@@ -1,0 +1,34 @@
+"""Core identity, message, and serialization layers (reference L0/L1)."""
+
+from .errors import *  # noqa: F401,F403
+from .ids import (  # noqa: F401
+    ActivationAddress,
+    ActivationId,
+    GrainCategory,
+    GrainId,
+    GrainType,
+    SiloAddress,
+    stable_hash32,
+    stable_hash64,
+    type_code_of,
+)
+from .message import (  # noqa: F401
+    Category,
+    Direction,
+    Message,
+    RejectionType,
+    ResponseKind,
+    make_request,
+    make_response,
+    make_error_response,
+    make_rejection,
+)
+from .serialization import (  # noqa: F401
+    ArrayField,
+    ArraySchema,
+    Immutable,
+    allow_wire_modules,
+    deep_copy,
+    deserialize,
+    serialize,
+)

@@ -1,0 +1,224 @@
+"""Message model (L1/L2 boundary).
+
+Re-design of the reference's single unit-of-work type
+(/root/reference/src/Orleans.Core/Messaging/Message.cs:12 — enums :74-101,
+HeadersContainer :725) plus the response envelope (``Response.cs``).
+
+Departures:
+
+* In-process and intra-slice delivery never serializes: messages are plain
+  Python objects handed between silo event loops (the reference deep-copies
+  instead for isolation — see ``immutable`` flag).
+* Batched device payloads: when a message targets a vectorized grain, ``body``
+  is a (method, args-pytree) pair whose leaves are numpy/jax scalars so the
+  tick engine can stack thousands of messages into one kernel launch.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from enum import IntEnum
+from typing import Any
+
+from .ids import ActivationId, GrainId, SiloAddress
+
+__all__ = [
+    "Category", "Direction", "ResponseKind", "RejectionType",
+    "Message", "make_request", "make_response", "make_error_response",
+    "make_rejection",
+]
+
+
+class Category(IntEnum):
+    """QoS classes with separate queues/draining in the reference
+    (``Message.cs:74-78``, ``InboundMessageQueue.cs``, ``Silo.cs:215-217``)."""
+
+    PING = 0
+    SYSTEM = 1
+    APPLICATION = 2
+
+
+class Direction(IntEnum):
+    """``Message.cs:80-85``."""
+
+    REQUEST = 0
+    RESPONSE = 1
+    ONE_WAY = 2
+
+
+class ResponseKind(IntEnum):
+    """Result discriminator (``Message.ResponseTypes``, ``Message.cs:95-101``)."""
+
+    SUCCESS = 0
+    ERROR = 1
+    REJECTION = 2
+
+
+class RejectionType(IntEnum):
+    """``Message.RejectionTypes`` (``Message.cs:87-93``)."""
+
+    TRANSIENT = 0
+    OVERLOADED = 1
+    DUPLICATE_REQUEST = 2
+    UNRECOVERABLE = 3
+    GATEWAY_TOO_BUSY = 4
+    CACHE_INVALIDATION = 5
+
+
+_correlation = itertools.count(1)
+
+
+@dataclass
+class Message:
+    """One grain message. Headers follow ``Message.HeadersContainer``
+    (``Message.cs:725``) trimmed to the fields the TPU runtime consumes.
+
+    ``__slots__`` keeps per-message overhead low on the host control plane; the
+    real hot path never materializes one object per logical invocation — the
+    tick engine (orleans_tpu.dispatch.tick) carries batches as arrays.
+    """
+
+    __slots__ = (
+        "category", "direction", "id", "sending_silo", "sending_grain",
+        "sending_activation", "target_silo", "target_grain", "target_activation",
+        "interface_name", "method_name", "body", "response_kind",
+        "rejection_type", "rejection_info", "forward_count", "resend_count",
+        "expires_at", "call_chain", "is_read_only", "is_always_interleave",
+        "is_unordered", "immutable", "cache_invalidation", "request_context",
+        "is_new_placement", "transaction_info",
+    )
+
+    category: Category
+    direction: Direction
+    id: int
+    sending_silo: SiloAddress | None
+    sending_grain: GrainId | None
+    sending_activation: ActivationId | None
+    target_silo: SiloAddress | None
+    target_grain: GrainId | None
+    target_activation: ActivationId | None
+    interface_name: str
+    method_name: str
+    body: Any
+    response_kind: ResponseKind
+    rejection_type: RejectionType | None
+    rejection_info: str | None
+    forward_count: int
+    resend_count: int
+    expires_at: float | None
+    call_chain: tuple[GrainId, ...]
+    is_read_only: bool
+    is_always_interleave: bool
+    is_unordered: bool
+    immutable: bool
+    cache_invalidation: list | None
+    request_context: dict | None
+    is_new_placement: bool
+    transaction_info: Any
+
+    # ------------------------------------------------------------------
+    @property
+    def is_expired(self) -> bool:
+        return self.expires_at is not None and time.monotonic() > self.expires_at
+
+    def created_response(self, kind: ResponseKind, body: Any) -> "Message":
+        """Build the response for this request, swapping sender/target
+        (``MessageFactory.CreateResponseMessage``)."""
+        return Message(
+            category=self.category,
+            direction=Direction.RESPONSE,
+            id=self.id,
+            sending_silo=self.target_silo,
+            sending_grain=self.target_grain,
+            sending_activation=self.target_activation,
+            target_silo=self.sending_silo,
+            target_grain=self.sending_grain,
+            target_activation=self.sending_activation,
+            interface_name=self.interface_name,
+            method_name=self.method_name,
+            body=body,
+            response_kind=kind,
+            rejection_type=None,
+            rejection_info=None,
+            forward_count=0,
+            resend_count=0,
+            expires_at=self.expires_at,
+            call_chain=(),
+            is_read_only=self.is_read_only,
+            is_always_interleave=False,
+            is_unordered=False,
+            immutable=True,
+            cache_invalidation=None,
+            request_context=None,
+            is_new_placement=False,
+            transaction_info=self.transaction_info,
+        )
+
+
+def make_request(
+    *,
+    target_grain: GrainId,
+    interface_name: str,
+    method_name: str,
+    body: Any,
+    category: Category = Category.APPLICATION,
+    direction: Direction = Direction.REQUEST,
+    sending_silo: SiloAddress | None = None,
+    sending_grain: GrainId | None = None,
+    sending_activation: ActivationId | None = None,
+    target_silo: SiloAddress | None = None,
+    timeout: float | None = 30.0,
+    call_chain: tuple[GrainId, ...] = (),
+    is_read_only: bool = False,
+    is_always_interleave: bool = False,
+    immutable: bool = False,
+    request_context: dict | None = None,
+) -> Message:
+    """Request factory (``MessageFactory.CreateMessage``). Default 30 s expiry
+    mirrors ``MessagingOptions.ResponseTimeout``."""
+    return Message(
+        category=category,
+        direction=direction,
+        id=next(_correlation),
+        sending_silo=sending_silo,
+        sending_grain=sending_grain,
+        sending_activation=sending_activation,
+        target_silo=target_silo,
+        target_grain=target_grain,
+        target_activation=None,
+        interface_name=interface_name,
+        method_name=method_name,
+        body=body,
+        response_kind=ResponseKind.SUCCESS,
+        rejection_type=None,
+        rejection_info=None,
+        forward_count=0,
+        resend_count=0,
+        expires_at=(time.monotonic() + timeout) if timeout is not None else None,
+        call_chain=call_chain,
+        is_read_only=is_read_only,
+        is_always_interleave=is_always_interleave,
+        is_unordered=False,
+        immutable=immutable,
+        cache_invalidation=None,
+        request_context=request_context,
+        is_new_placement=False,
+        transaction_info=None,
+    )
+
+
+def make_response(request: Message, result: Any) -> Message:
+    return request.created_response(ResponseKind.SUCCESS, result)
+
+
+def make_error_response(request: Message, exc: BaseException) -> Message:
+    return request.created_response(ResponseKind.ERROR, exc)
+
+
+def make_rejection(request: Message, rtype: RejectionType, info: str) -> Message:
+    msg = request.created_response(ResponseKind.REJECTION, None)
+    msg.rejection_type = rtype
+    msg.rejection_info = info
+    return msg

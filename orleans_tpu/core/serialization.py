@@ -1,0 +1,190 @@
+"""Serialization & payload schemas (L1).
+
+The reference has a 9,369-LoC three-tier serializer stack (codegen'd → IL-emitted
+→ fallback; /root/reference/src/Orleans.Core/Serialization/SerializationManager.cs:50,133)
+because every message crosses a socket. The TPU build's tiers are different:
+
+1. **Device tier** — payloads for vectorized grains are *array schemas*: fixed
+   dtype/shape pytrees that pack directly into batched kernel operands. This is
+   the analog of codegen'd serializers: zero-copy into the dispatch tick.
+2. **Host tier** — in-process messages are passed by reference; Orleans instead
+   deep-copies arguments for isolation (``SerializationManager.DeepCopy``,
+   registration :173-201). We keep that semantic behind :func:`deep_copy`
+   honoring an ``Immutable`` wrapper (``Concurrency/Immutable.cs``).
+3. **Wire tier** — cross-process control-plane bytes use a self-describing
+   pickle-based codec with a type allowlist hook (the fallback-serializer slot).
+"""
+
+from __future__ import annotations
+
+import copy
+import io
+import pickle
+import pickletools
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import numpy as np
+
+__all__ = [
+    "Immutable", "deep_copy", "serialize", "deserialize",
+    "allow_wire_modules", "ArrayField", "ArraySchema", "register_copier",
+]
+
+
+@dataclass(frozen=True)
+class Immutable:
+    """Marker wrapper: the sender promises not to mutate ``value`` so the
+    runtime may skip deep-copy isolation (``Immutable<T>``)."""
+
+    value: Any
+
+
+_copiers: dict[type, Callable[[Any], Any]] = {}
+
+
+def register_copier(typ: type, fn: Callable[[Any], Any]) -> None:
+    """Plug-in point mirroring ``SerializationManager.Register`` for deep-copy."""
+    _copiers[typ] = fn
+
+
+_SHALLOW_SAFE = (int, float, str, bytes, bool, type(None), frozenset, complex)
+
+
+def deep_copy(obj: Any) -> Any:
+    """Copy-isolation for in-silo calls (``SerializationManager.DeepCopy``).
+
+    Immutable wrappers, scalars, and jax/numpy arrays (immutable by API) pass
+    through untouched; everything else is deep-copied.
+    """
+    if isinstance(obj, Immutable):
+        return obj.value
+    if isinstance(obj, _SHALLOW_SAFE):
+        return obj
+    t = type(obj)
+    if t in _copiers:
+        return _copiers[t](obj)
+    # jax arrays are immutable; numpy arrays are not, but treating them as
+    # values is the framework contract for batched payloads (they are consumed
+    # by stacking, never mutated in place).
+    if isinstance(obj, np.ndarray) or t.__module__.startswith("jax"):
+        return obj
+    # Exact container types only — namedtuples / dict subclasses keep their
+    # type by falling through to copy.deepcopy.
+    if t is tuple:
+        return tuple(deep_copy(x) for x in obj)
+    if t is list:
+        return [deep_copy(x) for x in obj]
+    if t is dict:
+        return {deep_copy(k): deep_copy(v) for k, v in obj.items()}
+    return copy.deepcopy(obj)
+
+
+def serialize(obj: Any) -> bytes:
+    """Wire-tier encode (fallback-serializer slot, ``SerializationManager.cs:50``)."""
+    buf = io.BytesIO()
+    pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
+    return pickletools.optimize(buf.getvalue())
+
+
+# Module prefixes the wire-tier decoder will instantiate. Anything else is
+# rejected — the analog of the reference's serializer registration gate
+# (``SerializationManager.Register``): only known types cross the wire.
+_wire_allowlist: set[str] = {
+    "builtins", "collections", "datetime", "uuid", "decimal", "fractions",
+    "numpy", "jax", "jaxlib", "orleans_tpu",
+}
+
+
+def allow_wire_modules(*prefixes: str) -> None:
+    """Extend the wire-decode type allowlist (application grain payload types
+    must be registered, mirroring serializer registration in the reference)."""
+    _wire_allowlist.update(prefixes)
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    def find_class(self, module: str, name: str):
+        root = module.split(".", 1)[0]
+        if root not in _wire_allowlist:
+            raise pickle.UnpicklingError(
+                f"wire type {module}.{name} not in allowlist; call "
+                f"allow_wire_modules({root!r}) to register it")
+        return super().find_class(module, name)
+
+
+def deserialize(data: bytes) -> Any:
+    return _RestrictedUnpickler(io.BytesIO(data)).load()
+
+
+# ----------------------------------------------------------------------------
+# Device tier: array schemas for batched payloads
+# ----------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ArrayField:
+    """One field of a device payload/state schema."""
+
+    name: str
+    shape: tuple[int, ...]
+    dtype: Any  # numpy dtype-like
+
+    def zeros(self, batch: int | None = None) -> np.ndarray:
+        shape = self.shape if batch is None else (batch, *self.shape)
+        return np.zeros(shape, dtype=self.dtype)
+
+
+class ArraySchema:
+    """Fixed-layout schema: dict of named arrays with static shapes.
+
+    The codegen analog: a grain method that runs on device declares its args
+    schema once; the tick engine stacks per-message dicts into one batch
+    (``stack``) and splits kernel outputs back per message (``unstack``).
+    """
+
+    def __init__(self, *fields: ArrayField):
+        self.fields = fields
+        self.by_name = {f.name: f for f in fields}
+
+    @classmethod
+    def of(cls, **spec) -> "ArraySchema":
+        """``ArraySchema.of(x=(jnp.float32, (3,)), n=(jnp.int32, ()))``"""
+        fs = []
+        for name, (dtype, shape) in spec.items():
+            fs.append(ArrayField(name, tuple(shape), np.dtype(dtype)))
+        return cls(*fs)
+
+    def validate(self, payload: dict) -> None:
+        for f in self.fields:
+            v = np.asarray(payload[f.name])
+            if tuple(v.shape) != f.shape:
+                raise ValueError(
+                    f"field {f.name!r}: shape {v.shape} != schema {f.shape}")
+
+    def stack(self, payloads: list[dict], pad_to: int) -> dict[str, np.ndarray]:
+        """Stack N message payloads into batch arrays padded to ``pad_to``
+        rows (padding keeps kernel shapes static — XLA retraces only per
+        bucket size, not per batch)."""
+        out = {}
+        n = len(payloads)
+        for f in self.fields:
+            arr = np.zeros((pad_to, *f.shape), dtype=f.dtype)
+            if n:
+                try:
+                    arr[:n] = np.stack(
+                        [np.asarray(p[f.name], dtype=f.dtype) for p in payloads])
+                except ValueError as e:
+                    raise ValueError(
+                        f"payload field {f.name!r} does not match schema shape "
+                        f"{f.shape}: {e}") from None
+            out[f.name] = arr
+        return out
+
+    def unstack(self, batch: dict[str, np.ndarray], n: int) -> list[dict]:
+        """Split the first ``n`` rows of a batched kernel output back into
+        per-message dicts."""
+        keys = list(batch.keys())
+        cols = {k: np.asarray(batch[k]) for k in keys}
+        return [{k: cols[k][i] for k in keys} for i in range(n)]
+
+    def empty(self) -> dict[str, np.ndarray]:
+        return {f.name: f.zeros() for f in self.fields}

@@ -1,0 +1,19 @@
+"""Test configuration: force CPU jax with an 8-device virtual mesh so
+multi-"silo" sharding tests run anywhere (the driver validates the real
+multi-chip path separately via __graft_entry__.dryrun_multichip)."""
+
+import os
+
+# Must run before jax is imported anywhere.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"

@@ -1,0 +1,118 @@
+"""Identity-layer tests (parity with reference UniqueKey/GrainId behavior:
+stability, uniformity, round-tripping — test/NonSilo.Tests id tests)."""
+
+import uuid
+
+from orleans_tpu.core import (
+    ActivationAddress,
+    ActivationId,
+    GrainCategory,
+    GrainId,
+    GrainType,
+    SiloAddress,
+    stable_hash32,
+    stable_hash64,
+    type_code_of,
+)
+
+
+def test_stable_hash_is_deterministic():
+    assert stable_hash64("hello") == stable_hash64("hello")
+    assert stable_hash64(b"hello") == stable_hash64("hello".encode())
+    assert stable_hash64(42) == stable_hash64(42)
+    assert stable_hash64("a") != stable_hash64("b")
+    assert 0 <= stable_hash64("x") < 2**63
+    assert 0 <= stable_hash32("x") < 2**32
+
+
+def test_type_code_stable_and_distinct():
+    assert type_code_of("IHello") == type_code_of("IHello")
+    assert type_code_of("IHello") != type_code_of("IPlayer")
+
+
+def test_grain_id_equality_and_hash():
+    t = GrainType.of("PlayerGrain")
+    a = GrainId.for_grain(t, 7)
+    b = GrainId.for_grain(t, 7)
+    c = GrainId.for_grain(t, 8)
+    assert a == b
+    assert hash(a) == hash(b)
+    assert a != c
+    assert a.uniform_hash == b.uniform_hash
+    assert a.uniform_hash != c.uniform_hash
+
+
+def test_grain_id_key_kinds():
+    t = GrainType.of("G")
+    ids = [
+        GrainId.for_grain(t, 1),
+        GrainId.for_grain(t, "one"),
+        GrainId.for_guid(t, uuid.uuid5(uuid.NAMESPACE_DNS, "x")),
+        GrainId.for_grain(t, 1, key_ext="shard-a"),
+    ]
+    hashes = {g.uniform_hash for g in ids}
+    assert len(hashes) == len(ids)
+    # int key 1 with and without extension must differ
+    assert ids[0] != ids[3]
+
+
+def test_hash_uniformity_over_sequential_keys():
+    """Sequential integer keys must spread uniformly over buckets — the
+    property the reference's Jenkins hash provides for ring/directory
+    sharding (UniqueKey.cs:272-286)."""
+    t = GrainType.of("EchoGrain")
+    n, buckets = 8192, 8
+    counts = [0] * buckets
+    for k in range(n):
+        counts[GrainId.for_grain(t, k).uniform_hash % buckets] += 1
+    expected = n / buckets
+    for c in counts:
+        assert abs(c - expected) < expected * 0.2, counts
+
+
+def test_silo_address():
+    s1 = SiloAddress("10.0.0.1", 11111, generation=1)
+    s2 = SiloAddress("10.0.0.1", 11111, generation=2)
+    assert s1.same_endpoint(s2)
+    assert s2.is_successor_of(s1)
+    assert not s1.is_successor_of(s2)
+    assert s1.uniform_hash != s2.uniform_hash
+    assert s1 != s2
+
+
+def test_activation_ids_unique():
+    ids = {ActivationId.new().value for _ in range(1000)}
+    assert len(ids) == 1000
+
+
+def test_system_target_id():
+    s = SiloAddress("h", 1, 1)
+    g = GrainId.system_target(0x1234, s)
+    assert g.is_system_target()
+    assert not g.is_client()
+
+
+def test_activation_address_str():
+    s = SiloAddress("h", 1, 1)
+    g = GrainId.for_grain(GrainType.of("G"), 0)
+    a = ActivationAddress(s, g, ActivationId.new())
+    assert "Sh:1@1" in str(a)
+    assert "act-" in str(a)
+
+
+def test_no_engineered_hash_collision_via_key_ext():
+    """'a+b' as key must not collide with key 'a' + ext 'b' (length-prefixed
+    hash payload)."""
+    t = GrainType.of("G")
+    a = GrainId.for_grain(t, "a+b")
+    b = GrainId.for_grain(t, "a", key_ext="b")
+    assert a.uniform_hash != b.uniform_hash
+
+
+def test_uuid_int_key_supported():
+    import uuid as _uuid
+    t = GrainType.of("G")
+    big = _uuid.UUID("ffffffff-ffff-ffff-ffff-ffffffffffff").int
+    g = GrainId.for_grain(t, big)
+    assert g.uniform_hash >= 0
+    assert stable_hash64(big) == stable_hash64(big)
