@@ -30,5 +30,6 @@ from .serialization import (  # noqa: F401
     allow_wire_modules,
     deep_copy,
     deserialize,
+    register_copier,
     serialize,
 )

@@ -37,9 +37,6 @@ __all__ = [
     "type_code_of",
 ]
 
-_MASK64 = (1 << 64) - 1
-
-
 def stable_hash64(data: Union[bytes, str, int]) -> int:
     """Deterministic 64-bit hash, stable across processes and hosts.
 

@@ -67,7 +67,9 @@ def deep_copy(obj: Any) -> Any:
     # jax arrays are immutable; numpy arrays are not, but treating them as
     # values is the framework contract for batched payloads (they are consumed
     # by stacking, never mutated in place).
-    if isinstance(obj, np.ndarray) or t.__module__.startswith("jax"):
+    mod = t.__module__
+    if isinstance(obj, np.ndarray) or mod == "jax" or \
+            mod.startswith(("jax.", "jaxlib")):
         return obj
     # Exact container types only — namedtuples / dict subclasses keep their
     # type by falling through to copy.deepcopy.
@@ -87,13 +89,24 @@ def serialize(obj: Any) -> bytes:
     return pickletools.optimize(buf.getvalue())
 
 
-# Module prefixes the wire-tier decoder will instantiate. Anything else is
+# Module roots the wire-tier decoder will instantiate. Anything else is
 # rejected — the analog of the reference's serializer registration gate
 # (``SerializationManager.Register``): only known types cross the wire.
 _wire_allowlist: set[str] = {
     "builtins", "collections", "datetime", "uuid", "decimal", "fractions",
     "numpy", "jax", "jaxlib", "orleans_tpu",
 }
+
+# builtins is special-cased: only value-constructor names, never eval/exec/
+# getattr/__import__ (any of which turns unpickling into code execution).
+_SAFE_BUILTINS = frozenset({
+    "complex", "bytearray", "bytes", "dict", "frozenset", "list", "set",
+    "str", "int", "float", "bool", "tuple", "range", "slice", "object",
+    "Exception", "BaseException", "ValueError", "TypeError", "KeyError",
+    "IndexError", "AttributeError", "RuntimeError", "OSError", "IOError",
+    "TimeoutError", "StopIteration", "ArithmeticError", "ZeroDivisionError",
+    "NotImplementedError", "AssertionError", "LookupError",
+})
 
 
 def allow_wire_modules(*prefixes: str) -> None:
@@ -109,6 +122,9 @@ class _RestrictedUnpickler(pickle.Unpickler):
             raise pickle.UnpicklingError(
                 f"wire type {module}.{name} not in allowlist; call "
                 f"allow_wire_modules({root!r}) to register it")
+        if root == "builtins" and name not in _SAFE_BUILTINS:
+            raise pickle.UnpicklingError(
+                f"builtins.{name} is not wire-decodable")
         return super().find_class(module, name)
 
 
@@ -166,6 +182,10 @@ class ArraySchema:
         bucket size, not per batch)."""
         out = {}
         n = len(payloads)
+        if n > pad_to:
+            raise ValueError(
+                f"batch of {n} payloads exceeds pad_to={pad_to} "
+                f"(tick-engine bucketing bug)")
         for f in self.fields:
             arr = np.zeros((pad_to, *f.shape), dtype=f.dtype)
             if n:

@@ -1,0 +1,3 @@
+"""Observability: statistics, management surface (reference L13)."""
+
+from .stats import Histogram, StatsRegistry  # noqa: F401

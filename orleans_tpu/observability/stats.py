@@ -1,0 +1,90 @@
+"""Statistics registry (L13).
+
+Re-design of /root/reference/src/Orleans.Core/Statistics/ (CounterStatistic,
+IntValueStatistic, HistogramValueStatistic, StatisticNames) — a flat named
+registry of counters/gauges/histograms per silo, cheap enough for hot paths,
+dumpable for the management surface and test assertions.
+"""
+
+from __future__ import annotations
+
+import bisect
+import time
+from typing import Callable
+
+__all__ = ["StatsRegistry", "Histogram"]
+
+
+class Histogram:
+    """Fixed-bucket latency histogram (HistogramValueStatistic)."""
+
+    # bucket upper bounds in seconds
+    BOUNDS = [0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+              0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, float("inf")]
+
+    def __init__(self) -> None:
+        self.counts = [0] * len(self.BOUNDS)
+        self.total = 0
+        self.sum = 0.0
+
+    def observe(self, value: float) -> None:
+        self.counts[bisect.bisect_left(self.BOUNDS, value)] += 1
+        self.total += 1
+        self.sum += value
+
+    def percentile(self, p: float) -> float:
+        """Approximate percentile from bucket bounds (upper bound of the
+        bucket containing the p-quantile observation)."""
+        if self.total == 0:
+            return 0.0
+        rank = p * self.total
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= rank:
+                return self.BOUNDS[i]
+        return self.BOUNDS[-1]
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.total if self.total else 0.0
+
+
+class StatsRegistry:
+    """Named counters/gauges/histograms (CounterStatistic registry)."""
+
+    def __init__(self) -> None:
+        self.counters: dict[str, int] = {}
+        self.gauges: dict[str, Callable[[], float]] = {}
+        self.histograms: dict[str, Histogram] = {}
+
+    def increment(self, name: str, delta: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + delta
+
+    def get(self, name: str) -> int:
+        return self.counters.get(name, 0)
+
+    def register_gauge(self, name: str, fn: Callable[[], float]) -> None:
+        self.gauges[name] = fn
+
+    def histogram(self, name: str) -> Histogram:
+        h = self.histograms.get(name)
+        if h is None:
+            h = self.histograms[name] = Histogram()
+        return h
+
+    def observe(self, name: str, value: float) -> None:
+        self.histogram(name).observe(value)
+
+    def snapshot(self) -> dict:
+        """Dump for LogStatistics / management queries."""
+        return {
+            "counters": dict(self.counters),
+            "gauges": {k: fn() for k, fn in self.gauges.items()},
+            "histograms": {
+                k: {"count": h.total, "mean": h.mean,
+                    "p50": h.percentile(0.5), "p99": h.percentile(0.99)}
+                for k, h in self.histograms.items()
+            },
+            "ts": time.time(),
+        }

@@ -1,0 +1,23 @@
+"""Host-tier runtime: silo, catalog, dispatcher, grain API (reference
+L3/L4/L8/L9/L10)."""
+
+from .activation import ActivationData, ActivationState  # noqa: F401
+from .cluster import ClusterClient, InProcFabric  # noqa: F401
+from .context import RequestContext  # noqa: F401
+from .grain import (  # noqa: F401
+    Grain,
+    StatefulGrain,
+    always_interleave,
+    one_way,
+    placement,
+    read_only,
+    reentrant,
+    stateless_worker,
+)
+from .references import GrainFactory, GrainRef  # noqa: F401
+from .silo import (  # noqa: F401
+    ServiceLifecycleStage,
+    Silo,
+    SiloBuilder,
+    SiloConfig,
+)

@@ -1,0 +1,165 @@
+"""In-process fabric + external cluster client.
+
+The fabric plays the role of the reference's socket layer for in-process
+clusters (/root/reference/src/Orleans.Core/Messaging/SocketManager.cs,
+Runtime/Messaging/Gateway.cs:17, GatewayAcceptor.cs) and is the fault
+injection point for liveness tests (kill = AppDomain unload in
+TestingHost/AppDomainSiloHandle.cs:14; here: drop the silo from routing).
+
+The client mirrors OutsideRuntimeClient (Core/Runtime/OutsideRuntimeClient.cs:22)
++ ClientMessageCenter/GatewayManager: gateway selection is round-robin over
+alive silos; responses route back via the client's pseudo silo address.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+from typing import Any
+
+from ..core.errors import SiloUnavailableError
+from ..core.ids import SiloAddress
+from ..core.message import Direction, Message
+from .references import GrainFactory
+from .runtime_client import RuntimeClient
+
+log = logging.getLogger("orleans.fabric")
+
+__all__ = ["InProcFabric", "ClusterClient"]
+
+
+class InProcFabric:
+    """Message routing + liveness simulation for every silo/client sharing
+    one event loop."""
+
+    def __init__(self) -> None:
+        self.silos: dict[SiloAddress, Any] = {}
+        self.clients: dict[SiloAddress, "ClusterClient"] = {}
+        self.dead: set[SiloAddress] = set()
+        self._ports = itertools.count(11111)
+        self._generation = itertools.count(1)
+        # ordered pairs of endpoints whose traffic is dropped (partition tests)
+        self.partitions: set[tuple[str, str]] = set()
+
+    # -- address allocation ---------------------------------------------
+    def allocate_address(self, name: str) -> SiloAddress:
+        return SiloAddress(name, next(self._ports), next(self._generation))
+
+    def allocate_client_address(self) -> SiloAddress:
+        return SiloAddress("client", next(self._ports), next(self._generation))
+
+    # -- membership of the wire (not the cluster oracle) ------------------
+    def register_silo(self, silo) -> None:
+        self.silos[silo.silo_address] = silo
+        self.dead.discard(silo.silo_address)
+
+    def unregister_silo(self, silo, dead: bool = False) -> None:
+        self.silos.pop(silo.silo_address, None)
+        if dead:
+            self.dead.add(silo.silo_address)
+
+    def register_client(self, client: "ClusterClient") -> None:
+        self.clients[client.silo_address] = client
+
+    def unregister_client(self, client: "ClusterClient") -> None:
+        self.clients.pop(client.silo_address, None)
+
+    def is_dead(self, addr: SiloAddress) -> bool:
+        return addr in self.dead or (
+            addr not in self.silos and addr not in self.clients)
+
+    def alive_silos(self) -> list[SiloAddress]:
+        return [a for a, s in self.silos.items() if s.status in
+                ("Running", "Joining")]
+
+    # -- fault injection --------------------------------------------------
+    def partition(self, a: SiloAddress, b: SiloAddress) -> None:
+        self.partitions.add((a.endpoint, b.endpoint))
+        self.partitions.add((b.endpoint, a.endpoint))
+
+    def heal_partition(self, a: SiloAddress, b: SiloAddress) -> None:
+        self.partitions.discard((a.endpoint, b.endpoint))
+        self.partitions.discard((b.endpoint, a.endpoint))
+
+    # -- the wire ----------------------------------------------------------
+    def deliver(self, msg: Message) -> None:
+        """Route one message to its target silo or client inbox."""
+        target = msg.target_silo
+        if target is None:
+            log.warning("dropping unaddressed message %s", msg.method_name)
+            return
+        if msg.sending_silo is not None and \
+                (msg.sending_silo.endpoint, target.endpoint) in self.partitions:
+            return  # partitioned: silently dropped, like a black-holed link
+        client = self.clients.get(target)
+        if client is not None:
+            client.deliver(msg)
+            return
+        silo = self.silos.get(target)
+        if silo is None or target in self.dead:
+            return  # dead silo: dropped; senders learn via membership/timeout
+        silo.message_center.deliver(msg)
+
+    def deliver_via_gateway(self, gateway: SiloAddress, msg: Message) -> None:
+        """Client ingress: hand to a gateway silo which will address it
+        (GatewayAcceptor path)."""
+        silo = self.silos.get(gateway)
+        if silo is None:
+            raise SiloUnavailableError(f"gateway {gateway} unavailable")
+        silo.message_center.deliver(msg)
+
+
+class ClusterClient(RuntimeClient):
+    """External client (OutsideRuntimeClient.cs:22): N gateway connections →
+    here, round-robin gateway pick per request over alive silos."""
+
+    def __init__(self, fabric: InProcFabric, response_timeout: float = 30.0):
+        super().__init__(response_timeout=response_timeout)
+        self.fabric = fabric
+        self._address = fabric.allocate_client_address()
+        self.grain_factory = GrainFactory(self)
+        self._gateway_rr = 0
+        self.connected = False
+
+    # -- RuntimeClient surface --------------------------------------------
+    @property
+    def silo_address(self) -> SiloAddress:
+        return self._address
+
+    def transmit(self, msg: Message) -> None:
+        msg.sending_silo = self._address
+        gateways = self.fabric.alive_silos()
+        if not gateways:
+            raise SiloUnavailableError("no gateways available")
+        # affinity: route by target-grain hash so one grain's requests keep
+        # order through one gateway (ClientMessageCenter affinity routing)
+        if msg.target_grain is not None:
+            gw = gateways[msg.target_grain.uniform_hash % len(gateways)]
+        else:
+            self._gateway_rr = (self._gateway_rr + 1) % len(gateways)
+            gw = gateways[self._gateway_rr]
+        self.fabric.deliver_via_gateway(gw, msg)
+
+    def deliver(self, msg: Message) -> None:
+        """Inbound from the fabric (the client message pump,
+        OutsideRuntimeClient.RunClientMessagePump:235)."""
+        if msg.direction == Direction.RESPONSE:
+            self.receive_response(msg)
+        # grain→client observer calls land here too once observers exist
+
+    # -- lifecycle ---------------------------------------------------------
+    async def connect(self) -> "ClusterClient":
+        if not self.fabric.alive_silos():
+            raise SiloUnavailableError("no silos to connect to")
+        self.fabric.register_client(self)
+        self.connected = True
+        return self
+
+    async def close_async(self) -> None:
+        self.fabric.unregister_client(self)
+        self.connected = False
+        self.close()
+
+    def get_grain(self, grain_class: type, key, key_ext: str | None = None):
+        return self.grain_factory.get_grain(grain_class, key, key_ext)

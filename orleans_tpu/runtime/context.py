@@ -1,0 +1,63 @@
+"""Execution context: which activation is running, plus request baggage.
+
+The reference pins the current scheduling context in TLS
+(/root/reference/src/Orleans.Core/Runtime/RuntimeContext.cs) and flows
+user baggage via ``RequestContext``
+(Core.Abstractions/Runtime/RequestContext.cs). asyncio's ``contextvars``
+give both for free — a turn is an awaited coroutine, and context vars
+propagate through awaits exactly like the reference's logical call context.
+"""
+
+from __future__ import annotations
+
+import contextvars
+from typing import TYPE_CHECKING, Any
+
+if TYPE_CHECKING:
+    from .activation import ActivationData
+
+# The activation whose turn is currently executing (RuntimeContext TLS).
+current_activation: contextvars.ContextVar["ActivationData | None"] = (
+    contextvars.ContextVar("orleans_current_activation", default=None)
+)
+
+# User baggage propagated in message headers (RequestContext).
+_request_context: contextvars.ContextVar[dict | None] = contextvars.ContextVar(
+    "orleans_request_context", default=None
+)
+
+
+class RequestContext:
+    """Static accessors mirroring the reference API
+    (``RequestContext.Get/Set/Remove``)."""
+
+    @staticmethod
+    def get(key: str, default: Any = None) -> Any:
+        ctx = _request_context.get()
+        return default if ctx is None else ctx.get(key, default)
+
+    @staticmethod
+    def set(key: str, value: Any) -> None:
+        ctx = dict(_request_context.get() or {})
+        ctx[key] = value
+        _request_context.set(ctx)
+
+    @staticmethod
+    def remove(key: str) -> None:
+        ctx = dict(_request_context.get() or {})
+        ctx.pop(key, None)
+        _request_context.set(ctx or None)
+
+    @staticmethod
+    def export() -> dict | None:
+        """Snapshot for message headers (``RequestContextExtensions.Export``)."""
+        ctx = _request_context.get()
+        return dict(ctx) if ctx else None
+
+    @staticmethod
+    def import_(ctx: dict | None) -> None:
+        _request_context.set(dict(ctx) if ctx else None)
+
+    @staticmethod
+    def clear() -> None:
+        _request_context.set(None)

@@ -1,0 +1,252 @@
+"""Dispatcher: central message router for a silo.
+
+Re-design of /root/reference/src/Orleans.Runtime/Core/Dispatcher.cs:19 —
+``ReceiveMessage:75``, ``ReceiveRequest:262``, ``ActivationMayAcceptRequest:313``,
+``CheckDeadlock:364``, ``HandleIncomingRequest:399``, ``EnqueueRequest:431``,
+``TryForwardRequest:526``, ``AsyncSendMessage:645``, ``AddressMessage:715``,
+``SendResponse:769``, ``RunMessagePump:845`` — fused with the invoke engine of
+``InsideRuntimeClient.Invoke:294-474``.
+
+asyncio re-design notes: a "turn" is one request coroutine; the message pump
+is event-driven (runs after every turn completion) rather than a dedicated
+thread loop; forwarding/re-addressing reuses the same ``send_message`` path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import TYPE_CHECKING
+
+from ..core.errors import GrainOverloadedError, NonExistentActivationError
+from ..core.message import (
+    Direction,
+    Message,
+    RejectionType,
+    make_error_response,
+    make_rejection,
+    make_response,
+)
+from ..core.serialization import deep_copy
+from .activation import ActivationData, ActivationState
+from .context import RequestContext, current_activation
+
+if TYPE_CHECKING:
+    from .silo import Silo
+
+log = logging.getLogger("orleans.dispatcher")
+
+MAX_FORWARD_COUNT = 2  # SiloMessagingOptions.MaxForwardCount default
+
+
+class Dispatcher:
+    def __init__(self, silo: "Silo"):
+        self.silo = silo
+        self.detect_deadlocks = silo.config.detect_deadlocks
+
+    # ==================================================================
+    # Receive path
+    # ==================================================================
+    def receive_message(self, msg: Message) -> None:
+        """Entry for every message arriving at this silo (ReceiveMessage:75)."""
+        if msg.direction == Direction.RESPONSE:
+            self.silo.runtime_client.receive_response(msg)
+            return
+        try:
+            activation = self.silo.catalog.get_or_create_activation(msg)
+        except NonExistentActivationError as e:
+            self._reject_or_forward(msg, str(e))
+            return
+        except Exception as e:  # placement/registration failure
+            self._reject(msg, RejectionType.TRANSIENT, f"activation failed: {e}")
+            return
+        if activation.state == ActivationState.ACTIVATING:
+            # queue behind OnActivate (Catalog.cs:487-502 dummy-activation
+            # queue) — bounded by the same overload limit as the mailbox
+            if len(activation.activating_backlog) >= activation.max_enqueued:
+                self._reject(msg, RejectionType.OVERLOADED,
+                             f"{activation.grain_id} activating backlog full")
+                return
+            activation.activating_backlog.append(msg)
+            return
+        if activation.state in (ActivationState.DEACTIVATING, ActivationState.INVALID):
+            self._reject_or_forward(msg, "activation deactivating")
+            return
+        self.receive_request(activation, msg)
+
+    def receive_request(self, activation: ActivationData, msg: Message) -> None:
+        """ReceiveRequest:262 — gate, then run or enqueue."""
+        if msg.is_expired:
+            log.warning("dropping expired request %s", msg.method_name)
+            return
+        if self.detect_deadlocks and activation.grain_id in msg.call_chain \
+                and not activation.may_accept_request(msg):
+            # cycle through a busy non-interleavable activation: with the
+            # call-chain reentrancy rule in the gate this is unreachable,
+            # but stays as the CheckDeadlock:364 guard when that rule is off.
+            self._reject(msg, RejectionType.UNRECOVERABLE,
+                         f"deadlock cycle detected: {msg.call_chain}")
+            return
+        if activation.may_accept_request(msg):
+            self._handle_incoming(activation, msg)
+        else:
+            try:
+                activation.check_overloaded()
+            except GrainOverloadedError as e:
+                self._reject(msg, RejectionType.OVERLOADED, str(e))
+                return
+            activation.waiting.append(msg)  # EnqueueRequest:431
+
+    def _handle_incoming(self, activation: ActivationData, msg: Message) -> None:
+        """HandleIncomingRequest:399 → schedule the turn."""
+        activation.record_running(msg)
+        asyncio.get_running_loop().create_task(
+            self._run_turn(activation, msg))
+
+    async def _run_turn(self, activation: ActivationData, msg: Message) -> None:
+        """One turn: invoke the grain method, send the response, pump
+        (InvokeWorkItem.Execute → InsideRuntimeClient.Invoke:294-474 →
+        OnActivationCompletedRequest → RunMessagePump)."""
+        token_a = current_activation.set(activation)
+        RequestContext.import_(msg.request_context)
+        try:
+            result = await self.invoke(activation, msg)
+            if msg.direction == Direction.REQUEST:
+                self.send_response(msg, make_response(msg, deep_copy(result)))
+        except BaseException as e:  # noqa: BLE001 — grain errors flow to caller
+            if msg.direction == Direction.REQUEST:
+                self.send_response(msg, make_error_response(msg, e))
+            else:
+                log.exception("one-way turn failed on %s.%s",
+                              msg.interface_name, msg.method_name)
+            self.silo.catalog.on_invoke_error(activation, e)
+        finally:
+            RequestContext.clear()
+            current_activation.reset(token_a)
+            activation.reset_running(msg)
+            self.run_message_pump(activation)
+
+    async def invoke(self, activation: ActivationData, msg: Message):
+        """Resolve and call the grain method (Invoke:294-474, codegen
+        method-id switch → plain getattr here)."""
+        if msg.method_name == "__timer__":
+            callback, done = msg.body
+            try:
+                result = callback()
+                if asyncio.iscoroutine(result):
+                    result = await result
+                if done is not None and not done.done():
+                    done.set_result(None)
+                return None
+            except BaseException as e:
+                if done is not None and not done.done():
+                    done.set_exception(e)
+                raise
+        instance = activation.grain_instance
+        fn = getattr(instance, msg.method_name, None)
+        if fn is None:
+            raise AttributeError(
+                f"{activation.grain_class.__name__} has no method "
+                f"{msg.method_name!r}")
+        args, kwargs = msg.body
+        return await fn(*args, **kwargs)
+
+    def run_message_pump(self, activation: ActivationData) -> None:
+        """Drain the waiting queue as far as the gate allows
+        (RunMessagePump:845)."""
+        while activation.waiting:
+            if activation.state != ActivationState.VALID:
+                break
+            nxt = activation.waiting[0]
+            if not activation.may_accept_request(nxt):
+                break
+            activation.waiting.popleft()
+            if nxt.is_expired:
+                continue
+            self._handle_incoming(activation, nxt)
+        if activation.wants_deactivation:
+            self.silo.catalog.schedule_deactivation(activation)
+
+    async def run_closed_turn(self, activation: ActivationData, callback) -> None:
+        """Run a host callback (timer tick, system work) as a gated turn on
+        the activation — preserves single-threaded-turn semantics for
+        non-message work (GrainTimer ticks run as turns)."""
+        loop = asyncio.get_running_loop()
+        done: asyncio.Future = loop.create_future()
+        from ..core.message import Category, make_request
+        msg = make_request(
+            target_grain=activation.grain_id,
+            interface_name=activation.grain_class.__name__,
+            method_name="__timer__",
+            body=(callback, done),
+            direction=Direction.ONE_WAY,
+            category=Category.SYSTEM,
+            target_silo=self.silo.silo_address,
+            timeout=None,
+        )
+        msg.target_activation = activation.activation_id
+        self.receive_request(activation, msg)
+        await done
+
+    # ==================================================================
+    # Send path
+    # ==================================================================
+    def send_message(self, msg: Message, grain_class: type | None = None) -> None:
+        """AsyncSendMessage:645 — address if needed, then transmit."""
+        if msg.target_silo is None:
+            asyncio.get_running_loop().create_task(
+                self._address_and_send(msg, grain_class))
+        else:
+            self.transmit(msg)
+
+    async def _address_and_send(self, msg: Message,
+                                grain_class: type | None) -> None:
+        """AddressMessage:715 — placement director + directory lookup."""
+        try:
+            target = await self.silo.locator.locate(msg, grain_class)
+            msg.target_silo = target
+            self.transmit(msg)
+        except Exception as e:  # noqa: BLE001
+            log.exception("addressing failed for %s", msg.target_grain)
+            if msg.direction == Direction.REQUEST:
+                resp = make_error_response(msg, e)
+                resp.target_silo = msg.sending_silo
+                self.transmit(resp)
+
+    def transmit(self, msg: Message) -> None:
+        """Hand to the message center: loopback locally, network otherwise."""
+        if msg.target_silo is not None and \
+                msg.target_silo == self.silo.silo_address:
+            self.receive_message(msg)
+        else:
+            self.silo.message_center.send_message(msg)
+
+    def send_response(self, request: Message, response: Message) -> None:
+        """SendResponse:769."""
+        if request.direction == Direction.ONE_WAY:
+            return
+        response.target_silo = request.sending_silo
+        self.transmit(response)
+
+    # ==================================================================
+    # Rejection / forwarding (TryForwardRequest:526)
+    # ==================================================================
+    def _reject(self, msg: Message, rtype: RejectionType, info: str) -> None:
+        if msg.direction == Direction.ONE_WAY:
+            return
+        rej = make_rejection(msg, rtype, info)
+        rej.target_silo = msg.sending_silo
+        self.transmit(rej)
+
+    def _reject_or_forward(self, msg: Message, reason: str) -> None:
+        """Misdelivered/raced request: re-address and forward up to
+        MaxForwardCount hops, else reject transient (Dispatcher.cs:591-630)."""
+        if msg.forward_count < MAX_FORWARD_COUNT:
+            msg.forward_count += 1
+            msg.target_silo = None
+            msg.target_activation = None
+            self.silo.locator.invalidate_cache(msg.target_grain)
+            self.send_message(msg)
+        else:
+            self._reject(msg, RejectionType.TRANSIENT,
+                         f"forward limit reached: {reason}")

@@ -1,0 +1,86 @@
+"""Grain references: typed proxies without codegen.
+
+The reference emits ``GrainReference`` subclasses per interface at build time
+(/root/reference/src/Orleans.CodeGeneration/GrainReferenceGenerator.cs:22;
+invocation glue GrainReference.cs:35,340-342, GrainFactory.cs:59-124).
+Python needs no codegen: a :class:`GrainRef` resolves methods against the
+grain class's public async methods at call time and forwards them as request
+messages through the runtime client.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import TYPE_CHECKING, Any
+
+from ..core.ids import GrainId
+from .grain import grain_type_of, remote_methods
+
+if TYPE_CHECKING:
+    from .runtime_client import RuntimeClient
+
+__all__ = ["GrainRef", "GrainFactory"]
+
+
+class GrainRef:
+    """Remote-callable handle to a grain identity (``GrainReference``).
+
+    ``ref.method(*args, **kw)`` → awaitable result. Methods marked
+    ``@one_way`` return None immediately (fire-and-forget).
+    """
+
+    __slots__ = ("grain_class", "grain_id", "_client", "_methods")
+
+    def __init__(self, grain_class: type, grain_id: GrainId,
+                 client: "RuntimeClient"):
+        self.grain_class = grain_class
+        self.grain_id = grain_id
+        self._client = client
+        self._methods = remote_methods(grain_class)
+
+    def __getattr__(self, name: str):
+        fn = self._methods.get(name)
+        if fn is None:
+            raise AttributeError(
+                f"{self.grain_class.__name__} has no remote method {name!r} "
+                f"(remote methods are public async defs)")
+        return functools.partial(self._invoke, name, fn)
+
+    def _invoke(self, name: str, fn, *args: Any, **kwargs: Any):
+        return self._client.send_request(
+            target_grain=self.grain_id,
+            grain_class=self.grain_class,
+            interface_name=self.grain_class.__name__,
+            method_name=name,
+            args=args,
+            kwargs=kwargs,
+            is_read_only=getattr(fn, "__orleans_read_only__", False),
+            is_always_interleave=getattr(fn, "__orleans_always_interleave__", False),
+            is_one_way=getattr(fn, "__orleans_one_way__", False),
+        )
+
+    def __eq__(self, other: object) -> bool:
+        return (isinstance(other, GrainRef)
+                and other.grain_id == self.grain_id)
+
+    def __hash__(self) -> int:
+        return hash(self.grain_id)
+
+    def __repr__(self) -> str:
+        return f"GrainRef({self.grain_class.__name__}, {self.grain_id.key!r})"
+
+
+class GrainFactory:
+    """``IGrainFactory.GetGrain`` surface (GrainFactory.cs:59-124)."""
+
+    def __init__(self, client: "RuntimeClient"):
+        self._client = client
+
+    def get_grain(self, grain_class: type, key: Any,
+                  key_ext: str | None = None) -> GrainRef:
+        gid = GrainId.for_grain(grain_type_of(grain_class), key, key_ext)
+        return GrainRef(grain_class, gid, self._client)
+
+    def get_system_target(self, grain_class: type, grain_id: GrainId) -> GrainRef:
+        ref = GrainRef(grain_class, grain_id, self._client)
+        return ref

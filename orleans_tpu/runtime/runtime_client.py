@@ -1,0 +1,182 @@
+"""RuntimeClient: the RPC engine shared by silo-interior and external clients.
+
+Re-design of /root/reference/src/Orleans.Runtime/Core/InsideRuntimeClient.cs:28
+(``SendRequest:120-229`` with callback registry :207-217, ``Invoke:294-474``,
+``ReceiveResponse:569-627``, ``BreakOutstandingMessagesToDeadSilo:726``) and
+``CallbackData`` (Core/Runtime/CallbackData.cs).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import TYPE_CHECKING, Any
+
+from ..core.errors import (
+    GrainCallTimeoutError,
+    RejectionError,
+    SiloUnavailableError,
+)
+from ..core.ids import GrainId, SiloAddress
+from ..core.message import Direction, Message, ResponseKind, make_request
+from ..core.serialization import deep_copy
+from .context import RequestContext, current_activation
+
+if TYPE_CHECKING:
+    from .activation import ActivationData
+
+log = logging.getLogger("orleans.rpc")
+
+MAX_RESEND_COUNT = 3  # SiloMessagingOptions.MaxResendCount analog
+
+
+class CallbackData:
+    """One outstanding request: future + timeout bookkeeping (CallbackData.cs)."""
+
+    __slots__ = ("message", "future", "deadline")
+
+    def __init__(self, message: Message, future: asyncio.Future, deadline: float | None):
+        self.message = message
+        self.future = future
+        self.deadline = deadline
+
+
+class RuntimeClient:
+    """Shared base: callback registry + response correlation. Subclassed by
+    the silo interior (:class:`InsideRuntimeClient`) and the external client
+    (orleans_tpu.runtime.client.ClusterClient)."""
+
+    def __init__(self, response_timeout: float = 30.0):
+        self.callbacks: dict[int, CallbackData] = {}
+        self.response_timeout = response_timeout
+        self._timeout_sweeper: asyncio.Task | None = None
+
+    # -- to be provided by subclass -------------------------------------
+    @property
+    def silo_address(self) -> SiloAddress | None:  # pragma: no cover
+        raise NotImplementedError
+
+    def transmit(self, msg: Message) -> None:  # pragma: no cover
+        """Hand the message to the transport/dispatch layer."""
+        raise NotImplementedError
+
+    # -- request path (SendRequest) --------------------------------------
+    def send_request(self, *, target_grain: GrainId, grain_class: type,
+                     interface_name: str, method_name: str,
+                     args: tuple, kwargs: dict,
+                     is_read_only: bool = False,
+                     is_always_interleave: bool = False,
+                     is_one_way: bool = False,
+                     timeout: float | None = None):
+        timeout = self.response_timeout if timeout is None else timeout
+        sender = current_activation.get()
+        call_chain: tuple[GrainId, ...] = ()
+        if sender is not None:
+            # extend the caller's chain for deadlock/reentrancy detection
+            # (InsideRuntimeClient.cs:306-311)
+            running = sender.running[-1] if sender.running else None
+            parent_chain = running.call_chain if running is not None else ()
+            call_chain = (*parent_chain, sender.grain_id)
+        # Copy-isolate arguments at send time (SerializationManager.DeepCopy
+        # for in-silo calls): caller mutations after the call cannot leak into
+        # the callee. Immutable-wrapped args pass by reference.
+        msg = make_request(
+            target_grain=target_grain,
+            interface_name=interface_name,
+            method_name=method_name,
+            body=deep_copy((args, kwargs)),
+            direction=Direction.ONE_WAY if is_one_way else Direction.REQUEST,
+            sending_silo=self.silo_address,
+            sending_grain=sender.grain_id if sender else None,
+            sending_activation=sender.activation_id if sender else None,
+            timeout=timeout,
+            call_chain=call_chain,
+            is_read_only=is_read_only,
+            is_always_interleave=is_always_interleave,
+            request_context=RequestContext.export(),
+        )
+        return self._send(msg, is_one_way, timeout)
+
+    def _send(self, msg: Message, is_one_way: bool, timeout: float | None):
+        if is_one_way:
+            self.transmit(msg)
+            return None
+        loop = asyncio.get_running_loop()
+        future: asyncio.Future = loop.create_future()
+        deadline = (time.monotonic() + timeout) if timeout else None
+        self.callbacks[msg.id] = CallbackData(msg, future, deadline)
+        self._ensure_sweeper()
+        try:
+            self.transmit(msg)
+        except BaseException:
+            self.callbacks.pop(msg.id, None)
+            raise
+        return future
+
+    # -- response path (ReceiveResponse:569-627) --------------------------
+    def receive_response(self, msg: Message) -> None:
+        cb = self.callbacks.pop(msg.id, None)
+        if cb is None:
+            log.debug("dropping late/unknown response %s", msg.id)
+            return
+        if cb.future.done():
+            return
+        if msg.response_kind == ResponseKind.SUCCESS:
+            cb.future.set_result(msg.body)
+        elif msg.response_kind == ResponseKind.ERROR:
+            exc = msg.body if isinstance(msg.body, BaseException) else \
+                RejectionError(str(msg.body))
+            cb.future.set_exception(exc)
+        else:  # rejection — transparently resend transient rejections
+            if (msg.rejection_type is not None
+                    and cb.message.resend_count < MAX_RESEND_COUNT
+                    and msg.rejection_type.name in ("TRANSIENT", "CACHE_INVALIDATION")):
+                cb.message.resend_count += 1
+                cb.message.target_silo = None  # re-address from scratch
+                cb.message.target_activation = None
+                self.callbacks[msg.id] = cb
+                self.transmit(cb.message)
+                return
+            cb.future.set_exception(RejectionError(msg.rejection_info or "rejected"))
+
+    def break_outstanding_to_dead_silo(self, silo: SiloAddress) -> None:
+        """``BreakOutstandingMessagesToDeadSilo:726``."""
+        for mid, cb in list(self.callbacks.items()):
+            if cb.message.target_silo is not None and \
+                    cb.message.target_silo.same_endpoint(silo):
+                self.callbacks.pop(mid, None)
+                if not cb.future.done():
+                    cb.future.set_exception(SiloUnavailableError(
+                        f"silo {silo} declared dead with request in flight"))
+                    # suppress "exception never retrieved" if nobody awaits
+                    cb.future.exception()
+
+    # -- timeout sweep (CallbackData timer analog) -------------------------
+    def _ensure_sweeper(self) -> None:
+        if self._timeout_sweeper is None or self._timeout_sweeper.done():
+            self._timeout_sweeper = asyncio.get_running_loop().create_task(
+                self._sweep_timeouts())
+
+    async def _sweep_timeouts(self) -> None:
+        while self.callbacks:
+            await asyncio.sleep(0.05)
+            now = time.monotonic()
+            for mid, cb in list(self.callbacks.items()):
+                if cb.deadline is not None and now > cb.deadline:
+                    self.callbacks.pop(mid, None)
+                    if not cb.future.done():
+                        cb.future.set_exception(GrainCallTimeoutError(
+                            f"{cb.message.interface_name}.{cb.message.method_name} "
+                            f"to {cb.message.target_grain} timed out"))
+        self._timeout_sweeper = None
+
+    def close(self) -> None:
+        for cb in self.callbacks.values():
+            if not cb.future.done():
+                cb.future.set_exception(SiloUnavailableError("client closed"))
+                cb.future.exception()  # mark retrieved; close is best-effort
+        self.callbacks.clear()
+        if self._timeout_sweeper is not None:
+            self._timeout_sweeper.cancel()
+            self._timeout_sweeper = None

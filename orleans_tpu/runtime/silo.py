@@ -1,0 +1,337 @@
+"""Silo: composition root, lifecycle, message center, hosting builder.
+
+Re-design of /root/reference/src/Orleans.Runtime/Silo/Silo.cs:39 (ctor wiring
+:124-260, StartAsync:267, staged start :377-564, stop :663-802), the hosting
+builder (Hosting/Generic/SiloHostBuilder.cs:13, DefaultSiloServices.cs:99-195),
+and the silo transport (Runtime/Messaging/MessageCenter.cs:12,
+IncomingMessageAgent.cs:43, InboundMessageQueue.cs — three QoS queues with
+dedicated draining).
+
+The in-proc fabric (orleans_tpu.runtime.cluster.InProcFabric) replaces
+sockets for single-host clusters and tests; the TPU data plane for vectorized
+grains rides device collectives (orleans_tpu.parallel.transport) instead of
+either.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Any, Callable
+
+from ..core.ids import ActivationAddress, GrainId, SiloAddress
+from ..core.message import Category, Direction, Message
+from ..observability.stats import StatsRegistry
+from ..storage.core import StorageManager
+from .catalog import Catalog
+from .context import current_activation
+from .dispatcher import Dispatcher
+from .references import GrainFactory
+from .runtime_client import RuntimeClient
+
+if TYPE_CHECKING:
+    from .cluster import InProcFabric
+
+log = logging.getLogger("orleans.silo")
+
+__all__ = ["SiloConfig", "Silo", "SiloBuilder", "ServiceLifecycleStage"]
+
+
+class ServiceLifecycleStage:
+    """Ordered stages (Core/Lifecycle/ServiceLifecycleStage.cs)."""
+
+    RUNTIME_INITIALIZE = 2000
+    RUNTIME_SERVICES = 4000
+    RUNTIME_GRAIN_SERVICES = 6000
+    APPLICATION_SERVICES = 8000
+    ACTIVE = 10000
+
+
+@dataclass
+class SiloConfig:
+    """Typed options (the Options-classes analog: SchedulingOptions,
+    GrainCollectionOptions, SiloMessagingOptions defaults)."""
+
+    name: str = "silo"
+    response_timeout: float = 30.0
+    collection_age: float = 2 * 3600.0
+    collection_quantum: float = 60.0
+    max_enqueued_requests: int = 5000
+    deactivation_timeout: float = 5.0
+    detect_deadlocks: bool = False
+    membership_probe_period: float = 1.0
+    membership_missed_probes_limit: int = 3
+    membership_votes_needed: int = 2
+    directory_cache_size: int = 100_000
+
+
+class GrainRegistry:
+    """interface-name → grain class map + construction
+    (GrainTypeManager/GrainTypeManager.cs:19 + DefaultGrainActivator)."""
+
+    def __init__(self) -> None:
+        self._classes: dict[str, type] = {}
+        self._factories: dict[type, Callable[[], Any]] = {}
+
+    def register(self, *grain_classes: type,
+                 factory: Callable[[], Any] | None = None) -> None:
+        for cls in grain_classes:
+            self._classes[cls.__name__] = cls
+            if factory is not None:
+                self._factories[cls] = factory
+
+    def resolve(self, interface_name: str) -> type | None:
+        return self._classes.get(interface_name)
+
+    def construct(self, cls: type) -> Any:
+        f = self._factories.get(cls)
+        return f() if f else cls()
+
+    def all_classes(self) -> list[type]:
+        return list(self._classes.values())
+
+
+class MessageCenter:
+    """Silo transport endpoint: three category-partitioned inbound queues with
+    dedicated pump tasks (InboundMessageQueue + IncomingMessageAgent), and the
+    outbound hand-off to the fabric (OutboundMessageQueue)."""
+
+    def __init__(self, silo: "Silo"):
+        self.silo = silo
+        self.inbound: dict[Category, asyncio.Queue[Message]] = {}
+        self._pumps: list[asyncio.Task] = []
+        self.running = False
+
+    def start(self) -> None:
+        self.running = True
+        loop = asyncio.get_running_loop()
+        for cat in Category:
+            self.inbound[cat] = asyncio.Queue()
+            self._pumps.append(loop.create_task(self._pump(cat)))
+
+    def stop(self) -> None:
+        self.running = False
+        for t in self._pumps:
+            t.cancel()
+        self._pumps.clear()
+
+    def deliver(self, msg: Message) -> None:
+        """Called by the fabric when a message arrives for this silo."""
+        if not self.running:
+            return
+        self.inbound[msg.category].put_nowait(msg)
+
+    async def _pump(self, cat: Category) -> None:
+        q = self.inbound[cat]
+        while True:
+            msg = await q.get()
+            try:
+                self._route(msg)
+            except Exception:  # noqa: BLE001
+                log.exception("inbound routing failed for %s", msg.method_name)
+
+    def _route(self, msg: Message) -> None:
+        self.silo.stats.increment(f"messaging.received.{msg.category.name.lower()}")
+        if msg.direction != Direction.RESPONSE and (
+                msg.target_silo is None
+                or msg.target_silo != self.silo.silo_address):
+            # Gateway ingress / misrouted: address on this silo's authority
+            # (Gateway.cs:17 + Dispatcher.AddressMessage)
+            msg.target_silo = None
+            self.silo.dispatcher.send_message(msg)
+        else:
+            self.silo.dispatcher.receive_message(msg)
+
+    def send_message(self, msg: Message) -> None:
+        """Outbound to another silo/client via the fabric
+        (MessageCenter.SendMessage:177-191)."""
+        self.silo.stats.increment("messaging.sent")
+        if msg.target_silo is not None and \
+                self.silo.fabric.is_dead(msg.target_silo):
+            # drop to dead silo (MessageCenter SiloDeadOracle, Silo.cs:347)
+            if msg.direction == Direction.REQUEST:
+                self.silo.runtime_client.break_outstanding_to_dead_silo(
+                    msg.target_silo)
+            return
+        self.silo.fabric.deliver(msg)
+
+
+class InsideRuntimeClient(RuntimeClient):
+    """Silo-interior RPC engine (InsideRuntimeClient.cs:28)."""
+
+    def __init__(self, silo: "Silo"):
+        super().__init__(response_timeout=silo.config.response_timeout)
+        self.silo = silo
+
+    @property
+    def silo_address(self) -> SiloAddress:
+        return self.silo.silo_address
+
+    def transmit(self, msg: Message) -> None:
+        self.silo.dispatcher.send_message(msg)
+
+
+class SingleSiloLocator:
+    """Grain locator for a one-silo deployment: everything is local. The
+    distributed implementation (ring + partitioned directory + placement
+    directors) lives in orleans_tpu.directory.locator.DistributedLocator and
+    replaces this when the silo joins a fabric with membership."""
+
+    def __init__(self, silo: "Silo"):
+        self.silo = silo
+
+    async def locate(self, msg: Message, grain_class: type | None) -> SiloAddress:
+        return self.silo.silo_address
+
+    def should_host(self, grain_id: GrainId, grain_class: type,
+                    msg: Message) -> bool:
+        return True
+
+    async def register(self, address: ActivationAddress) -> ActivationAddress | None:
+        return None
+
+    async def unregister(self, address: ActivationAddress) -> None:
+        return None
+
+    def invalidate_cache(self, grain_id: GrainId) -> None:
+        return None
+
+
+_silo_port = itertools.count(11111)
+
+
+class Silo:
+    """One silo: the unit of hosting, addressing, and failure."""
+
+    def __init__(self, config: SiloConfig, fabric: "InProcFabric",
+                 registry: GrainRegistry, storage: StorageManager):
+        self.config = config
+        self.fabric = fabric
+        self.registry = registry
+        self.storage_manager = storage
+        self.silo_address = fabric.allocate_address(config.name)
+        self.stats = StatsRegistry()
+
+        # ctor wiring order mirrors Silo.cs:124-260
+        self.runtime_client = InsideRuntimeClient(self)
+        self.message_center = MessageCenter(self)
+        self.dispatcher = Dispatcher(self)
+        self.catalog = Catalog(self)
+        self.grain_factory = GrainFactory(self.runtime_client)
+        self.locator: Any = SingleSiloLocator(self)
+        self.membership: Any = None       # installed by cluster join (task: L6)
+        self.reminders: Any = None        # installed by reminder service (L11)
+        self.stream_providers: dict[str, Any] = {}
+        self.status = "Created"
+        self._lifecycle: list[tuple[int, Callable, Callable]] = []
+
+    # `runtime` facade seen by activations
+    @property
+    def runtime(self) -> "Silo":
+        return self
+
+    def get_stream_provider(self, name: str):
+        try:
+            return self.stream_providers[name]
+        except KeyError:
+            raise KeyError(f"no stream provider named {name!r}") from None
+
+    def subscribe_lifecycle(self, stage: int, start, stop=None) -> None:
+        """ISiloLifecycle.Subscribe (Silo.cs:864-869)."""
+        self._lifecycle.append((stage, start, stop or (lambda: None)))
+
+    # ------------------------------------------------------------------
+    async def start(self) -> None:
+        """Staged startup (Silo.StartAsync:267; stages :377-564)."""
+        self.status = "Joining"
+        self.message_center.start()          # RuntimeServices
+        self.catalog.start()
+        self.fabric.register_silo(self)
+        for stage, start, _ in sorted(self._lifecycle, key=lambda x: x[0]):
+            r = start()
+            if asyncio.iscoroutine(r):
+                await r
+        if self.membership is not None:
+            await self.membership.become_active()
+        self.status = "Running"
+        log.info("silo %s running", self.silo_address)
+
+    async def stop(self, graceful: bool = True) -> None:
+        """Stop path (Silo.cs:663-802). ``graceful=False`` ≈ kill: no
+        deactivations, no membership goodbye — used by liveness tests."""
+        if self.status == "Stopped":
+            return
+        self.status = "ShuttingDown" if graceful else "Dead"
+        if graceful:
+            if self.membership is not None:
+                await self.membership.shutdown()
+            await self.catalog.stop()
+            for stage, _, stop in sorted(self._lifecycle, key=lambda x: x[0],
+                                         reverse=True):
+                r = stop()
+                if asyncio.iscoroutine(r):
+                    await r
+        self.message_center.stop()
+        self.runtime_client.close()
+        self.fabric.unregister_silo(self, dead=not graceful)
+        self.status = "Stopped"
+
+    # helper used by Catalog to run lifecycle hooks in activation context
+    async def dispatcher_scoped(self, activation, coro_fn) -> None:
+        token = current_activation.set(activation)
+        try:
+            await coro_fn()
+        finally:
+            current_activation.reset(token)
+
+    def __repr__(self) -> str:
+        return f"<Silo {self.silo_address} {self.status}>"
+
+
+class SiloBuilder:
+    """Fluent hosting builder (SiloHostBuilder.cs:13)."""
+
+    def __init__(self) -> None:
+        self.config = SiloConfig()
+        self.registry = GrainRegistry()
+        self.storage = StorageManager()
+        self._fabric: "InProcFabric | None" = None
+        self._configurators: list[Callable[[Silo], None]] = []
+
+    def with_name(self, name: str) -> "SiloBuilder":
+        self.config.name = name
+        return self
+
+    def with_config(self, **kw) -> "SiloBuilder":
+        for k, v in kw.items():
+            if not hasattr(self.config, k):
+                raise AttributeError(f"unknown silo option {k!r}")
+            setattr(self.config, k, v)
+        return self
+
+    def add_grains(self, *grain_classes: type) -> "SiloBuilder":
+        self.registry.register(*grain_classes)
+        return self
+
+    def with_storage(self, name: str, provider) -> "SiloBuilder":
+        self.storage.add(name, provider)
+        return self
+
+    def with_fabric(self, fabric: "InProcFabric") -> "SiloBuilder":
+        self._fabric = fabric
+        return self
+
+    def configure(self, fn: Callable[[Silo], None]) -> "SiloBuilder":
+        """Escape hatch mirroring ConfigureServices: run fn(silo) pre-start."""
+        self._configurators.append(fn)
+        return self
+
+    def build(self) -> Silo:
+        from .cluster import InProcFabric
+        fabric = self._fabric or InProcFabric()
+        silo = Silo(self.config, fabric, self.registry, self.storage)
+        for fn in self._configurators:
+            fn(silo)
+        return silo

@@ -1,0 +1,11 @@
+"""Grain persistence providers (reference L11 persistence)."""
+
+from .core import (  # noqa: F401
+    ErrorInjectionStorage,
+    FileStorage,
+    GrainStorage,
+    LatencyStorage,
+    MemoryStorage,
+    StateStorageBridge,
+    StorageManager,
+)

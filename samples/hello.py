@@ -1,0 +1,37 @@
+"""HelloWorld sample — parity with /root/reference/Samples/HelloWorld/
+(minimal grain + silo + client): one silo, one HelloGrain, one client call.
+
+Run: python samples/hello.py
+"""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from orleans_tpu.runtime import ClusterClient, Grain, SiloBuilder
+
+
+class HelloGrain(Grain):
+    """IHello grain (Samples/HelloWorld/HelloWorld.Grains/HelloGrain.cs)."""
+
+    async def say_hello(self, greeting: str) -> str:
+        return f"You said: '{greeting}', I say: Hello!"
+
+
+async def main() -> None:
+    silo = SiloBuilder().with_name("hello-silo").add_grains(HelloGrain).build()
+    await silo.start()
+
+    client = await ClusterClient(silo.fabric).connect()
+    friend = client.get_grain(HelloGrain, 0)
+    response = await friend.say_hello("Good morning, my friend!")
+    print(response)
+
+    await client.close_async()
+    await silo.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

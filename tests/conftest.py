@@ -1,6 +1,11 @@
-"""Test configuration: force CPU jax with an 8-device virtual mesh so
-multi-"silo" sharding tests run anywhere (the driver validates the real
-multi-chip path separately via __graft_entry__.dryrun_multichip)."""
+"""Test configuration.
+
+* Forces CPU jax with an 8-device virtual mesh so multi-"silo" sharding tests
+  run anywhere (the driver validates the real multi-chip path separately via
+  __graft_entry__.dryrun_multichip).
+* Minimal async-test support: any ``async def test_*`` runs under
+  ``asyncio.run`` (no pytest-asyncio in the image).
+"""
 
 import os
 
@@ -11,9 +16,18 @@ if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
+import asyncio  # noqa: E402
+import inspect  # noqa: E402
+
 import pytest  # noqa: E402
 
 
-@pytest.fixture
-def anyio_backend():
-    return "asyncio"
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {name: pyfuncitem.funcargs[name]
+                  for name in pyfuncitem._fixtureinfo.argnames}
+        asyncio.run(fn(**kwargs))
+        return True
+    return None

@@ -138,3 +138,18 @@ def test_restricted_unpickler_blocks_unknown_modules():
     import uuid as _uuid
     u = _uuid.uuid5(_uuid.NAMESPACE_DNS, "x")
     assert deserialize(serialize(u)) == u
+
+
+def test_restricted_unpickler_blocks_builtins_eval():
+    evil = b"cbuiltins\neval\n(S'1+1'\ntR."
+    import pickle as _p
+    with pytest.raises(_p.UnpicklingError):
+        deserialize(evil)
+    # safe builtins still work (exceptions cross the wire in error responses)
+    assert isinstance(deserialize(serialize(ValueError("x"))), ValueError)
+
+
+def test_stack_overflow_guard():
+    sch = ArraySchema.of(x=(np.float32, ()))
+    with pytest.raises(ValueError, match="exceeds pad_to"):
+        sch.stack([{"x": 0.0}] * 10, pad_to=8)
