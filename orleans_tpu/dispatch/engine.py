@@ -1,0 +1,603 @@
+"""The tick engine: coalesce VectorGrain invocations into batched kernels.
+
+This replaces the reference's hot path — IncomingMessageAgent → Dispatcher →
+scheduler turn → invoke (SURVEY.md §3.3) — with a vectorized dispatch tick
+(§7): every event-loop iteration, all pending invocations per (class, method)
+are packed into fixed-bucket batches and executed as ONE pjit'ed kernel over
+the sharded actor table:
+
+    gather rows → fresh-init (on-device activation) → vmapped handler
+    → masked scatter (skipped for read-only methods)
+
+run under ``shard_map`` so each mesh shard touches only its slot block
+(gathers/scatters are shard-local; no cross-device traffic inside a tick —
+cross-shard *messages* are the transport layer's job).
+
+Turn-semantics guarantee: within a tick at most one message per activation;
+same-activation conflicts defer to the next tick (the mailbox ordering of
+``ActivationData.EnqueueMessage``, ActivationData.cs:566).
+
+Static-shape discipline: batch buckets are powers of two with a floor, so
+XLA compiles O(log max-batch) kernel variants per method, all reused across
+ticks (no data-dependent shapes; SURVEY.md §7 hard parts #3).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..core.ids import GrainId
+from ..parallel.mesh import SILO_AXIS, make_mesh
+from .table import ShardedActorTable
+from .vector_grain import ActorMethod, VectorGrain
+
+log = logging.getLogger("orleans.vector")
+
+__all__ = ["VectorRuntime", "VectorActorRef"]
+
+MIN_BUCKET = 8
+
+
+def _bucket(n: int) -> int:
+    return max(MIN_BUCKET, 1 << max(0, (n - 1).bit_length()))
+
+
+def _validate_args(cls: type, method: str, schema: dict, args: dict) -> None:
+    missing = set(schema) - set(args)
+    extra = set(args) - set(schema)
+    if missing or extra:
+        raise TypeError(
+            f"{cls.__name__}.{method} args mismatch: "
+            f"missing {sorted(missing)}, unexpected {sorted(extra)} "
+            f"(schema: {sorted(schema)})")
+
+
+class _DensePlan:
+    """Cached batch layout for a recurring dense key set. The constant batch
+    operands (slots/key-hashes/valid mask/zero fresh mask) are uploaded to
+    device once and reused every tick — only the message payload crosses the
+    host↔device boundary per round."""
+
+    __slots__ = ("keys", "order", "inv", "sorted_shard", "lane_sorted", "B",
+                 "slots_b", "valid_b", "khash_b", "_dev", "identity", "counts")
+
+    def __init__(self, keys, order, inv, sorted_shard, lane_sorted, B,
+                 slots_b, valid_b, khash_b, identity=False, counts=None):
+        self.keys = keys
+        self.order = order
+        self.inv = inv
+        self.sorted_shard = sorted_shard
+        self.lane_sorted = lane_sorted
+        self.B = B
+        self.slots_b = slots_b
+        self.valid_b = valid_b
+        self.khash_b = khash_b
+        self._dev = None
+        # identity plans (keys == 0..M-1 under the block-wise dense mapping)
+        # repack by contiguous slice copies instead of fancy indexing — the
+        # zero-shuffle bulk path
+        self.identity = identity
+        self.counts = counts
+
+    def pack(self, x: np.ndarray, dtype, shape) -> np.ndarray:
+        """[M, ...] caller-order payload → [n_shards, B, ...] batch buffer."""
+        n = self.valid_b.shape[0]
+        buf = np.zeros((n, self.B, *shape), dtype=dtype)
+        if self.identity:
+            off = 0
+            for s in range(n):
+                c = self.counts[s]
+                buf[s, :c] = x[off:off + c]
+                off += c
+        else:
+            buf[self.sorted_shard, self.lane_sorted] = \
+                np.asarray(x, dtype=dtype)[self.order]
+        return buf
+
+    def device_operands(self, put):
+        if self._dev is None:
+            self._dev = (
+                put(jnp.asarray(self.slots_b)),
+                put(jnp.asarray(self.khash_b)),
+                put(jnp.asarray(self.valid_b)),
+                put(jnp.zeros(self.valid_b.shape, jnp.bool_)),
+            )
+        return self._dev
+
+    def unpack(self, results):
+        """[n_shards, B, ...] device results → [M, ...] host rows in the
+        caller's original key order (synchronizes)."""
+        def one(a):
+            a = np.asarray(a)
+            if self.identity:
+                return np.concatenate(
+                    [a[s, :c] for s, c in enumerate(self.counts)])
+            return a[self.sorted_shard, self.lane_sorted][self.inv]
+        return jax.tree_util.tree_map(one, results)
+
+
+class _Pending:
+    """One queued invocation in the hashed (per-key) path."""
+
+    __slots__ = ("key_hash", "shard", "slot", "fresh", "args", "future")
+
+    def __init__(self, key_hash, shard, slot, fresh, args, future):
+        self.key_hash = key_hash
+        self.shard = shard
+        self.slot = slot
+        self.fresh = fresh
+        self.args = args
+        self.future = future
+
+
+class VectorActorRef:
+    """Typed handle to one device-tier activation (GrainReference analog)."""
+
+    __slots__ = ("runtime", "grain_class", "key", "key_hash")
+
+    def __init__(self, runtime: "VectorRuntime", grain_class: type, key: int,
+                 key_hash: int):
+        self.runtime = runtime
+        self.grain_class = grain_class
+        self.key = key
+        self.key_hash = key_hash
+
+    def __getattr__(self, name: str):
+        self.runtime.method_of(self.grain_class, name)  # raise if unknown
+        return partial(self.runtime.call, self.grain_class, self.key_hash, name)
+
+    def __repr__(self) -> str:
+        return f"VectorActorRef({self.grain_class.__name__}, {self.key!r})"
+
+
+class VectorRuntime:
+    """Per-silo device-tier runtime: tables + tick loop + kernel cache."""
+
+    def __init__(self, mesh=None, capacity_per_shard: int = 1024):
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.capacity_per_shard = capacity_per_shard
+        self.tables: dict[type, ShardedActorTable] = {}
+        # pending per (class, method): list[_Pending]
+        self.pending: dict[tuple[type, str], list[_Pending]] = {}
+        # slots already claimed by the current tick per class → conflict defer
+        self._tick_scheduled = False
+        self._kernel_cache: dict[tuple, Any] = {}
+        self._flush_waiters: list[asyncio.Future] = []
+        self.ticks = 0
+        self.messages_processed = 0
+
+    # ------------------------------------------------------------------
+    def register(self, *grain_classes: type[VectorGrain],
+                 capacity_per_shard: int | None = None) -> None:
+        for cls in grain_classes:
+            if cls not in self.tables:
+                self.tables[cls] = ShardedActorTable(
+                    cls, self.mesh,
+                    capacity_per_shard or self.capacity_per_shard)
+
+    def table(self, cls: type) -> ShardedActorTable:
+        if cls not in self.tables:
+            self.register(cls)
+        return self.tables[cls]
+
+    def method_of(self, cls: type, name: str) -> ActorMethod:
+        m = self.table(cls).methods.get(name)
+        if m is None:
+            raise AttributeError(
+                f"{cls.__name__} has no @actor_method {name!r}")
+        return m
+
+    def actor(self, grain_class: type, key: int | str) -> VectorActorRef:
+        """Reference to one device-tier activation. Small non-negative int
+        keys map directly (enabling the dense regime); other keys hash."""
+        if isinstance(key, int) and 0 <= key < 2**62:
+            kh = key
+        else:
+            from ..core.ids import GrainType
+            kh = GrainId.for_grain(
+                GrainType.of(grain_class.__name__), key).uniform_hash
+        return VectorActorRef(self, grain_class, key, kh)
+
+    # ------------------------------------------------------------------
+    # Per-key path (general; conflict-safe)
+    # ------------------------------------------------------------------
+    def call(self, grain_class: type, key_hash: int, method: str,
+             **args) -> asyncio.Future:
+        """Queue one invocation; resolves after the tick that runs it."""
+        m = self.method_of(grain_class, method)
+        if m.args_schema is not None:
+            _validate_args(grain_class, method, m.args_schema, args)
+        tbl = self.table(grain_class)
+        if 0 <= key_hash < tbl.dense_n:
+            shard = key_hash // tbl.dense_per_shard
+            slot = key_hash % tbl.dense_per_shard
+            # first touch of a dense-provisioned key still needs its
+            # on-device initial_state (the OnActivate analog)
+            fresh = not bool(tbl.dense_active[key_hash])
+            tbl.dense_active[key_hash] = True
+        else:
+            shard, slot, fresh = tbl.lookup_or_allocate(key_hash)
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self.pending.setdefault((grain_class, method), []).append(
+            _Pending(key_hash, shard, slot, fresh, args, fut))
+        self._schedule_tick(loop)
+        return fut
+
+    def _schedule_tick(self, loop) -> None:
+        if not self._tick_scheduled:
+            self._tick_scheduled = True
+            loop.call_soon(self._tick)
+
+    async def flush(self) -> None:
+        """Run ticks until all pending work (incl. conflict-deferred) drains."""
+        while self.pending:
+            self._tick()
+            await asyncio.sleep(0)
+
+    # ------------------------------------------------------------------
+    def _tick(self) -> None:
+        self._tick_scheduled = False
+        if not self.pending:
+            return
+        work, self.pending = self.pending, {}
+        for (cls, method), items in work.items():
+            try:
+                self._run_batch(cls, method, items)
+            except Exception as e:  # noqa: BLE001 — fail the futures, not the loop
+                log.exception("vector tick failed for %s.%s",
+                              cls.__name__, method)
+                for p in items:
+                    if not p.future.done():
+                        p.future.set_exception(e)
+        self.ticks += 1
+        if self.pending:  # conflict-deferred work → next tick
+            self._schedule_tick(asyncio.get_running_loop())
+
+    def _run_batch(self, cls: type, method: str, items: list[_Pending]) -> None:
+        tbl = self.tables[cls]
+        m = tbl.methods[method]
+        # schema inference is committed only after a successful batch so a
+        # bad first call cannot poison the class-level schema
+        schema = m.args_schema
+        inferred = schema is None
+        if inferred:
+            schema = {k: (np.asarray(v).dtype, np.asarray(v).shape)
+                      for k, v in items[0].args.items()}
+        # one message per slot per tick: conflicts defer (turn semantics)
+        claimed: set[tuple[int, int]] = set()
+        ready: list[_Pending] = []
+        for p in items:
+            loc = (p.shard, p.slot)
+            if loc in claimed:
+                self.pending.setdefault((cls, method), []).append(p)
+                continue
+            claimed.add(loc)
+            ready.append(p)
+        if not ready:
+            return
+        n, cap = tbl.n_shards, tbl.capacity
+        per_shard: list[list[_Pending]] = [[] for _ in range(n)]
+        for p in ready:
+            per_shard[p.shard].append(p)
+        B = _bucket(max(len(ps) for ps in per_shard))
+        slots = np.full((n, B), tbl.sink_slot, dtype=np.int32)
+        # key hashes ride to the device as 31-bit ints (x64 is disabled;
+        # initial_state only needs a per-actor seed, not the full hash)
+        khash = np.zeros((n, B), dtype=np.int32)
+        fresh = np.zeros((n, B), dtype=bool)
+        valid = np.zeros((n, B), dtype=bool)
+        args_stacked: dict[str, np.ndarray] = {}
+        for fname, (dtype, shape) in schema.items():
+            args_stacked[fname] = np.zeros((n, B, *shape), dtype=dtype)
+        for s, ps in enumerate(per_shard):
+            for i, p in enumerate(ps):
+                slots[s, i] = p.slot
+                khash[s, i] = p.key_hash & 0x7FFFFFFF
+                fresh[s, i] = p.fresh
+                valid[s, i] = True
+                for fname in schema:
+                    args_stacked[fname][s, i] = p.args[fname]
+        if inferred:
+            m.args_schema = schema  # needed by the kernel builder
+        try:
+            new_state, results = self._kernel(cls, method, B)(
+                tbl.state, jnp.asarray(slots), jnp.asarray(khash),
+                jnp.asarray(fresh), jnp.asarray(valid),
+                {k: jnp.asarray(v) for k, v in args_stacked.items()})
+        except BaseException:
+            if inferred:
+                m.args_schema = None  # do not poison the class schema
+            raise
+        if not m.read_only:
+            tbl.state = new_state
+        # resolve futures from the result batch
+        host = jax.tree_util.tree_map(np.asarray, results)
+        for s, ps in enumerate(per_shard):
+            for i, p in enumerate(ps):
+                if not p.future.done():
+                    p.future.set_result(jax.tree_util.tree_map(
+                        lambda a: a[s, i], host))
+        self.messages_processed += len(ready)
+
+    # ------------------------------------------------------------------
+    # Bulk path (dense keys; the ≥1M msgs/sec route)
+    # ------------------------------------------------------------------
+    def make_dense_plan(self, grain_class: type, keys: np.ndarray) -> "_DensePlan":
+        """Precompute the key→(shard, lane) batch layout for a recurring bulk
+        key set (amortizes the argsort across ticks — e.g. every Presence
+        heartbeat round touches the same 1M players)."""
+        tbl = self.table(grain_class)
+        keys = np.asarray(keys)
+        M = keys.shape[0]
+        n = tbl.n_shards
+        if keys.shape[0] and np.unique(keys).shape[0] != keys.shape[0]:
+            # duplicate keys in one bulk tick would scatter twice into one
+            # row (nondeterministic write order — a silent turn-semantics
+            # violation); the per-key path serializes them across ticks
+            raise ValueError(
+                "call_batch keys must be unique within a tick; route "
+                "duplicate-key traffic through VectorRuntime.call")
+        shard, slot = tbl.dense_shard_slot(keys)
+        order = np.argsort(shard, kind="stable")
+        inv = np.empty_like(order)
+        inv[order] = np.arange(M)
+        counts = np.bincount(shard, minlength=n)
+        B = _bucket(int(counts.max()) if M else MIN_BUCKET)
+        sorted_shard = shard[order]
+        starts = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(counts, out=starts[1:])
+        lane_sorted = np.arange(M) - starts[sorted_shard]
+        slots_b = np.full((n, B), tbl.sink_slot, dtype=np.int32)
+        valid_b = np.zeros((n, B), dtype=bool)
+        khash_b = np.zeros((n, B), dtype=np.int32)
+        slots_b[sorted_shard, lane_sorted] = slot[order]
+        valid_b[sorted_shard, lane_sorted] = True
+        khash_b[sorted_shard, lane_sorted] = keys[order] & 0x7FFFFFFF
+        identity = bool(M) and keys[0] == 0 and keys[-1] == M - 1 and \
+            np.array_equal(keys, np.arange(M))
+        return _DensePlan(keys, order, inv, sorted_shard, lane_sorted, B,
+                          slots_b, valid_b, khash_b,
+                          identity=identity, counts=counts)
+
+    def call_batch(self, grain_class: type, method: str,
+                   keys: np.ndarray, args: dict[str, np.ndarray],
+                   fresh: np.ndarray | None = None,
+                   plan: "_DensePlan | None" = None,
+                   device_results: bool = False):
+        """Invoke ``method`` on many dense-keyed activations in one tick.
+
+        ``keys``: int array [M] of dense keys (must be ensure_dense'd and
+        unique within the call). ``args``: dict of [M, ...] arrays. Returns
+        the stacked result pytree with leading axis [M]. Runs synchronously
+        (one kernel launch) — the caller IS the tick. Pass a reusable
+        ``plan`` from :meth:`make_dense_plan` for recurring key sets.
+        """
+        tbl = self.table(grain_class)
+        m = self.method_of(grain_class, method)
+        if m.args_schema is None:
+            m.args_schema = {
+                k: (np.asarray(v).dtype, np.asarray(v).shape[1:])
+                for k, v in args.items()}
+        _validate_args(grain_class, method, m.args_schema, args)
+        if plan is None:
+            plan = self.make_dense_plan(grain_class, keys)
+        M = plan.keys.shape[0]
+        d_slots, d_khash, d_valid, d_fresh0 = plan.device_operands(tbl._put)
+        if fresh is None:
+            # auto-activate: keys never touched get initial_state this tick
+            fresh = tbl.dense_fresh_mask(plan.keys)
+        if fresh is not None:
+            d_fresh = tbl._put(
+                jnp.asarray(plan.pack(np.asarray(fresh), bool, ())))
+            tbl.mark_dense_active(plan.keys)
+        else:
+            d_fresh = d_fresh0
+        args_b = {}
+        for fname, (dtype, shape) in m.args_schema.items():
+            args_b[fname] = tbl._put(
+                jnp.asarray(plan.pack(np.asarray(args[fname]), dtype, shape)))
+        new_state, results = self._kernel(grain_class, method, plan.B)(
+            tbl.state, d_slots, d_khash, d_fresh, d_valid, args_b)
+        if not m.read_only:
+            tbl.state = new_state
+        self.ticks += 1
+        self.messages_processed += M
+        if device_results:
+            # async path: raw [n, B, ...] device results, no host sync —
+            # use plan.unpack(...) to materialize caller-order rows later
+            return results
+        return plan.unpack(results)
+
+    def call_batch_rounds(self, grain_class: type, method: str,
+                          keys: np.ndarray,
+                          args_rounds: dict[str, np.ndarray],
+                          plan: "_DensePlan | None" = None,
+                          device_results: bool = False):
+        """Sustained-streaming dispatch: K message rounds to the same dense
+        key set in ONE kernel call (``lax.scan`` over ticks on device).
+
+        ``args_rounds``: dict of [K, M, ...] arrays — K sequential rounds.
+        Turn semantics hold: round k+1 sees the state written by round k
+        (ticks are sequential inside the scan). One payload upload + one
+        dispatch per K·M messages — the streaming-gateway hot path (the
+        PersistentStreamPullingAgent pump re-expressed as a scanned kernel,
+        PersistentStreamPullingAgent.cs:141,350-368).
+        """
+        tbl = self.table(grain_class)
+        m = self.method_of(grain_class, method)
+        if not args_rounds:
+            raise TypeError(
+                "call_batch_rounds requires at least one [K, M, ...] args "
+                "array to define K; use call_batch for single no-arg ticks")
+        if m.args_schema is None:
+            m.args_schema = {
+                k: (np.asarray(v).dtype, np.asarray(v).shape[2:])
+                for k, v in args_rounds.items()}
+        _validate_args(grain_class, method, m.args_schema, args_rounds)
+        if plan is None:
+            plan = self.make_dense_plan(grain_class, keys)
+        K = next(iter(args_rounds.values())).shape[0]
+        M = plan.keys.shape[0]
+        fresh0 = tbl.dense_fresh_mask(plan.keys)
+        d_slots, d_khash, d_valid, d_zeros = plan.device_operands(tbl._put)
+        if fresh0 is not None:
+            d_fresh = tbl._put(
+                jnp.asarray(plan.pack(np.asarray(fresh0), bool, ())))
+            tbl.mark_dense_active(plan.keys)
+        else:
+            d_fresh = d_zeros
+        args_b = {}
+        for fname, (dtype, shape) in m.args_schema.items():
+            a = np.asarray(args_rounds[fname])
+            packed = np.stack([plan.pack(a[k], dtype, shape)
+                               for k in range(K)])
+            args_b[fname] = tbl._put_rounds(jnp.asarray(packed))
+        kern = self._scan_kernel(grain_class, method, plan.B, K)
+        new_state, results = kern(
+            tbl.state, d_slots, d_khash, d_fresh, d_valid, args_b)
+        if not m.read_only:
+            tbl.state = new_state
+        self.ticks += K
+        self.messages_processed += K * M
+        if device_results:
+            return results  # [K, n, B, ...]
+        return jax.tree_util.tree_map(
+            lambda a: np.stack([plan.unpack(a[k]) for k in range(K)]),
+            results)
+
+    def _scan_kernel(self, cls: type, method: str, B: int, K: int):
+        tbl = self.tables[cls]
+        key = ("scan", cls, method, B, K, tbl.capacity, tbl.n_shards)
+        k = self._kernel_cache.get(key)
+        if k is None:
+            k = self._build_kernel(cls, method, scan_rounds=K)
+            self._kernel_cache[key] = k
+        return k
+
+    def call_batch_device(self, grain_class: type, method: str,
+                          slots_b, khash_b, fresh_b, valid_b, args_b):
+        """Zero-copy tick for callers that already hold device-layout
+        [n_shards, B] batches (the transport layer / benchmarks). Returns
+        the raw [n_shards, B, ...] result pytree without host transfer."""
+        tbl = self.table(grain_class)
+        m = self.method_of(grain_class, method)
+        B = slots_b.shape[1]
+        new_state, results = self._kernel(grain_class, method, B)(
+            tbl.state, slots_b, khash_b, fresh_b, valid_b, args_b)
+        if not m.read_only:
+            tbl.state = new_state
+        self.ticks += 1
+        self.messages_processed += int(valid_b.shape[0] * B)
+        return results
+
+    # ------------------------------------------------------------------
+    # Kernel construction
+    # ------------------------------------------------------------------
+    def _kernel(self, cls: type, method: str, B: int):
+        tbl = self.tables[cls]
+        key = (cls, method, B, tbl.capacity, tbl.n_shards)
+        k = self._kernel_cache.get(key)
+        if k is None:
+            k = self._build_kernel(cls, method)
+            self._kernel_cache[key] = k
+        return k
+
+    def _build_kernel(self, cls: type, method: str, scan_rounds: int = 0):
+        tbl = self.tables[cls]
+        m = tbl.methods[method]
+        handler = m.fn
+        init = cls.initial_state
+        mesh = tbl.mesh
+        read_only = m.read_only
+
+        def local_step(state, slots, khash, fresh, valid, args):
+            # block shapes: state [1, C+1, ...]; slots/khash/fresh/valid
+            # [1, B]; args [1, B, ...] — squeeze the shard-block axis
+            state_l = jax.tree_util.tree_map(lambda a: a[0], state)
+            slots_l, khash_l = slots[0], khash[0]
+            fresh_l, valid_l = fresh[0], valid[0]
+            args_l = jax.tree_util.tree_map(lambda a: a[0], args)
+
+            rows = jax.tree_util.tree_map(lambda f: f[slots_l], state_l)
+            init_rows = jax.vmap(init)(khash_l)
+
+            def sel(mask, a, b):
+                return jnp.where(
+                    mask.reshape(mask.shape + (1,) * (a.ndim - 1)), a, b)
+
+            rows = jax.tree_util.tree_map(
+                lambda ir, r: sel(fresh_l, ir, r), init_rows, rows)
+            new_rows, results = jax.vmap(handler)(rows, args_l)
+            if read_only:
+                out_state = state
+            else:
+                write = valid_l
+
+                def scatter(f, nr, r):
+                    return f.at[slots_l].set(sel(write, nr, r))
+
+                new_state_l = jax.tree_util.tree_map(
+                    scatter, state_l, new_rows, rows)
+                out_state = jax.tree_util.tree_map(
+                    lambda a: a[None], new_state_l)
+            return out_state, jax.tree_util.tree_map(
+                lambda a: a[None], results)
+
+        if scan_rounds:
+            import jax.lax as lax
+
+            def init_pass(state, slots, khash, fresh, valid):
+                # fresh-init BEFORE the scan: the OnActivate pre-pass, so
+                # round 0 of the scan sees initialized rows and later rounds
+                # never re-init
+                st = jax.tree_util.tree_map(lambda a: a[0], state)
+                slots_l, khash_l = slots[0], khash[0]
+                write = fresh[0] & valid[0]
+                rows = jax.tree_util.tree_map(lambda f: f[slots_l], st)
+                init_rows = jax.vmap(init)(khash_l)
+
+                def sel(mask, a, b):
+                    return jnp.where(
+                        mask.reshape(mask.shape + (1,) * (a.ndim - 1)), a, b)
+
+                new_st = jax.tree_util.tree_map(
+                    lambda f, ir, r: f.at[slots_l].set(sel(write, ir, r)),
+                    st, init_rows, rows)
+                return jax.tree_util.tree_map(lambda a: a[None], new_st)
+
+            def scanned(state, slots, khash, fresh, valid, args_rounds):
+                # args_rounds leaves: [K, n, B, ...] — scan over K ticks;
+                # tick k+1 reads the state tick k wrote (serial turns)
+                state = init_pass(state, slots, khash, fresh, valid)
+                no_fresh = jnp.zeros_like(fresh)
+
+                def one(carry, args_k):
+                    st, out = local_step(carry, slots, khash, no_fresh,
+                                         valid, args_k)
+                    return st, out
+                return lax.scan(one, state, args_rounds)
+
+            body = scanned
+        else:
+            body = local_step
+
+        if tbl.n_shards > 1:
+            spec = P(SILO_AXIS)
+            pspec = P(None, SILO_AXIS) if scan_rounds else spec
+            body = jax.shard_map(
+                body, mesh=mesh,
+                in_specs=(spec, spec, spec, spec, spec, pspec),
+                out_specs=(spec, P(None, SILO_AXIS) if scan_rounds else spec),
+                check_vma=False)
+        # else: single-shard — shard_map is semantically a no-op but pays a
+        # large dispatch penalty (committed shardings); plain jit
+        return jax.jit(body, donate_argnums=(0,) if not read_only else ())

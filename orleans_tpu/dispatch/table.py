@@ -1,0 +1,207 @@
+"""ShardedActorTable: device-resident activation state, sharded over the mesh.
+
+The fusion of the reference's ``ActivationDirectory`` (local activation map,
+ActivationDirectory.cs) and ``GrainDirectoryPartition`` (consistent-hash
+ownership, GrainDirectoryPartition.cs:207) re-expressed as device arrays
+(SURVEY.md §7): activation state for one VectorGrain class lives in a slot
+pool of shape ``[n_shards, capacity+1, *field]`` sharded over the ``silo``
+mesh axis. Slot ``capacity`` (the last row) is a write sink for padding
+lanes, so masked scatters never collide with real rows.
+
+Key → shard is ``uniform_hash % n_shards`` (the ring's CalculateTargetSilo,
+LocalGrainDirectory.cs:477, degenerated to a static mesh mapping); slot
+within the shard comes from a host-side free list (the dynamic-activation-
+table hard part: slot pool + free list, SURVEY.md §7 hard parts #2).
+
+Two key regimes:
+* **hashed** (general): host dict key→(shard, slot); per-key alloc/free.
+* **dense** (bulk workloads, e.g. 1M Presence players with keys 0..N-1):
+  ``ensure_dense(n)`` pre-provisions key i → (i % n_shards, i // n_shards)
+  so bulk batches compute slots with vectorized integer math — no per-key
+  Python. This is the 1M-msgs/sec path.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.mesh import SILO_AXIS, make_mesh, shard_spec
+from .vector_grain import VectorGrain, vector_methods
+
+__all__ = ["ShardedActorTable"]
+
+
+class ShardedActorTable:
+    def __init__(self, grain_class: type[VectorGrain], mesh=None,
+                 capacity_per_shard: int = 1024):
+        self.grain_class = grain_class
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.n_shards = self.mesh.devices.size
+        self.capacity = int(capacity_per_shard)
+        self.methods = vector_methods(grain_class)
+        # On a 1-device mesh, committed NamedSharding buffers pay a large
+        # dispatch/layout penalty through the axon tunnel for zero benefit;
+        # plain uncommitted arrays behave identically there.
+        self.sharding = shard_spec(self.mesh) if self.n_shards > 1 else None
+
+        # host bookkeeping
+        self.key_to_slot: dict[int, tuple[int, int]] = {}  # key_hash → (shard, slot)
+        self.free: list[list[int]] = [
+            list(range(self.capacity - 1, -1, -1)) for _ in range(self.n_shards)]
+        self.dense_n = 0  # keys [0, dense_n) are dense-mapped
+        self.dense_per_shard = 0
+        self.dense_active = np.zeros(0, dtype=bool)
+
+        # device state: [n_shards, capacity+1, *shape]; row `capacity` is the
+        # padding write sink
+        self.state: dict[str, jax.Array] = {}
+        for name, (dtype, shape) in grain_class.STATE.items():
+            self.state[name] = self._put(
+                jnp.zeros((self.n_shards, self.capacity + 1, *shape),
+                          dtype=dtype))
+
+    # ------------------------------------------------------------------
+    def _put(self, arr):
+        """Commit to the mesh sharding (no-op on a 1-device mesh)."""
+        return jax.device_put(arr, self.sharding) if self.sharding else arr
+
+    def _put_rounds(self, arr):
+        """Commit a [K, n_shards, ...] stacked-rounds array: sharded on the
+        shard axis (dim 1), replicated over rounds."""
+        if not self.sharding:
+            return arr
+        from jax.sharding import NamedSharding, PartitionSpec
+        return jax.device_put(
+            arr, NamedSharding(self.mesh, PartitionSpec(None, SILO_AXIS)))
+
+    @property
+    def sink_slot(self) -> int:
+        return self.capacity
+
+    def active_count(self) -> int:
+        return len(self.key_to_slot) + self.dense_n
+
+    # -- dense regime -----------------------------------------------------
+    def ensure_dense(self, n: int) -> None:
+        """Pre-provision keys 0..n-1 with the static dense mapping. Must be
+        called before any hashed allocation (the two regimes share slots
+        only if dense claims the low slot range first).
+
+        The mapping is BLOCK-wise — key → (key // per_shard, key % per_shard)
+        — so a contiguous key range is an exact reshape onto the
+        [n_shards, B] batch layout (zero-shuffle bulk dispatch)."""
+        if self.key_to_slot:
+            raise RuntimeError("dense mapping must be set up before hashed keys")
+        if self.dense_per_shard:
+            # the block mapping is frozen at first provisioning: changing
+            # per_shard would remap every existing key to another row
+            # (silent cross-actor state leak); growth within the provisioned
+            # keyspace is free, beyond it requires migration
+            if n <= self.dense_per_shard * self.n_shards:
+                if n > self.dense_n:
+                    self.dense_active = np.concatenate(
+                        [self.dense_active, np.zeros(n - self.dense_n, bool)])
+                    self.dense_n = n
+                return
+            raise RuntimeError(
+                f"dense keyspace exhausted ({n} > "
+                f"{self.dense_per_shard * self.n_shards}); provision the "
+                f"maximum population in the first ensure_dense call")
+        per_shard = -(-n // self.n_shards)  # ceil
+        if per_shard > self.capacity:
+            self.grow(per_shard)
+        self.dense_n = n
+        self.dense_per_shard = per_shard
+        # host-side activation bitmap: which dense keys have been fresh-
+        # initialized (the OnActivate bookkeeping for the dense regime)
+        self.dense_active = np.zeros(n, dtype=bool)
+        # carve dense slots out of the free lists
+        for s in range(self.n_shards):
+            self.free[s] = [i for i in self.free[s]
+                            if i >= self.dense_per_shard]
+
+    def dense_fresh_mask(self, keys: np.ndarray) -> np.ndarray | None:
+        """Bool [M] mask of dense keys not yet activated, or None when every
+        key is already active (the common steady-state — no upload needed)."""
+        if self.dense_active.size == 0:
+            return None
+        m = ~self.dense_active[keys]
+        return m if m.any() else None
+
+    def mark_dense_active(self, keys: np.ndarray) -> None:
+        if self.dense_active.size:
+            self.dense_active[keys] = True
+
+    def dense_shard_slot(self, keys: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Vectorized key→(shard, slot) for dense keys (int array)."""
+        per = max(self.dense_per_shard, 1)
+        return keys // per, keys % per
+
+    # -- hashed regime ----------------------------------------------------
+    def lookup_or_allocate(self, key_hash: int) -> tuple[int, int, bool]:
+        """Returns (shard, slot, fresh)."""
+        loc = self.key_to_slot.get(key_hash)
+        if loc is not None:
+            return loc[0], loc[1], False
+        shard = key_hash % self.n_shards
+        if not self.free[shard]:
+            self.grow(self.capacity * 2)
+        slot = self.free[shard].pop()
+        self.key_to_slot[key_hash] = (shard, slot)
+        return shard, slot, True
+
+    def lookup(self, key_hash: int) -> tuple[int, int] | None:
+        return self.key_to_slot.get(key_hash)
+
+    def release(self, key_hash: int) -> bool:
+        """Free a slot (deactivation). The row data is left in place; the
+        slot is reused by the next activation (fresh-init overwrites it)."""
+        loc = self.key_to_slot.pop(key_hash, None)
+        if loc is None:
+            return False
+        self.free[loc[0]].append(loc[1])
+        return True
+
+    # -- growth -----------------------------------------------------------
+    def grow(self, new_capacity: int) -> None:
+        """Grow every shard's slot pool (doubling amortizes recompiles —
+        kernels specialize on capacity)."""
+        new_capacity = max(new_capacity, self.capacity * 2)
+        # round to power of two to bound the number of distinct kernel shapes
+        new_capacity = 1 << (new_capacity - 1).bit_length()
+        old = self.capacity
+        for name, arr in self.state.items():
+            dtype, shape = self.grain_class.STATE[name]
+            grown = jnp.zeros(
+                (self.n_shards, new_capacity + 1, *shape), dtype=dtype)
+            # old sink row (index `old`) is junk; copy only real rows
+            grown = grown.at[:, :old].set(arr[:, :old])
+            self.state[name] = self._put(grown)
+        for s in range(self.n_shards):
+            self.free[s] = list(range(new_capacity - 1, old - 1, -1)) + self.free[s]
+        self.capacity = new_capacity
+
+    # -- host access (tests, persistence flush) ---------------------------
+    def read_row(self, key_hash: int) -> dict[str, np.ndarray] | None:
+        loc = self.key_to_slot.get(key_hash)
+        if loc is None:
+            if 0 <= key_hash < self.dense_n:
+                loc = (key_hash // self.dense_per_shard,
+                       key_hash % self.dense_per_shard)
+            else:
+                return None
+        shard, slot = loc
+        return {k: np.asarray(v[shard, slot]) for k, v in self.state.items()}
+
+    def snapshot(self) -> dict[str, np.ndarray]:
+        """Full host copy of the state arrays (checkpoint path; orbax-style
+        async checkpointing can hook here)."""
+        return {k: np.asarray(v) for k, v in self.state.items()}
+
+    def restore(self, snap: dict[str, np.ndarray]) -> None:
+        for k, arr in snap.items():
+            self.state[k] = self._put(jnp.asarray(arr))

@@ -1,0 +1,4 @@
+"""Mesh, sharding, and ICI transport helpers (the comm-backend analog of the
+reference's TCP message fabric, SURVEY.md §5)."""
+
+from .mesh import SILO_AXIS, make_mesh, replicated_spec, shard_spec  # noqa: F401

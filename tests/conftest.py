@@ -9,12 +9,17 @@
 
 import os
 
-# Must run before jax is imported anywhere.
+# Must run before jax backends initialize. The image exports
+# JAX_PLATFORMS=axon (the real TPU tunnel); tests pin CPU explicitly.
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import asyncio  # noqa: E402
 import inspect  # noqa: E402

@@ -1,0 +1,253 @@
+"""Batched device-tier dispatch tests — the PingBenchmark acceptance tier
+(reference test/Benchmarks/Ping/PingBenchmark.cs shape: many EchoGrains,
+batched no-op invokes) plus turn-semantics guarantees under batching."""
+
+import asyncio
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orleans_tpu.dispatch import VectorGrain, VectorRuntime, actor_method
+from orleans_tpu.parallel import make_mesh
+
+
+class EchoActor(VectorGrain):
+    """EchoGrain analog: state counts calls, echo returns the payload."""
+
+    STATE = {"calls": (jnp.int32, ())}
+
+    @staticmethod
+    def initial_state(key_hash):
+        return {"calls": jnp.int32(0)}
+
+    @actor_method(args={"x": (jnp.float32, ())})
+    def echo(state, args):
+        return {"calls": state["calls"] + 1}, {"x": args["x"],
+                                               "calls": state["calls"] + 1}
+
+
+class CounterActor(VectorGrain):
+    STATE = {"value": (jnp.int32, ())}
+
+    @staticmethod
+    def initial_state(key_hash):
+        return {"value": jnp.int32(0)}
+
+    @actor_method(args={"n": (jnp.int32, ())})
+    def add(state, args):
+        v = state["value"] + args["n"]
+        return {"value": v}, v
+
+    @actor_method(args={}, read_only=True)
+    def get(state, args):
+        return state, state["value"]
+
+
+class PlayerActor(VectorGrain):
+    """Presence PlayerGrain analog: position + heartbeat counter."""
+
+    STATE = {"pos": (jnp.float32, (2,)), "beats": (jnp.int32, ())}
+
+    @staticmethod
+    def initial_state(key_hash):
+        return {"pos": jnp.zeros(2, jnp.float32), "beats": jnp.int32(0)}
+
+    @actor_method(args={"pos": (jnp.float32, (2,))})
+    def heartbeat(state, args):
+        new = {"pos": args["pos"], "beats": state["beats"] + 1}
+        return new, new["beats"]
+
+
+async def test_single_call_roundtrip():
+    rt = VectorRuntime()
+    ref = rt.actor(EchoActor, 7)
+    out = await ref.echo(x=np.float32(3.5))
+    assert out["x"] == np.float32(3.5)
+    assert out["calls"] == 1
+
+
+async def test_state_persists_across_ticks():
+    rt = VectorRuntime()
+    c = rt.actor(CounterActor, 1)
+    assert await c.add(n=5) == 5
+    assert await c.add(n=3) == 8
+    assert await c.get() == 8
+
+
+async def test_batched_fanout_10k_echo_actors():
+    """10k distinct actors in one gather → one tick, not 10k turns."""
+    rt = VectorRuntime(capacity_per_shard=2048)
+    futs = [rt.call(EchoActor, i, "echo", x=np.float32(i))
+            for i in range(10_000)]
+    out = await asyncio.gather(*futs)
+    assert rt.ticks <= 3  # coalesced, not per-message
+    assert out[1234]["x"] == np.float32(1234)
+    assert all(o["calls"] == 1 for o in out[:100])
+
+
+async def test_same_actor_conflicts_defer_to_next_tick():
+    """Two messages to one activation in one batch: serial turns."""
+    rt = VectorRuntime()
+    c = rt.actor(CounterActor, 9)
+    r = await asyncio.gather(c.add(n=1), c.add(n=10), c.add(n=100))
+    assert sorted(int(x) for x in r) == [1, 11, 111]
+    assert rt.ticks >= 3
+
+
+async def test_fresh_init_on_first_message():
+    rt = VectorRuntime()
+    out = await rt.actor(PlayerActor, 55).heartbeat(
+        pos=np.array([1.0, 2.0], np.float32))
+    assert out == 1
+    row = rt.table(PlayerActor).read_row(55)
+    assert row["beats"] == 1
+    np.testing.assert_allclose(row["pos"], [1.0, 2.0])
+
+
+async def test_table_growth():
+    rt = VectorRuntime(capacity_per_shard=8)
+    tbl = rt.table(CounterActor)
+    start_cap = tbl.capacity
+    futs = [rt.call(CounterActor, i, "add", n=np.int32(1))
+            for i in range(1000)]
+    await asyncio.gather(*futs)
+    assert tbl.capacity > start_cap
+    # state survives growth
+    assert await rt.actor(CounterActor, 3).get() == 1
+
+
+async def test_deactivation_frees_slot_and_reinit():
+    rt = VectorRuntime()
+    c = rt.actor(CounterActor, 4)
+    await c.add(n=42)
+    assert rt.table(CounterActor).release(4)
+    # next call re-activates fresh (virtual actor identity)
+    assert await c.add(n=1) == 1
+
+
+async def test_multi_shard_distribution():
+    """8-device CPU mesh: actors spread across all shards."""
+    mesh = make_mesh(8)
+    rt = VectorRuntime(mesh=mesh)
+    futs = [rt.call(CounterActor, i, "add", n=np.int32(i))
+            for i in range(64)]
+    await asyncio.gather(*futs)
+    tbl = rt.table(CounterActor)
+    shards = {s for (s, _) in tbl.key_to_slot.values()}
+    assert shards == set(range(8))
+    assert await rt.actor(CounterActor, 63).get() == 63
+
+
+async def test_dense_bulk_call_batch():
+    """The 1M-msgs/sec path: vectorized key mapping, one kernel launch."""
+    mesh = make_mesh(8)
+    rt = VectorRuntime(mesh=mesh, capacity_per_shard=4096)
+    tbl = rt.table(PlayerActor)
+    n = 10_000
+    tbl.ensure_dense(n)
+    keys = np.arange(n)
+    pos = np.random.rand(n, 2).astype(np.float32)
+    ticks_before = rt.ticks
+    out = rt.call_batch(PlayerActor, "heartbeat", keys,
+                        {"pos": pos}, fresh=np.ones(n, bool))
+    assert rt.ticks == ticks_before + 1
+    assert out.shape == (n,)
+    assert (out == 1).all()
+    out2 = rt.call_batch(PlayerActor, "heartbeat", keys, {"pos": pos})
+    assert (out2 == 2).all()
+    row = tbl.read_row(777)
+    np.testing.assert_allclose(row["pos"], pos[777])
+
+
+async def test_read_only_method_skips_writeback():
+    rt = VectorRuntime()
+    c = rt.actor(CounterActor, 11)
+    await c.add(n=7)
+    before = rt.table(CounterActor).state["value"]
+    await c.get()
+    assert rt.table(CounterActor).state["value"] is before  # same buffer
+
+
+async def test_unknown_method_raises():
+    rt = VectorRuntime()
+    with pytest.raises(AttributeError):
+        rt.actor(CounterActor, 0).nope()
+
+
+async def test_scanned_rounds_serial_turn_semantics():
+    """K rounds in one scanned kernel: round k+1 must see round k's state."""
+    mesh = make_mesh(8)
+    rt = VectorRuntime(mesh=mesh, capacity_per_shard=64)
+    tbl = rt.table(CounterActor)
+    n, K = 100, 5
+    tbl.ensure_dense(n)
+    keys = np.arange(n)
+    adds = np.ones((K, n), np.int32)
+    out = rt.call_batch_rounds(CounterActor, "add", keys, {"n": adds})
+    assert out.shape == (K, n)
+    # each round increments: results are 1, 2, ..., K per actor
+    for k in range(K):
+        assert (out[k] == k + 1).all()
+
+
+async def test_scanned_rounds_single_shard():
+    rt = VectorRuntime(capacity_per_shard=64)
+    tbl = rt.table(CounterActor)
+    tbl.ensure_dense(8)
+    adds = np.full((3, 8), 2, np.int32)
+    out = rt.call_batch_rounds(CounterActor, "add", np.arange(8), {"n": adds})
+    assert (out[-1] == 6).all()
+
+
+async def test_duplicate_keys_rejected_in_bulk():
+    rt = VectorRuntime(capacity_per_shard=64)
+    rt.table(CounterActor).ensure_dense(8)
+    with pytest.raises(ValueError, match="unique"):
+        rt.call_batch(CounterActor, "add", np.array([1, 1, 2]),
+                      {"n": np.zeros(3, np.int32)})
+
+
+async def test_wrong_arg_name_is_clear_error():
+    rt = VectorRuntime()
+    with pytest.raises(TypeError, match="args mismatch"):
+        await rt.actor(CounterActor, 0).add(wrong=np.int32(1))
+
+
+async def test_scanned_rounds_fresh_init_nonzero_initial_state():
+    """First-ever scanned call must apply initial_state (pre-pass), and
+    must NOT re-apply it on later rounds."""
+    class SeededActor(VectorGrain):
+        STATE = {"v": (jnp.int32, ())}
+        @staticmethod
+        def initial_state(kh):
+            return {"v": kh * 10}
+        @actor_method(args={"n": (jnp.int32, ())})
+        def add(state, args):
+            v = state["v"] + args["n"]
+            return {"v": v}, v
+
+    rt = VectorRuntime(capacity_per_shard=16)
+    rt.table(SeededActor).ensure_dense(4)
+    adds = np.ones((3, 4), np.int32)
+    out = rt.call_batch_rounds(SeededActor, "add", np.arange(4), {"n": adds})
+    # key k starts at 10k, then +1 per round
+    for k in range(4):
+        assert out[0][k] == 10 * k + 1
+        assert out[2][k] == 10 * k + 3
+
+
+async def test_call_auto_fresh_on_dense_key():
+    """Per-key call on a dense-provisioned key must run initial_state."""
+    class Seeded2(VectorGrain):
+        STATE = {"v": (jnp.int32, ())}
+        @staticmethod
+        def initial_state(kh):
+            return {"v": jnp.int32(100)}
+        @actor_method(args={})
+        def get(state, args):
+            return state, state["v"]
+
+    rt = VectorRuntime(capacity_per_shard=16)
+    rt.table(Seeded2).ensure_dense(4)
+    assert await rt.actor(Seeded2, 2).get() == 100
