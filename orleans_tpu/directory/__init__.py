@@ -1,0 +1,4 @@
+"""Distributed grain directory + consistent rings (reference L5)."""
+
+from .locator import DistributedLocator  # noqa: F401
+from .ring import ConsistentRing, RingRange, VirtualBucketRing  # noqa: F401

@@ -1,0 +1,278 @@
+"""Distributed grain locator: ring-partitioned directory + placement.
+
+Re-design of /root/reference/src/Orleans.Runtime/GrainDirectory/:
+``LocalGrainDirectory.cs:16`` (ring :23, CalculateTargetSilo :477-546,
+RegisterAsync :576, UnregisterAsync :673, LookupAsync :878),
+``GrainDirectoryPartition.cs:207`` (AddSingleActivation :304 — first-wins
+registration), the LRU cache (``LRUBasedGrainDirectoryCache.cs``) with
+invalidation on forward, ``RemoteGrainDirectory.cs`` (directory ops as
+system-target messages), and ``GrainDirectoryHandoffManager.cs`` (partition
+re-ranging on membership change).
+
+One DistributedLocator per silo replaces SingleSiloLocator when the silo
+joins a multi-silo fabric. Directory ownership: ``ring.owner(grain_hash)``;
+ops for grains owned elsewhere become SYSTEM-category messages to the
+owner's DirectoryTarget.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import logging
+from typing import TYPE_CHECKING
+
+from ..core.ids import ActivationAddress, GrainId, SiloAddress
+from ..core.message import Category, Message
+from ..placement import PlacementManager
+from .ring import ConsistentRing
+
+if TYPE_CHECKING:
+    from ..runtime.silo import Silo
+
+log = logging.getLogger("orleans.directory")
+
+DIRECTORY_TARGET = "DirectoryTarget"
+CACHE_SIZE_DEFAULT = 100_000
+
+
+class DirectoryTarget:
+    """Per-silo directory system target (RemoteGrainDirectory.cs:110): the
+    remote surface of this silo's partition."""
+
+    _activation = None
+
+    def __init__(self, locator: "DistributedLocator"):
+        self.locator = locator
+
+    async def dir_lookup_or_place(self, grain_id: GrainId,
+                                  placement: str | None,
+                                  requester: SiloAddress):
+        return self.locator.local_lookup_or_place(
+            grain_id, placement, requester)
+
+    async def dir_register(self, address: ActivationAddress):
+        return self.locator.local_register(address)
+
+    async def dir_unregister(self, address: ActivationAddress):
+        self.locator.local_unregister(address)
+        return True
+
+    async def dir_handoff(self, entries: list):
+        """Bulk-receive partition entries from a re-ranging peer
+        (GrainDirectoryHandoffManager)."""
+        for addr in entries:
+            self.locator.local_register(addr)
+        return True
+
+
+class DistributedLocator:
+    """Implements the silo locator protocol over a ring-partitioned
+    directory (drop-in replacement for SingleSiloLocator)."""
+
+    def __init__(self, silo: "Silo"):
+        self.silo = silo
+        self.ring = ConsistentRing([silo.silo_address])
+        self.alive_set: set[SiloAddress] = {silo.silo_address}
+        self.alive_list: list[SiloAddress] = [silo.silo_address]
+        self.partition: dict[GrainId, ActivationAddress] = {}
+        self.cache: collections.OrderedDict[GrainId, SiloAddress] = \
+            collections.OrderedDict()
+        self.cache_size = silo.config.directory_cache_size
+        self.placement = PlacementManager(load_of=self._load_of)
+        self.target = DirectoryTarget(self)
+        self.target_id = silo.register_system_target(
+            self.target, DIRECTORY_TARGET)
+
+    # ------------------------------------------------------------------
+    def _load_of(self, silo: SiloAddress) -> int:
+        """Activation-count stats feed. In-proc fabric: read directly (the
+        DeploymentLoadPublisher shortcut); cross-host deployments override
+        via the management stats exchange."""
+        s = self.silo.fabric.silos.get(silo)
+        return s.catalog.activation_count() if s is not None else 1 << 30
+
+    def _alive(self) -> list[SiloAddress]:
+        return self.alive_list or [self.silo.silo_address]
+
+    def _target_ref(self, silo: SiloAddress, method: str, *args):
+        """Invoke a directory op on a peer's system target."""
+        gid = GrainId.system_target(
+            _dir_type_code(), silo)
+        return self.silo.runtime_client.send_request(
+            target_grain=gid, grain_class=DirectoryTarget,
+            interface_name="DirectoryTarget", method_name=method,
+            args=args, kwargs={}, target_silo=silo,
+            category=Category.SYSTEM)
+
+    # ------------------------------------------------------------------
+    # Locator protocol
+    # ------------------------------------------------------------------
+    async def locate(self, msg: Message, grain_class: type | None) -> SiloAddress:
+        """AddressMessage:715 — resolve the hosting silo for a request."""
+        grain_id = msg.target_grain
+        if grain_id.is_system_target() or grain_id.is_client():
+            return msg.target_silo or self.silo.silo_address
+        if grain_class is None:
+            grain_class = self.silo.registry.resolve(msg.interface_name)
+        if grain_class is not None and \
+                getattr(grain_class, "__orleans_stateless_worker__", 0):
+            return self.silo.silo_address  # stateless workers host locally
+        cached = self.cache.get(grain_id)
+        if cached is not None and cached in self.alive_set:
+            self.cache.move_to_end(grain_id)
+            return cached
+        placement_name = getattr(grain_class, "__orleans_placement__",
+                                 None) if grain_class else None
+        owner = self.ring.owner(grain_id.uniform_hash) or self.silo.silo_address
+        if owner == self.silo.silo_address:
+            silo, is_new = self.local_lookup_or_place(
+                grain_id, placement_name, self.silo.silo_address)
+        else:
+            silo, is_new = await self._target_ref(
+                owner, "dir_lookup_or_place", grain_id, placement_name,
+                self.silo.silo_address)
+        msg.is_new_placement = is_new
+        self._cache_put(grain_id, silo)
+        return silo
+
+    def should_host(self, grain_id: GrainId, grain_class: type,
+                    msg: Message) -> bool:
+        if getattr(grain_class, "__orleans_stateless_worker__", 0):
+            return True
+        if msg.is_new_placement:
+            return True
+        reg = self.partition.get(grain_id)
+        return reg is not None and reg.silo == self.silo.silo_address
+
+    async def register(self, address: ActivationAddress
+                       ) -> ActivationAddress | None:
+        """RegisterAsync:576 → first-wins AddSingleActivation on the owner."""
+        owner = self.ring.owner(address.grain.uniform_hash)
+        if owner is None or owner == self.silo.silo_address:
+            return self.local_register(address)
+        return await self._target_ref(owner, "dir_register", address)
+
+    async def unregister(self, address: ActivationAddress) -> None:
+        owner = self.ring.owner(address.grain.uniform_hash)
+        self.cache.pop(address.grain, None)
+        if owner is None or owner == self.silo.silo_address:
+            self.local_unregister(address)
+        else:
+            try:
+                await self._target_ref(owner, "dir_unregister", address)
+            except Exception:  # noqa: BLE001 — owner may be mid-death
+                log.debug("remote unregister failed for %s", address.grain)
+
+    def invalidate_cache(self, grain_id: GrainId) -> None:
+        self.cache.pop(grain_id, None)
+
+    # ------------------------------------------------------------------
+    # Owner-side partition ops
+    # ------------------------------------------------------------------
+    def local_lookup_or_place(self, grain_id: GrainId,
+                              placement_name: str | None,
+                              requester: SiloAddress):
+        reg = self.partition.get(grain_id)
+        if reg is not None and reg.silo in self.alive_set:
+            return reg.silo, False
+        director = self.placement.director_by_name(placement_name)
+        silo = director.place(grain_id, requester, self._alive())
+        return silo, True
+
+    def local_register(self, address: ActivationAddress) -> ActivationAddress:
+        """AddSingleActivation (GrainDirectoryPartition.cs:304): first
+        registration wins; returns the winning address."""
+        cur = self.partition.get(address.grain)
+        if cur is not None and cur.silo in self.alive_set:
+            return cur
+        self.partition[address.grain] = address
+        return address
+
+    def local_unregister(self, address: ActivationAddress) -> None:
+        cur = self.partition.get(address.grain)
+        if cur is not None and cur.activation == address.activation:
+            self.partition.pop(address.grain, None)
+
+    def _cache_put(self, grain_id: GrainId, silo: SiloAddress) -> None:
+        self.cache[grain_id] = silo
+        self.cache.move_to_end(grain_id)
+        while len(self.cache) > self.cache_size:
+            self.cache.popitem(last=False)
+
+    # ------------------------------------------------------------------
+    # Membership events (LocalGrainDirectory.cs:431-460 + handoff manager)
+    # ------------------------------------------------------------------
+    def on_membership_change(self, silos: list[SiloAddress],
+                             dead: list[SiloAddress]) -> None:
+        self.ring.update(silos)
+        alive = set(silos)
+        self.alive_set = alive
+        self.alive_list = self.ring.silos
+        # drop directory entries for activations on dead silos: the next
+        # call re-creates the grain elsewhere (virtual-actor guarantee)
+        for gid, addr in list(self.partition.items()):
+            if addr.silo not in alive:
+                self.partition.pop(gid, None)
+        for gid, silo in list(self.cache.items()):
+            if silo not in alive:
+                self.cache.pop(gid, None)
+        # re-range: replicate entries we no longer own to the new owner.
+        # The entry is popped only after the new owner acks — during the
+        # transfer window both silos answer lookups consistently (the old
+        # owner still holds the registration); failed pushes keep the entry
+        # here for retry at the next membership change.
+        moved: dict[SiloAddress, list] = {}
+        for gid, addr in self.partition.items():
+            owner = self.ring.owner(gid.uniform_hash)
+            if owner is not None and owner != self.silo.silo_address:
+                moved.setdefault(owner, []).append((gid, addr))
+        for owner, entries in moved.items():
+            asyncio.ensure_future(self._handoff_entries(owner, entries))
+
+    async def _handoff_entries(self, owner: SiloAddress, entries: list) -> None:
+        try:
+            await self._target_ref(owner, "dir_handoff",
+                                   [addr for _, addr in entries])
+        except Exception:  # noqa: BLE001 — keep entries; retried on next change
+            log.debug("re-range handoff to %s failed; entries retained", owner)
+            return
+        for gid, addr in entries:
+            cur = self.partition.get(gid)
+            if cur is not None and cur.activation == addr.activation:
+                self.partition.pop(gid, None)
+
+    async def handoff_all(self) -> None:
+        """Graceful-stop handoff: push the whole partition to successors
+        (GrainDirectoryHandoffManager on ShuttingDown). Without this,
+        registrations for grains hosted on OTHER silos die with this
+        partition and single-activation breaks (duplicate activations)."""
+        others = [s for s in self._alive() if s != self.silo.silo_address]
+        if not others:
+            return
+        ring = ConsistentRing(others)
+        moved: dict[SiloAddress, list[ActivationAddress]] = {}
+        for gid, addr in self.partition.items():
+            if addr.silo == self.silo.silo_address:
+                continue  # our activations die with us
+            owner = ring.owner(gid.uniform_hash)
+            if owner is not None:
+                moved.setdefault(owner, []).append(addr)
+        for owner, entries in moved.items():
+            try:
+                await self._target_ref(owner, "dir_handoff", entries)
+            except Exception:  # noqa: BLE001
+                log.debug("handoff to %s failed", owner)
+        self.partition.clear()
+
+
+async def _swallow(fut):
+    try:
+        await fut
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _dir_type_code() -> int:
+    from ..core.ids import type_code_of
+    return type_code_of(DIRECTORY_TARGET)

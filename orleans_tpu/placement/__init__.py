@@ -1,0 +1,3 @@
+"""Placement strategies (reference L7)."""
+
+from .strategies import PlacementDirector, PlacementManager  # noqa: F401

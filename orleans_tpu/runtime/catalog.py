@@ -173,8 +173,9 @@ class Catalog:
         while act.running and time.monotonic() < deadline:
             await asyncio.sleep(0.005)
         try:
-            if act.grain_instance is not None:
-                await act.grain_instance.on_deactivate()
+            hook = getattr(act.grain_instance, "on_deactivate", None)
+            if hook is not None:
+                await hook()
         except Exception:  # noqa: BLE001
             log.exception("on_deactivate failed for %s", act.grain_id)
         if not act.is_stateless_worker and not act.grain_id.is_system_target():
@@ -226,6 +227,8 @@ class Catalog:
             await asyncio.sleep(self.collection_quantum * (0.9 + 0.2 * random.random()))
             now = time.monotonic()
             for act in list(self.by_activation.values()):
+                if act.grain_id.is_system_target():
+                    continue  # system targets live as long as the silo
                 if act.state != ActivationState.VALID or not act.is_inactive:
                     continue
                 if now < act.keep_alive_until:
@@ -238,7 +241,10 @@ class Catalog:
 
     # ------------------------------------------------------------------
     def activation_count(self) -> int:
-        return len(self.by_activation)
+        """Application activations (system targets excluded, matching the
+        management-grain activation-count semantics)."""
+        return sum(1 for a in self.by_activation.values()
+                   if not a.grain_id.is_system_target())
 
     def on_silo_dead(self, silo_address) -> None:
         """Kill activations whose directory registration lived on a dead silo

@@ -53,11 +53,26 @@ class InProcFabric:
     def register_silo(self, silo) -> None:
         self.silos[silo.silo_address] = silo
         self.dead.discard(silo.silo_address)
+        self._broadcast_membership()
 
     def unregister_silo(self, silo, dead: bool = False) -> None:
         self.silos.pop(silo.silo_address, None)
         if dead:
             self.dead.add(silo.silo_address)
+        self._broadcast_membership(dead=[silo.silo_address] if dead else [])
+
+    def _broadcast_membership(self, dead: list[SiloAddress] | None = None) -> None:
+        """Fan membership changes to every silo's locator. When a membership
+        oracle is installed on the silos, the oracle drives these
+        notifications instead (probe/vote protocol) and the fabric only
+        carries the wire."""
+        alive = self.alive_silos()
+        for s in list(self.silos.values()):
+            if s.membership is None:
+                s.locator.on_membership_change(alive, dead or [])
+                if dead:
+                    for d in dead:
+                        s.runtime_client.break_outstanding_to_dead_silo(d)
 
     def register_client(self, client: "ClusterClient") -> None:
         self.clients[client.silo_address] = client

@@ -19,7 +19,13 @@ from ..core.errors import (
     SiloUnavailableError,
 )
 from ..core.ids import GrainId, SiloAddress
-from ..core.message import Direction, Message, ResponseKind, make_request
+from ..core.message import (
+    Category,
+    Direction,
+    Message,
+    ResponseKind,
+    make_request,
+)
 from ..core.serialization import deep_copy
 from .context import RequestContext, current_activation
 
@@ -68,7 +74,9 @@ class RuntimeClient:
                      is_read_only: bool = False,
                      is_always_interleave: bool = False,
                      is_one_way: bool = False,
-                     timeout: float | None = None):
+                     timeout: float | None = None,
+                     target_silo: SiloAddress | None = None,
+                     category=None):
         timeout = self.response_timeout if timeout is None else timeout
         sender = current_activation.get()
         call_chain: tuple[GrainId, ...] = ()
@@ -87,6 +95,8 @@ class RuntimeClient:
             method_name=method_name,
             body=deep_copy((args, kwargs)),
             direction=Direction.ONE_WAY if is_one_way else Direction.REQUEST,
+            category=category if category is not None else Category.APPLICATION,
+            target_silo=target_silo,
             sending_silo=self.silo_address,
             sending_grain=sender.grain_id if sender else None,
             sending_activation=sender.activation_id if sender else None,

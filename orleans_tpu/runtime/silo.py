@@ -16,7 +16,6 @@ either.
 from __future__ import annotations
 
 import asyncio
-import itertools
 import logging
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Any, Callable
@@ -150,10 +149,17 @@ class MessageCenter:
         self.silo.stats.increment("messaging.sent")
         if msg.target_silo is not None and \
                 self.silo.fabric.is_dead(msg.target_silo):
-            # drop to dead silo (MessageCenter SiloDeadOracle, Silo.cs:347)
-            if msg.direction == Direction.REQUEST:
-                self.silo.runtime_client.break_outstanding_to_dead_silo(
-                    msg.target_silo)
+            # dead target (MessageCenter SiloDeadOracle, Silo.cs:347):
+            # bounce a transient rejection to the sender so callers —
+            # including external clients routed through this gateway —
+            # re-address instead of waiting out the response timeout
+            if msg.direction == Direction.REQUEST and \
+                    msg.sending_silo is not None:
+                from ..core.message import RejectionType, make_rejection
+                rej = make_rejection(msg, RejectionType.TRANSIENT,
+                                     f"target silo {msg.target_silo} dead")
+                rej.target_silo = msg.sending_silo
+                self.silo.fabric.deliver(rej)
             return
         self.silo.fabric.deliver(msg)
 
@@ -171,35 +177,6 @@ class InsideRuntimeClient(RuntimeClient):
 
     def transmit(self, msg: Message) -> None:
         self.silo.dispatcher.send_message(msg)
-
-
-class SingleSiloLocator:
-    """Grain locator for a one-silo deployment: everything is local. The
-    distributed implementation (ring + partitioned directory + placement
-    directors) lives in orleans_tpu.directory.locator.DistributedLocator and
-    replaces this when the silo joins a fabric with membership."""
-
-    def __init__(self, silo: "Silo"):
-        self.silo = silo
-
-    async def locate(self, msg: Message, grain_class: type | None) -> SiloAddress:
-        return self.silo.silo_address
-
-    def should_host(self, grain_id: GrainId, grain_class: type,
-                    msg: Message) -> bool:
-        return True
-
-    async def register(self, address: ActivationAddress) -> ActivationAddress | None:
-        return None
-
-    async def unregister(self, address: ActivationAddress) -> None:
-        return None
-
-    def invalidate_cache(self, grain_id: GrainId) -> None:
-        return None
-
-
-_silo_port = itertools.count(11111)
 
 
 class Silo:
@@ -220,8 +197,9 @@ class Silo:
         self.dispatcher = Dispatcher(self)
         self.catalog = Catalog(self)
         self.grain_factory = GrainFactory(self.runtime_client)
-        self.locator: Any = SingleSiloLocator(self)
-        self.membership: Any = None       # installed by cluster join (task: L6)
+        from ..directory.locator import DistributedLocator
+        self.locator: Any = DistributedLocator(self)
+        self.membership: Any = None       # installed by cluster join (L6)
         self.reminders: Any = None        # installed by reminder service (L11)
         self.stream_providers: dict[str, Any] = {}
         self.status = "Created"
@@ -268,6 +246,12 @@ class Silo:
             if self.membership is not None:
                 await self.membership.shutdown()
             await self.catalog.stop()
+            # push surviving directory entries (grains hosted on OTHER
+            # silos) to ring successors — without this their registrations
+            # die with our partition and single-activation breaks
+            # (GrainDirectoryHandoffManager on ShuttingDown)
+            if hasattr(self.locator, "handoff_all"):
+                await self.locator.handoff_all()
             for stage, _, stop in sorted(self._lifecycle, key=lambda x: x[0],
                                          reverse=True):
                 r = stop()
@@ -277,6 +261,22 @@ class Silo:
         self.runtime_client.close()
         self.fabric.unregister_silo(self, dead=not graceful)
         self.status = "Stopped"
+
+    def register_system_target(self, instance, name: str) -> GrainId:
+        """Register a per-silo pseudo-grain at a well-known id
+        (SystemTarget framework, Silo.RegisterSystemTarget Silo.cs:816-820).
+        The instance's public async methods become remotely callable with
+        ``target_silo`` pinned to this silo."""
+        from ..core.ids import type_code_of
+        from .activation import ActivationData, ActivationState
+        gid = GrainId.system_target(type_code_of(name), self.silo_address)
+        act = ActivationData(gid, self, type(instance))
+        act.state = ActivationState.VALID
+        act.grain_instance = instance
+        instance._activation = act
+        self.catalog.by_activation[act.activation_id] = act
+        self.catalog.by_grain[gid] = [act]
+        return gid
 
     # helper used by Catalog to run lifecycle hooks in activation context
     async def dispatcher_scoped(self, activation, coro_fn) -> None:
