@@ -61,8 +61,13 @@ class SiloConfig:
     deactivation_timeout: float = 5.0
     detect_deadlocks: bool = False
     membership_probe_period: float = 1.0
+    membership_probe_timeout: float = 1.0
     membership_missed_probes_limit: int = 3
     membership_votes_needed: int = 2
+    membership_num_probed: int = 3
+    membership_iam_alive_period: float = 5.0
+    membership_refresh_period: float = 5.0
+    membership_vote_expiration: float = 10.0
     directory_cache_size: int = 100_000
 
 
@@ -242,6 +247,8 @@ class Silo:
         if self.status == "Stopped":
             return
         self.status = "ShuttingDown" if graceful else "Dead"
+        if not graceful and self.membership is not None:
+            self.membership.stop()  # kill: timers die with us, no goodbye row
         if graceful:
             if self.membership is not None:
                 await self.membership.shutdown()

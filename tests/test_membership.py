@@ -1,0 +1,256 @@
+"""Membership service tests: CAS table contract (every backend, mirroring
+test/TesterInternal/MembershipTests/MembershipTableTestsBase.cs), and the
+probe/vote oracle protocol (test/Tester/MembershipTests/LivenessTests.cs)."""
+
+import asyncio
+import time
+
+import pytest
+
+from orleans_tpu.membership import (
+    FileMembershipTable,
+    InMemoryMembershipTable,
+    MembershipEntry,
+    SiloStatus,
+    SqliteMembershipTable,
+    join_cluster,
+)
+from orleans_tpu.core.ids import SiloAddress
+from orleans_tpu.runtime import ClusterClient, Grain, InProcFabric, SiloBuilder
+from orleans_tpu.storage import MemoryStorage
+
+
+# ---------------------------------------------------------------------------
+# Table contract (all backends)
+# ---------------------------------------------------------------------------
+
+def make_tables(tmp_path):
+    return [
+        InMemoryMembershipTable(),
+        FileMembershipTable(str(tmp_path / "mbr.json")),
+        SqliteMembershipTable(str(tmp_path / "mbr.sqlite")),
+    ]
+
+
+def addr(i: int, gen: int = 1) -> SiloAddress:
+    return SiloAddress("host", 1000 + i, gen)
+
+
+async def test_table_contract(tmp_path):
+    for table in make_tables(tmp_path):
+        snap = await table.read_all()
+        assert snap.entries == [] and snap.version.version == 0
+
+        e0 = MembershipEntry(addr(0), SiloStatus.ACTIVE, start_time=1.0)
+        assert await table.insert_row(e0, snap.version.next())
+        # stale version: CAS must fail
+        assert not await table.insert_row(
+            MembershipEntry(addr(1), SiloStatus.ACTIVE), snap.version.next())
+
+        snap = await table.read_all()
+        assert snap.version.version == 1
+        entry, etag = snap.get(addr(0))
+        assert entry.status == SiloStatus.ACTIVE
+
+        # CAS update with correct etag wins; reusing the stale etag loses
+        entry = entry.copy()
+        entry.status = SiloStatus.DEAD
+        assert await table.update_row(entry, etag, snap.version.next())
+        assert not await table.update_row(entry, etag, snap.version.next())
+
+        snap = await table.read_all()
+        assert snap.get(addr(0))[0].status == SiloStatus.DEAD
+
+        await table.update_iam_alive(addr(0), 42.0)
+        snap = await table.read_all()
+        assert snap.get(addr(0))[0].iam_alive_time == 42.0
+        await table.delete_table()
+
+
+async def test_table_concurrent_cas_single_winner(tmp_path):
+    for table in make_tables(tmp_path):
+        base = await table.read_all()
+        e = MembershipEntry(addr(0), SiloStatus.ACTIVE)
+        assert await table.insert_row(e, base.version.next())
+        snap = await table.read_all()
+        entry, etag = snap.get(addr(0))
+
+        async def contend(status):
+            mod = entry.copy()
+            mod.status = status
+            return await table.update_row(mod, etag, snap.version.next())
+
+        results = await asyncio.gather(
+            contend(SiloStatus.SHUTTING_DOWN), contend(SiloStatus.DEAD))
+        assert sum(results) == 1  # exactly one CAS winner
+        await table.delete_table()
+
+
+# ---------------------------------------------------------------------------
+# Oracle protocol over an in-proc fabric
+# ---------------------------------------------------------------------------
+
+class PingGrain(Grain):
+    async def ping(self):
+        return self.runtime_identity
+
+
+FAST = dict(
+    membership_probe_period=0.1,
+    membership_probe_timeout=0.15,
+    membership_missed_probes_limit=2,
+    membership_votes_needed=2,
+    membership_iam_alive_period=0.5,
+    membership_refresh_period=0.3,
+    membership_vote_expiration=5.0,
+    response_timeout=2.0,
+)
+
+
+async def start_cluster(n, table=None, fabric=None):
+    fabric = fabric or InProcFabric()
+    table = table if table is not None else InMemoryMembershipTable()
+    silos = []
+    for i in range(n):
+        silo = (SiloBuilder().with_name(f"m{i}").with_fabric(fabric)
+                .add_grains(PingGrain)
+                .with_storage("Default", MemoryStorage())
+                .with_config(**FAST).build())
+        join_cluster(silo, table)
+        await silo.start()
+        silos.append(silo)
+    return fabric, table, silos
+
+
+async def wait_until(cond, timeout=8.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        await asyncio.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+async def stop_all(silos):
+    for s in silos:
+        if s.status not in ("Stopped", "Dead"):
+            await s.stop()
+
+
+async def test_oracle_all_silos_see_each_other():
+    fabric, table, silos = await start_cluster(3)
+    try:
+        await wait_until(
+            lambda: all(len(s.membership.active) == 3 for s in silos),
+            msg="full active view")
+        for s in silos:
+            assert set(s.membership.active) == {x.silo_address for x in silos}
+    finally:
+        await stop_all(silos)
+
+
+async def test_oracle_detects_killed_silo_and_cluster_recovers():
+    fabric, table, silos = await start_cluster(3)
+    client = await ClusterClient(fabric).connect()
+    try:
+        await wait_until(
+            lambda: all(len(s.membership.active) == 3 for s in silos))
+        victim = silos[2]
+        await victim.stop(graceful=False)  # kill: no goodbye row
+        survivors = silos[:2]
+        await wait_until(
+            lambda: all(victim.silo_address in s.membership.dead
+                        for s in survivors),
+            msg="victim declared dead via probe+vote")
+        snap = await table.read_all()
+        assert snap.get(victim.silo_address)[0].status == SiloStatus.DEAD
+        # virtual-actor guarantee: calls keep working post-death
+        for k in range(20):
+            await client.get_grain(PingGrain, k).ping()
+    finally:
+        await client.close_async()
+        await stop_all(silos)
+
+
+async def test_oracle_graceful_shutdown_writes_dead_row():
+    fabric, table, silos = await start_cluster(3)
+    try:
+        await wait_until(
+            lambda: all(len(s.membership.active) == 3 for s in silos))
+        leaver = silos[0]
+        await leaver.stop(graceful=True)
+        snap = await table.read_all()
+        assert snap.get(leaver.silo_address)[0].status == SiloStatus.DEAD
+        await wait_until(
+            lambda: all(leaver.silo_address not in s.membership.active
+                        for s in silos[1:]),
+            msg="survivors drop leaver from active view")
+    finally:
+        await stop_all(silos)
+
+
+async def test_oracle_partitioned_silo_kills_itself():
+    fabric, table, silos = await start_cluster(3)
+    try:
+        await wait_until(
+            lambda: all(len(s.membership.active) == 3 for s in silos))
+        victim = silos[2]
+        for s in silos[:2]:
+            fabric.partition(s.silo_address, victim.silo_address)
+        # majority side votes the unreachable silo dead; the victim reads
+        # its own Dead row (table is out-of-band, like Azure/SQL) and stops
+        await wait_until(
+            lambda: victim.membership.declared_dead,
+            msg="victim learns of its death and self-terminates")
+        await wait_until(
+            lambda: victim.status in ("Stopped", "Dead"),
+            msg="victim stopped")
+        await wait_until(
+            lambda: all(victim.silo_address not in s.membership.active
+                        for s in silos[:2]),
+            msg="survivors converge on 2-silo view")
+    finally:
+        await stop_all(silos)
+
+
+async def test_oracle_elastic_join_updates_views():
+    fabric, table, silos = await start_cluster(2)
+    try:
+        await wait_until(
+            lambda: all(len(s.membership.active) == 2 for s in silos))
+        newcomer = (SiloBuilder().with_name("m-new").with_fabric(fabric)
+                    .add_grains(PingGrain)
+                    .with_storage("Default", MemoryStorage())
+                    .with_config(**FAST).build())
+        join_cluster(newcomer, table)
+        await newcomer.start()
+        silos.append(newcomer)
+        await wait_until(
+            lambda: all(len(s.membership.active) == 3 for s in silos),
+            msg="all three converge after join")
+    finally:
+        await stop_all(silos)
+
+
+async def test_restart_same_endpoint_supersedes_old_generation():
+    """A restarted silo at the same endpoint must declare its prior
+    incarnation dead on join (become_active prior-generation sweep)."""
+    table = InMemoryMembershipTable()
+    old = MembershipEntry(SiloAddress("host", 7777, 1), SiloStatus.ACTIVE)
+    base = await table.read_all()
+    assert await table.insert_row(old, base.version.next())
+
+    fabric = InProcFabric()
+    silo = (SiloBuilder().with_name("reborn").with_fabric(fabric)
+            .add_grains(PingGrain).with_storage("Default", MemoryStorage())
+            .with_config(**FAST).build())
+    # pin the same endpoint, newer generation
+    silo.silo_address = SiloAddress("host", 7777, 2)
+    join_cluster(silo, table)
+    try:
+        await silo.start()
+        snap = await table.read_all()
+        assert snap.get(SiloAddress("host", 7777, 1))[0].status == SiloStatus.DEAD
+        assert snap.get(silo.silo_address)[0].status == SiloStatus.ACTIVE
+    finally:
+        await silo.stop()
