@@ -1,0 +1,165 @@
+"""Reminder service tests (test/TesterInternal/RemindersTest tier):
+registration, ticking, persistence across deactivation, ring re-ranging on
+silo death, and the table contract on both backends."""
+
+import asyncio
+import time
+
+from orleans_tpu.core.ids import GrainId, GrainType
+from orleans_tpu.membership import InMemoryMembershipTable, join_cluster
+from orleans_tpu.reminders import (
+    InMemoryReminderTable,
+    ReminderEntry,
+    SqliteReminderTable,
+    add_reminders,
+)
+from orleans_tpu.runtime import ClusterClient, Grain, InProcFabric, SiloBuilder
+from orleans_tpu.storage import MemoryStorage
+
+TICKS = {}  # (key, reminder name) -> list of tick times
+
+
+class AlarmGrain(Grain):
+    """IRemindable grain: records reminder ticks in a module-global so the
+    test can observe ticks even across re-activations."""
+
+    async def arm(self, name, due, period):
+        await self.register_reminder(name, due, period)
+        return True
+
+    async def disarm(self, name):
+        await self.unregister_reminder(name)
+
+    async def lookup(self, name):
+        h = await self.get_reminder(name)
+        return None if h is None else h.name
+
+    async def receive_reminder(self, name, status):
+        TICKS.setdefault((self.primary_key, name), []).append(
+            status.current_tick_time)
+
+    async def die(self):
+        self.deactivate_on_idle()
+
+
+def reminder_tables(tmp_path):
+    return [InMemoryReminderTable(),
+            SqliteReminderTable(str(tmp_path / "rem.sqlite"))]
+
+
+async def test_reminder_table_contract(tmp_path):
+    gid = GrainId.for_grain(GrainType.of("AlarmGrain"), 7)
+    for table in reminder_tables(tmp_path):
+        assert await table.read_all() == []
+        e = ReminderEntry(gid, "AlarmGrain", "wake", 100.0, 60.0)
+        tag1 = await table.upsert_row(e)
+        row = await table.read_row(gid, "wake")
+        assert row.period == 60.0 and row.etag == tag1
+        # upsert same key overwrites with a new etag
+        e2 = ReminderEntry(gid, "AlarmGrain", "wake", 100.0, 30.0)
+        tag2 = await table.upsert_row(e2)
+        assert tag2 != tag1
+        assert (await table.read_row(gid, "wake")).period == 30.0
+        assert len(await table.read_grain_rows(gid)) == 1
+        # etag-checked remove: stale etag fails, fresh succeeds
+        assert not await table.remove_row(gid, "wake", tag1)
+        assert await table.remove_row(gid, "wake", tag2)
+        assert await table.read_row(gid, "wake") is None
+        await table.delete_table()
+
+
+async def start_cluster(n, rem_table=None):
+    fabric = InProcFabric()
+    mbr = InMemoryMembershipTable()
+    rem = rem_table or InMemoryReminderTable()
+    silos = []
+    for i in range(n):
+        silo = (SiloBuilder().with_name(f"r{i}").with_fabric(fabric)
+                .add_grains(AlarmGrain)
+                .with_storage("Default", MemoryStorage())
+                .with_config(membership_probe_period=0.1,
+                             membership_probe_timeout=0.15,
+                             membership_missed_probes_limit=2,
+                             membership_refresh_period=0.3,
+                             response_timeout=2.0)
+                .build())
+        join_cluster(silo, mbr)
+        add_reminders(silo, rem, refresh_period=0.2)
+        await silo.start()
+        silos.append(silo)
+    client = await ClusterClient(fabric).connect()
+    return fabric, rem, silos, client
+
+
+async def stop_all(silos, client):
+    await client.close_async()
+    for s in silos:
+        if s.status not in ("Stopped", "Dead"):
+            await s.stop()
+
+
+async def wait_ticks(key, name, count, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if len(TICKS.get((key, name), [])) >= count:
+            return TICKS[(key, name)]
+        await asyncio.sleep(0.05)
+    raise AssertionError(
+        f"reminder {name} got {len(TICKS.get((key, name), []))} ticks, "
+        f"wanted {count}")
+
+
+async def test_reminder_fires_periodically():
+    TICKS.clear()
+    fabric, rem, silos, client = await start_cluster(1)
+    try:
+        g = client.get_grain(AlarmGrain, 1)
+        await g.arm("beat", 0.1, 0.2)
+        ticks = await wait_ticks(1, "beat", 3)
+        assert ticks == sorted(ticks)
+        assert await g.lookup("beat") == "beat"
+        await g.disarm("beat")
+        n = len(TICKS[(1, "beat")])
+        await asyncio.sleep(0.6)
+        assert len(TICKS[(1, "beat")]) <= n + 1  # at most one in-flight tick
+    finally:
+        await stop_all(silos, client)
+
+
+async def test_reminder_survives_deactivation():
+    TICKS.clear()
+    fabric, rem, silos, client = await start_cluster(1)
+    try:
+        g = client.get_grain(AlarmGrain, 2)
+        await g.arm("persist", 0.1, 0.25)
+        await wait_ticks(2, "persist", 1)
+        await g.die()  # deactivate the grain; reminder must keep firing
+        before = len(TICKS[(2, "persist")])
+        await wait_ticks(2, "persist", before + 2)
+    finally:
+        await stop_all(silos, client)
+
+
+async def test_reminder_reranges_to_survivor_on_silo_death():
+    TICKS.clear()
+    fabric, rem, silos, client = await start_cluster(3)
+    try:
+        # arm enough reminders that every silo owns at least one
+        for k in range(12):
+            await client.get_grain(AlarmGrain, 100 + k).arm("spread", 0.1, 0.3)
+        for k in range(12):
+            await wait_ticks(100 + k, "spread", 1)
+        owners = {s.silo_address: len(s.reminders.local) for s in silos}
+        assert sum(owners.values()) == 12
+        victim = max(silos, key=lambda s: len(s.reminders.local))
+        assert len(victim.reminders.local) > 0
+        await victim.stop(graceful=False)
+        survivors = [s for s in silos if s is not victim]
+        # all 12 keep ticking: survivors adopt the victim's ranges
+        counts = {k: len(TICKS[(100 + k, "spread")]) for k in range(12)}
+        for k in range(12):
+            await wait_ticks(100 + k, "spread", counts[k] + 2, timeout=15.0)
+        total_local = sum(len(s.reminders.local) for s in survivors)
+        assert total_local == 12
+    finally:
+        await stop_all(silos, client)
