@@ -146,7 +146,16 @@ class RuntimeClient:
                 cb.message.target_silo = None  # re-address from scratch
                 cb.message.target_activation = None
                 self.callbacks[msg.id] = cb
-                self.transmit(cb.message)
+                # back off before re-addressing: transient rejections during
+                # silo death need the directory/membership view a moment to
+                # converge before the retry can land elsewhere
+                delay = 0.05 * (2 ** cb.message.resend_count)
+
+                def _resend(mid=msg.id, m=cb.message):
+                    if mid in self.callbacks:
+                        self.transmit(m)
+
+                asyncio.get_running_loop().call_later(delay, _resend)
                 return
             cb.future.set_exception(RejectionError(msg.rejection_info or "rejected"))
 

@@ -1,0 +1,23 @@
+"""Virtual streams (reference L11, src/Orleans.Core/Streams/ +
+src/Orleans.Runtime/Streams/): SMS direct fan-out + persistent queue-backed
+providers over grain-call delivery."""
+
+from .core import StreamId, StreamProvider, StreamRef, SubscriptionHandle
+from .persistent import (
+    MemoryQueueAdapter,
+    PersistentStreamProvider,
+    QueueAdapter,
+    QueueBatch,
+    QueueReceiver,
+    add_persistent_streams,
+)
+from .pubsub import PubSubRendezvousGrain, implicit_stream_subscription
+from .sms import SMSStreamProvider, add_sms_streams
+
+__all__ = [
+    "StreamId", "StreamRef", "SubscriptionHandle", "StreamProvider",
+    "SMSStreamProvider", "add_sms_streams",
+    "QueueAdapter", "QueueReceiver", "QueueBatch", "MemoryQueueAdapter",
+    "PersistentStreamProvider", "add_persistent_streams",
+    "PubSubRendezvousGrain", "implicit_stream_subscription",
+]

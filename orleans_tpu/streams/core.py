@@ -1,0 +1,125 @@
+"""Stream model: identities, handles, and the provider contract.
+
+Re-design of /root/reference/src/Orleans.Core/Streams/:
+``StreamImpl`` (Internal/StreamImpl.cs:13 — Subscribe :60, OnNext :89),
+``StreamId``/``IAsyncStream<T>`` (virtual streams addressed by guid+namespace),
+``StreamSubscriptionHandle``. Providers implement ``get_stream`` and the
+producer/consumer plumbing; consumers are grains — a subscription records
+(grain id, method) and delivery is an ordinary grain call, the analog of the
+``StreamConsumerExtension`` piggybacking on grain messaging.
+"""
+
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Any, Callable
+
+from ..core.errors import StreamError
+from ..core.ids import GrainId, stable_hash64
+
+if TYPE_CHECKING:
+    from ..runtime.silo import Silo
+
+__all__ = ["StreamId", "StreamRef", "SubscriptionHandle", "StreamProvider"]
+
+
+@dataclass(frozen=True)
+class StreamId:
+    """Stream identity = (provider, namespace, key) — StreamId.cs."""
+
+    provider: str
+    namespace: str
+    key: str
+
+    @property
+    def uniform_hash(self) -> int:
+        return stable_hash64(f"stream|{self.provider}|{self.namespace}|{self.key}")
+
+    def __str__(self) -> str:
+        return f"{self.provider}/{self.namespace}/{self.key}"
+
+
+@dataclass(frozen=True)
+class SubscriptionHandle:
+    """Opaque subscription token (StreamSubscriptionHandle<T>)."""
+
+    stream: StreamId
+    handle_id: str
+    grain_id: GrainId
+    interface_name: str
+    method_name: str
+
+
+def consumer_of(handler: Callable) -> tuple[GrainId, str, str]:
+    """Extract (grain id, interface, method) from a bound grain method —
+    the subscription record. The handler must be ``self.method`` of a live
+    grain so delivery can route as a grain call after re-activation."""
+    owner = getattr(handler, "__self__", None)
+    if owner is None or not hasattr(owner, "grain_id"):
+        raise StreamError(
+            "stream handlers must be bound methods of a grain "
+            "(e.g. stream.subscribe(self.on_event))")
+    return owner.grain_id, type(owner).__name__, handler.__name__
+
+
+class StreamRef:
+    """The user-facing stream handle (IAsyncStream<T>): produce + subscribe.
+    Cheap to create; all state lives in pubsub/queues."""
+
+    def __init__(self, provider: "StreamProvider", stream: StreamId):
+        self.provider = provider
+        self.stream_id = stream
+
+    # -- producer side (StreamImpl.OnNext :89) --------------------------
+    async def on_next(self, item: Any) -> None:
+        await self.provider.produce(self.stream_id, [item])
+
+    async def on_next_batch(self, items: list) -> None:
+        await self.provider.produce(self.stream_id, list(items))
+
+    async def on_completed(self) -> None:
+        await self.provider.complete(self.stream_id)
+
+    # -- consumer side (StreamImpl.Subscribe :60) -----------------------
+    async def subscribe(self, handler: Callable) -> SubscriptionHandle:
+        grain_id, iface, method = consumer_of(handler)
+        handle = SubscriptionHandle(
+            stream=self.stream_id, handle_id=uuid.uuid4().hex,
+            grain_id=grain_id, interface_name=iface, method_name=method)
+        await self.provider.register_consumer(handle)
+        return handle
+
+    async def unsubscribe(self, handle: SubscriptionHandle) -> None:
+        await self.provider.unregister_consumer(handle)
+
+    async def subscription_handles(self) -> list[SubscriptionHandle]:
+        return await self.provider.consumer_handles(self.stream_id)
+
+
+class StreamProvider:
+    """Provider contract (IStreamProvider). Subclasses: SMS (direct fan-out)
+    and persistent (queue-backed)."""
+
+    def __init__(self, silo: "Silo", name: str):
+        self.silo = silo
+        self.name = name
+
+    def get_stream(self, namespace: str, key) -> StreamRef:
+        return StreamRef(self, StreamId(self.name, namespace, str(key)))
+
+    # -- to implement ----------------------------------------------------
+    async def produce(self, stream: StreamId, items: list) -> None:
+        raise NotImplementedError
+
+    async def complete(self, stream: StreamId) -> None:  # noqa: B027
+        pass
+
+    async def register_consumer(self, handle: SubscriptionHandle) -> None:
+        raise NotImplementedError
+
+    async def unregister_consumer(self, handle: SubscriptionHandle) -> None:
+        raise NotImplementedError
+
+    async def consumer_handles(self, stream: StreamId) -> list[SubscriptionHandle]:
+        raise NotImplementedError

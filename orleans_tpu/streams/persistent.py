@@ -1,0 +1,304 @@
+"""Persistent (queue-backed) streams: adapters, balancer, pulling agents.
+
+Re-design of /root/reference/src/Orleans.Runtime/Streams/PersistentStream/:
+``PersistentStreamPullingAgent.cs:13`` (timer-driven pull loop :141, read
+:350-368, per-consumer delivery with backoff retry + IStreamFailureHandler),
+``PersistentStreamPullingManager.cs:14`` (queue↔silo assignment), the
+``IQueueAdapter`` abstraction (Core/Streams/PersistentStreams/), the
+membership-driven ``DeploymentBasedQueueBalancer.cs:40``, and the Memory
+adapter (OrleansProviders/Streams/Memory/MemoryAdapterFactory.cs:22 — there
+backed by MemoryStreamQueueGrain; here a shared in-proc queue object standing
+in for the external queue service).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import logging
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Callable
+
+from ..core.ids import SiloAddress, stable_hash64
+from .core import StreamId, StreamProvider, SubscriptionHandle
+from .pubsub import PubSubRendezvousGrain, deliver_to_consumer, resolve_consumers
+
+if TYPE_CHECKING:
+    from ..runtime.silo import Silo
+
+log = logging.getLogger("orleans.streams.persistent")
+
+__all__ = [
+    "QueueBatch", "QueueAdapter", "QueueReceiver", "MemoryQueueAdapter",
+    "PersistentStreamProvider", "PullingManager", "add_persistent_streams",
+]
+
+
+@dataclass
+class QueueBatch:
+    """One queued batch (IBatchContainer): events of one stream + cursor."""
+
+    stream: StreamId
+    items: list
+    seq: int
+
+
+class QueueAdapter:
+    """External-queue abstraction (IQueueAdapter)."""
+
+    name = "queue"
+    n_queues = 8
+
+    async def queue_message_batch(self, queue_id: int, stream: StreamId,
+                                  items: list) -> None:
+        raise NotImplementedError
+
+    def create_receiver(self, queue_id: int) -> "QueueReceiver":
+        raise NotImplementedError
+
+
+class QueueReceiver:
+    """Per-queue pull handle (IQueueAdapterReceiver)."""
+
+    async def get_messages(self, max_count: int) -> list[QueueBatch]:
+        raise NotImplementedError
+
+    async def ack(self, batch: QueueBatch) -> None:  # noqa: B027
+        pass
+
+
+class MemoryQueueAdapter(QueueAdapter):
+    """In-proc shared queue bank: the dev/test "external queue service".
+    One instance must be shared by every silo of the cluster (like a real
+    queue service endpoint)."""
+
+    def __init__(self, n_queues: int = 8, name: str = "memory"):
+        self.name = name
+        self.n_queues = n_queues
+        self._queues: list[collections.deque[QueueBatch]] = [
+            collections.deque() for _ in range(n_queues)]
+        self._seq = 0
+
+    async def queue_message_batch(self, queue_id, stream, items) -> None:
+        self._seq += 1
+        self._queues[queue_id].append(QueueBatch(stream, list(items), self._seq))
+
+    def create_receiver(self, queue_id: int) -> "QueueReceiver":
+        return _MemoryReceiver(self._queues[queue_id])
+
+
+class _MemoryReceiver(QueueReceiver):
+    def __init__(self, queue: collections.deque):
+        self._queue = queue
+        self._inflight: list[QueueBatch] = []
+
+    async def get_messages(self, max_count: int) -> list[QueueBatch]:
+        out = []
+        while self._queue and len(out) < max_count:
+            out.append(self._queue.popleft())
+        # keep a separate inflight list: ack() mutates it while the agent
+        # iterates the returned list
+        self._inflight = list(out)
+        return out
+
+    async def ack(self, batch: QueueBatch) -> None:
+        if batch in self._inflight:
+            self._inflight.remove(batch)
+
+
+def deployment_balancer(queue_id: int, adapter_name: str,
+                        silos: list[SiloAddress]) -> SiloAddress | None:
+    """Queue→silo assignment by consistent hash over the alive set
+    (DeploymentBasedQueueBalancer.cs:40 — deterministic, membership-driven,
+    no coordination needed: every silo computes the same mapping)."""
+    if not silos:
+        return None
+    # rendezvous (highest-random-weight) hashing: minimal churn on join/leave
+    return min(silos, key=lambda s: stable_hash64(
+        f"qb|{adapter_name}|{queue_id}|{s.endpoint}|{s.generation}"))
+
+
+class PullingAgent:
+    """One owned queue's pump (PersistentStreamPullingAgent.cs:13): pull a
+    batch, resolve subscribers, deliver in order with bounded backoff retry,
+    then ack. A small bounded cache of recent batches supports diagnostics
+    (the SimpleQueueCache stand-in)."""
+
+    def __init__(self, provider: "PersistentStreamProvider", queue_id: int,
+                 pull_period: float, max_batch: int,
+                 max_delivery_attempts: int = 3, cache_size: int = 1024):
+        self.provider = provider
+        self.queue_id = queue_id
+        self.pull_period = pull_period
+        self.max_batch = max_batch
+        self.max_delivery_attempts = max_delivery_attempts
+        self.receiver = provider.adapter.create_receiver(queue_id)
+        self.cache: collections.deque[QueueBatch] = collections.deque(
+            maxlen=cache_size)
+        self._task: asyncio.Task | None = None
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _run(self) -> None:
+        silo = self.provider.silo
+        while True:
+            try:
+                batches = await self.receiver.get_messages(self.max_batch)
+            except Exception:  # noqa: BLE001
+                log.exception("queue %d read failed", self.queue_id)
+                batches = []
+            if not batches:
+                await asyncio.sleep(self.pull_period)
+                continue
+            for batch in batches:
+                self.cache.append(batch)
+                silo.stats.increment("streams.persistent.pulled",
+                                     len(batch.items))
+                await self._deliver_batch(batch)
+                await self.receiver.ack(batch)
+
+    async def _deliver_batch(self, batch: QueueBatch) -> None:
+        silo = self.provider.silo
+        try:
+            consumers = await resolve_consumers(silo, batch.stream)
+        except Exception:  # noqa: BLE001
+            log.exception("pubsub resolve failed for %s", batch.stream)
+            return
+        for handle in consumers:
+            backoff = 0.05
+            for attempt in range(self.max_delivery_attempts):
+                try:
+                    await deliver_to_consumer(
+                        silo, handle, batch.items, batch.seq)
+                    break
+                except Exception as exc:  # noqa: BLE001
+                    if attempt + 1 == self.max_delivery_attempts:
+                        self.provider.on_delivery_failure(
+                            handle, batch.stream, batch, exc)
+                    else:
+                        await asyncio.sleep(backoff)
+                        backoff *= 2
+
+
+class PullingManager:
+    """Per-silo agent manager (PersistentStreamPullingManager.cs:14):
+    recomputes owned queues from the membership view and starts/stops
+    agents on re-balance."""
+
+    def __init__(self, provider: "PersistentStreamProvider",
+                 rebalance_period: float = 2.0):
+        self.provider = provider
+        self.rebalance_period = rebalance_period
+        self.agents: dict[int, PullingAgent] = {}
+        self._task: asyncio.Task | None = None
+        self._kick = asyncio.Event()
+
+    def start(self) -> None:
+        silo = self.provider.silo
+        if silo.membership is not None:
+            silo.membership.subscribe(lambda a, d: self._kick.set())
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+        self._kick.set()
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        for agent in self.agents.values():
+            agent.stop()
+        self.agents.clear()
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await asyncio.wait_for(self._kick.wait(),
+                                       timeout=self.rebalance_period)
+            except asyncio.TimeoutError:
+                pass
+            self._kick.clear()
+            try:
+                self._rebalance()
+            except Exception:  # noqa: BLE001
+                log.exception("stream queue rebalance failed")
+
+    def _rebalance(self) -> None:
+        p = self.provider
+        me = p.silo.silo_address
+        alive = p.silo.locator.alive_list
+        mine = {q for q in range(p.adapter.n_queues)
+                if deployment_balancer(q, p.adapter.name, alive) == me}
+        for q in list(self.agents):
+            if q not in mine:
+                self.agents.pop(q).stop()
+        for q in mine:
+            if q not in self.agents:
+                agent = PullingAgent(p, q, p.pull_period, p.max_batch)
+                agent.start()
+                self.agents[q] = agent
+
+
+class PersistentStreamProvider(StreamProvider):
+    """Queue-backed provider (PersistentStreamProvider.cs)."""
+
+    def __init__(self, silo: "Silo", name: str, adapter: QueueAdapter,
+                 pull_period: float = 0.1, max_batch: int = 32,
+                 failure_handler: Callable | None = None):
+        super().__init__(silo, name)
+        self.adapter = adapter
+        self.pull_period = pull_period
+        self.max_batch = max_batch
+        self.failure_handler = failure_handler
+        self.manager = PullingManager(self)
+
+    async def produce(self, stream: StreamId, items: list) -> None:
+        queue_id = stream.uniform_hash % self.adapter.n_queues
+        self.silo.stats.increment("streams.persistent.produced", len(items))
+        await self.adapter.queue_message_batch(queue_id, stream, items)
+
+    async def register_consumer(self, handle: SubscriptionHandle) -> None:
+        await self._rendezvous(handle.stream).register_consumer(handle)
+
+    async def unregister_consumer(self, handle: SubscriptionHandle) -> None:
+        await self._rendezvous(handle.stream).unregister_consumer(
+            handle.handle_id)
+
+    async def consumer_handles(self, stream: StreamId):
+        return await resolve_consumers(self.silo, stream)
+
+    def on_delivery_failure(self, handle: SubscriptionHandle,
+                            stream: StreamId, batch: QueueBatch,
+                            exc: BaseException) -> None:
+        """IStreamFailureHandler: called after delivery retries exhaust."""
+        self.silo.stats.increment("streams.persistent.delivery_failures")
+        if self.failure_handler is not None:
+            self.failure_handler(handle, stream, batch, exc)
+        else:
+            log.warning("dropping %d events of %s for %s after retries: %s",
+                        len(batch.items), stream, handle.grain_id, exc)
+
+    def _rendezvous(self, stream: StreamId):
+        return self.silo.grain_factory.get_grain(
+            PubSubRendezvousGrain, str(stream))
+
+
+def add_persistent_streams(builder, name: str, adapter: QueueAdapter,
+                           **kw):
+    """Register a queue-backed provider on a SiloBuilder. ``adapter`` must
+    be the cluster-shared queue object (the external queue service)."""
+    builder.add_grains(PubSubRendezvousGrain)
+
+    def install(silo) -> None:
+        provider = PersistentStreamProvider(silo, name, adapter, **kw)
+        silo.stream_providers[name] = provider
+        from ..runtime.silo import ServiceLifecycleStage
+        silo.subscribe_lifecycle(
+            ServiceLifecycleStage.RUNTIME_GRAIN_SERVICES,
+            provider.manager.start, provider.manager.stop)
+
+    return builder.configure(install)

@@ -1,0 +1,102 @@
+"""Stream pub-sub: rendezvous grain state + implicit subscriptions.
+
+Re-design of /root/reference/src/Orleans.Runtime/Streams/PubSub/
+PubSubRendezvousGrain.cs:21 (RegisterProducer :62, RegisterConsumer :115 —
+durable per-stream subscriber sets held in grain state) and
+src/Orleans.Core/Streams/PubSub/ImplicitStreamSubscriberTable.cs:11
+(attribute-declared subscriptions resolved from the type map, no rendezvous
+round-trip).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from ..core.ids import GrainId, GrainType
+from ..runtime.grain import StatefulGrain
+from .core import StreamId, SubscriptionHandle
+
+if TYPE_CHECKING:
+    from ..runtime.silo import Silo
+
+__all__ = ["PubSubRendezvousGrain", "implicit_stream_subscription",
+           "implicit_consumers", "resolve_consumers", "deliver_to_consumer"]
+
+
+class PubSubRendezvousGrain(StatefulGrain):
+    """One per stream (key = str(StreamId)): the durable subscriber set."""
+
+    async def register_consumer(self, handle: SubscriptionHandle) -> None:
+        self.state.setdefault("consumers", {})[handle.handle_id] = handle
+        await self.write_state()
+
+    async def unregister_consumer(self, handle_id: str) -> None:
+        if self.state.setdefault("consumers", {}).pop(handle_id, None):
+            await self.write_state()
+
+    async def get_consumers(self) -> list[SubscriptionHandle]:
+        return list(self.state.get("consumers", {}).values())
+
+    async def register_producer(self, producer: str) -> None:
+        if producer not in self.state.setdefault("producers", []):
+            self.state["producers"].append(producer)
+            await self.write_state()
+
+    async def counts(self) -> tuple[int, int]:
+        return (len(self.state.get("producers", [])),
+                len(self.state.get("consumers", {})))
+
+
+def implicit_stream_subscription(namespace: str):
+    """Class decorator: auto-subscribe every grain of this class to streams
+    in ``namespace``, keyed by the stream key ([ImplicitStreamSubscription]).
+    The grain must define ``async def on_next(self, item, token)``."""
+
+    def deco(cls: type) -> type:
+        existing = list(getattr(cls, "__implicit_stream_ns__", ()))
+        cls.__implicit_stream_ns__ = (*existing, namespace)
+        return cls
+
+    return deco
+
+
+def implicit_consumers(silo: "Silo", stream: StreamId) -> list[SubscriptionHandle]:
+    """ImplicitStreamSubscriberTable: registered classes whose declared
+    namespaces include this stream's — consumer key = stream key."""
+    out = []
+    for cls in silo.registry.all_classes():
+        if stream.namespace in getattr(cls, "__implicit_stream_ns__", ()):
+            gid = GrainId.for_grain(GrainType.of(cls.__name__), stream.key)
+            out.append(SubscriptionHandle(
+                stream=stream, handle_id=f"implicit:{cls.__name__}",
+                grain_id=gid, interface_name=cls.__name__,
+                method_name="on_next"))
+    return out
+
+
+def _rendezvous(silo: "Silo", stream: StreamId):
+    return silo.grain_factory.get_grain(PubSubRendezvousGrain, str(stream))
+
+
+async def resolve_consumers(silo: "Silo", stream: StreamId
+                            ) -> list[SubscriptionHandle]:
+    """Explicit (rendezvous state) + implicit (type map) subscribers."""
+    explicit = await _rendezvous(silo, stream).get_consumers()
+    return list(explicit) + implicit_consumers(silo, stream)
+
+
+async def deliver_to_consumer(silo: "Silo", handle: SubscriptionHandle,
+                              items: list, first_token: int) -> None:
+    """Deliver events as ordinary grain calls (the consumer-extension path):
+    ``await consumer.<method>(item, token)`` per event, in order."""
+    cls = silo.registry.resolve(handle.interface_name)
+    if cls is None:
+        raise LookupError(
+            f"stream consumer class {handle.interface_name} not registered")
+    for i, item in enumerate(items):
+        fut = silo.runtime_client.send_request(
+            target_grain=handle.grain_id, grain_class=cls,
+            interface_name=handle.interface_name,
+            method_name=handle.method_name,
+            args=(item, first_token + i), kwargs={})
+        await fut

@@ -1,0 +1,217 @@
+"""Stream tests (test/Tester streaming tier): SMS fan-out, implicit
+subscriptions, persistent queue-backed delivery, queue rebalance on silo
+death, and delivery-failure handling."""
+
+import asyncio
+import time
+
+from orleans_tpu.membership import InMemoryMembershipTable, join_cluster
+from orleans_tpu.runtime import ClusterClient, Grain, InProcFabric, SiloBuilder
+from orleans_tpu.storage import MemoryStorage
+from orleans_tpu.streams import (
+    MemoryQueueAdapter,
+    add_persistent_streams,
+    add_sms_streams,
+    implicit_stream_subscription,
+)
+
+RECEIVED = {}   # (consumer key, kind) -> list of items
+FAILURES = []
+
+
+class ProducerGrain(Grain):
+    async def publish(self, provider, ns, stream_key, item):
+        stream = self.get_stream_provider(provider).get_stream(ns, stream_key)
+        await stream.on_next(item)
+
+    async def publish_batch(self, provider, ns, stream_key, items):
+        stream = self.get_stream_provider(provider).get_stream(ns, stream_key)
+        await stream.on_next_batch(items)
+
+
+class ConsumerGrain(Grain):
+    async def join(self, provider, ns, stream_key):
+        stream = self.get_stream_provider(provider).get_stream(ns, stream_key)
+        self._handle = await stream.subscribe(self.on_event)
+        return self._handle.handle_id
+
+    async def leave(self, provider, ns, stream_key):
+        stream = self.get_stream_provider(provider).get_stream(ns, stream_key)
+        await stream.unsubscribe(self._handle)
+
+    async def on_event(self, item, token):
+        RECEIVED.setdefault((self.primary_key, "explicit"), []).append(item)
+
+
+@implicit_stream_subscription("telemetry")
+class ImplicitConsumerGrain(Grain):
+    async def on_next(self, item, token):
+        RECEIVED.setdefault((self.primary_key, "implicit"), []).append(item)
+
+
+class FlakyConsumerGrain(Grain):
+    async def join(self, provider, ns, stream_key):
+        stream = self.get_stream_provider(provider).get_stream(ns, stream_key)
+        await stream.subscribe(self.on_event)
+
+    async def on_event(self, item, token):
+        raise RuntimeError("consumer permanently broken")
+
+
+GRAINS = [ProducerGrain, ConsumerGrain, ImplicitConsumerGrain,
+          FlakyConsumerGrain]
+
+
+async def start_cluster(n, adapter=None, with_membership=False):
+    fabric = InProcFabric()
+    storage = MemoryStorage()
+    mbr = InMemoryMembershipTable()
+    adapter = adapter or MemoryQueueAdapter(n_queues=4)
+    silos = []
+    for i in range(n):
+        b = (SiloBuilder().with_name(f"st{i}").with_fabric(fabric)
+             .add_grains(*GRAINS).with_storage("Default", storage)
+             .with_config(membership_probe_period=0.1,
+                          membership_probe_timeout=0.15,
+                          membership_missed_probes_limit=2,
+                          membership_refresh_period=0.3,
+                          response_timeout=2.0))
+        add_sms_streams(b, "sms")
+        add_persistent_streams(b, "queue", adapter, pull_period=0.05)
+        b.configure(lambda s: setattr(
+            s.stream_providers["queue"], "failure_handler",
+            lambda h, st, batch, exc: FAILURES.append((h.grain_id, exc))))
+        silo = b.build()
+        if with_membership:
+            join_cluster(silo, mbr)
+        await silo.start()
+        silos.append(silo)
+    client = await ClusterClient(fabric).connect()
+    return fabric, adapter, silos, client
+
+
+async def stop_all(silos, client):
+    await client.close_async()
+    for s in silos:
+        if s.status not in ("Stopped", "Dead"):
+            await s.stop()
+
+
+async def wait_received(key, count, timeout=8.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if len(RECEIVED.get(key, [])) >= count:
+            return RECEIVED[key]
+        await asyncio.sleep(0.03)
+    raise AssertionError(
+        f"{key} got {len(RECEIVED.get(key, []))} events, wanted {count}")
+
+
+async def test_sms_explicit_pubsub_roundtrip():
+    RECEIVED.clear()
+    fabric, adapter, silos, client = await start_cluster(1)
+    try:
+        consumer = client.get_grain(ConsumerGrain, 1)
+        await consumer.join("sms", "chat", "room1")
+        producer = client.get_grain(ProducerGrain, 1)
+        await producer.publish("sms", "chat", "room1", "hello")
+        await producer.publish_batch("sms", "chat", "room1", ["a", "b"])
+        got = await wait_received((1, "explicit"), 3)
+        assert got == ["hello", "a", "b"]
+        await consumer.leave("sms", "chat", "room1")
+        await producer.publish("sms", "chat", "room1", "after")
+        await asyncio.sleep(0.2)
+        assert RECEIVED[(1, "explicit")] == ["hello", "a", "b"]
+    finally:
+        await stop_all(silos, client)
+
+
+async def test_sms_multiple_consumers_fan_out():
+    RECEIVED.clear()
+    fabric, adapter, silos, client = await start_cluster(2)
+    try:
+        for k in (10, 11, 12):
+            await client.get_grain(ConsumerGrain, k).join("sms", "chat", "r")
+        await client.get_grain(ProducerGrain, 2).publish("sms", "chat", "r", "x")
+        for k in (10, 11, 12):
+            assert (await wait_received((k, "explicit"), 1)) == ["x"]
+    finally:
+        await stop_all(silos, client)
+
+
+async def test_implicit_subscription_receives_by_stream_key():
+    RECEIVED.clear()
+    fabric, adapter, silos, client = await start_cluster(1)
+    try:
+        await client.get_grain(ProducerGrain, 3).publish(
+            "sms", "telemetry", "device-7", {"t": 1})
+        got = await wait_received(("device-7", "implicit"), 1)
+        assert got == [{"t": 1}]
+    finally:
+        await stop_all(silos, client)
+
+
+async def test_persistent_stream_delivers_through_queue():
+    RECEIVED.clear()
+    fabric, adapter, silos, client = await start_cluster(2)
+    try:
+        await client.get_grain(ConsumerGrain, 20).join("queue", "gps", "car1")
+        producer = client.get_grain(ProducerGrain, 4)
+        for i in range(5):
+            await producer.publish("queue", "gps", "car1", i)
+        got = await wait_received((20, "explicit"), 5)
+        assert got == [0, 1, 2, 3, 4]  # per-stream order preserved
+    finally:
+        await stop_all(silos, client)
+
+
+async def test_persistent_stream_rebalances_on_silo_death():
+    RECEIVED.clear()
+    fabric, adapter, silos, client = await start_cluster(3, with_membership=True)
+    try:
+        await client.get_grain(ConsumerGrain, 30).join("queue", "gps", "s")
+
+        def owners():
+            return {q: s.silo_address for s in silos
+                    if s.status == "Running"
+                    for q in s.stream_providers["queue"].manager.agents}
+
+        deadline = time.monotonic() + 8
+        while time.monotonic() < deadline and len(owners()) < adapter.n_queues:
+            await asyncio.sleep(0.05)
+        assert len(owners()) == adapter.n_queues
+
+        victim = silos[1]
+        await victim.stop(graceful=False)
+        survivors = [s for s in silos if s is not victim]
+        deadline = time.monotonic() + 8
+        while time.monotonic() < deadline and not all(
+                victim.silo_address in s.membership.dead for s in survivors):
+            await asyncio.sleep(0.05)
+        producer = client.get_grain(ProducerGrain, 5)
+        for i in range(10):
+            await producer.publish("queue", "gps", "s", i)
+        await wait_received((30, "explicit"), 10, timeout=15.0)
+        # every queue is re-owned by a survivor
+        deadline = time.monotonic() + 8
+        while time.monotonic() < deadline and len(owners()) < adapter.n_queues:
+            await asyncio.sleep(0.05)
+        assert len(owners()) == adapter.n_queues
+        assert victim.silo_address not in owners().values()
+    finally:
+        await stop_all(silos, client)
+
+
+async def test_persistent_delivery_failure_invokes_handler():
+    RECEIVED.clear()
+    FAILURES.clear()
+    fabric, adapter, silos, client = await start_cluster(1)
+    try:
+        await client.get_grain(FlakyConsumerGrain, 40).join("queue", "gps", "f")
+        await client.get_grain(ProducerGrain, 6).publish("queue", "gps", "f", 1)
+        deadline = time.monotonic() + 8
+        while time.monotonic() < deadline and not FAILURES:
+            await asyncio.sleep(0.05)
+        assert FAILURES, "failure handler never invoked"
+    finally:
+        await stop_all(silos, client)
