@@ -206,6 +206,7 @@ class Silo:
         self.locator: Any = DistributedLocator(self)
         self.membership: Any = None       # installed by cluster join (L6)
         self.reminders: Any = None        # installed by reminder service (L11)
+        self.transactions: Any = None     # installed by add_transactions (L11)
         self.stream_providers: dict[str, Any] = {}
         self.status = "Created"
         self._lifecycle: list[tuple[int, Callable, Callable]] = []

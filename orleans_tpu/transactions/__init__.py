@@ -1,0 +1,18 @@
+"""ACID multi-grain transactions (reference L11, src/Orleans.Transactions/ +
+src/Orleans.Runtime/Transactions/): @transactional scopes, TransactionalState
+versioned grain state, singleton TM grain running 2PC."""
+
+from .context import ambient_txn
+from .manager import (
+    TransactionAgent,
+    TransactionManagerGrain,
+    add_transactions,
+    transactional,
+)
+from .state import TransactionalGrain, TransactionalState
+
+__all__ = [
+    "transactional", "add_transactions", "ambient_txn",
+    "TransactionAgent", "TransactionManagerGrain",
+    "TransactionalGrain", "TransactionalState",
+]

@@ -1,0 +1,25 @@
+"""Transaction ambient context: the txn id rides RequestContext so it flows
+through nested grain calls exactly like the reference's TransactionInfo
+message header (Message headers transaction info; scope opened in
+InsideRuntimeClient.Invoke, /root/reference/src/Orleans.Runtime/Core/
+InsideRuntimeClient.cs:313-438)."""
+
+from __future__ import annotations
+
+from ..runtime.context import RequestContext
+
+TXN_KEY = "orleans.txn.id"
+
+__all__ = ["TXN_KEY", "ambient_txn", "set_ambient_txn", "clear_ambient_txn"]
+
+
+def ambient_txn() -> str | None:
+    return RequestContext.get(TXN_KEY)
+
+
+def set_ambient_txn(txn_id: str) -> None:
+    RequestContext.set(TXN_KEY, txn_id)
+
+
+def clear_ambient_txn() -> None:
+    RequestContext.remove(TXN_KEY)

@@ -1,0 +1,215 @@
+"""Transaction manager + per-silo agent + the @transactional scope.
+
+Re-design of /root/reference/src/Orleans.Transactions/InClusterTM/
+TransactionManager.cs:709 (in-cluster sequencer + commit log),
+src/Orleans.Runtime/Transactions/TransactionAgent.cs:98 (per-silo proxy to
+the TM), and TransactionLog.cs. The TM here is a singleton grain running
+2PC over participants that registered via join; commit versions are the
+TM's monotone sequence (the sequencer), and the decision log is grain state
+(the commit-log analog, durable through the grain's storage provider).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import time
+import uuid
+from typing import TYPE_CHECKING
+
+from ..core.errors import TransactionAbortedError, TransactionError
+from ..core.ids import GrainId
+from ..runtime.grain import StatefulGrain
+from .context import ambient_txn, clear_ambient_txn, set_ambient_txn
+
+if TYPE_CHECKING:
+    from ..runtime.silo import Silo
+
+log = logging.getLogger("orleans.transactions")
+
+__all__ = ["TransactionManagerGrain", "TransactionAgent", "transactional",
+           "add_transactions"]
+
+DEFAULT_TXN_TIMEOUT = 10.0
+
+
+class TransactionManagerGrain(StatefulGrain):
+    """Singleton TM grain (key 0): sequencer + 2PC coordinator + decision
+    log. State: {"seq": int, "decisions": {txn: "committed"|"aborted"}}."""
+
+    def _active(self) -> dict:
+        return self.state.setdefault("active", {})
+
+    async def start_transaction(self, timeout: float = DEFAULT_TXN_TIMEOUT
+                                ) -> str:
+        txn = uuid.uuid4().hex
+        self._active()[txn] = {
+            "participants": {},        # str(grain_id) -> (GrainId, iface)
+            "deadline": time.time() + timeout,
+        }
+        return txn
+
+    async def join(self, txn: str, grain_id: GrainId, iface: str) -> None:
+        info = self._active().get(txn)
+        if info is None:
+            raise TransactionError(f"transaction {txn} unknown or finished")
+        if time.time() > info["deadline"]:
+            raise TransactionAbortedError(f"transaction {txn} timed out")
+        info["participants"][str(grain_id)] = (grain_id, iface)
+
+    async def commit_transaction(self, txn: str) -> bool:
+        info = self._active().pop(txn, None)
+        if info is None:
+            return False
+        if time.time() > info["deadline"]:
+            await self._notify(info, "_txn_abort", txn)
+            await self._record(txn, "aborted")
+            return False
+        participants = list(info["participants"].values())
+        # phase 1: prepare — every participant validates + locks
+        votes = []
+        for gid, iface in participants:
+            try:
+                votes.append(await self._call(gid, iface, "_txn_prepare", txn))
+            except Exception:  # noqa: BLE001 — unreachable participant = no
+                log.warning("prepare failed for %s in %s", gid, txn,
+                            exc_info=True)
+                votes.append(False)
+        if all(votes):
+            # sequencer: commit version = next monotone sequence number
+            self.state["seq"] = self.state.get("seq", 0) + 1
+            version = self.state["seq"]
+            await self._record(txn, "committed")
+            for gid, iface in participants:
+                try:
+                    await self._call(gid, iface, "_txn_commit", txn, version)
+                except Exception:  # noqa: BLE001 — decision is logged;
+                    # participant re-syncs from storage on reactivation
+                    log.warning("commit delivery failed for %s in %s",
+                                gid, txn, exc_info=True)
+            return True
+        await self._notify(info, "_txn_abort", txn)
+        await self._record(txn, "aborted")
+        return False
+
+    async def abort_transaction(self, txn: str) -> None:
+        info = self._active().pop(txn, None)
+        if info is not None:
+            await self._notify(info, "_txn_abort", txn)
+            await self._record(txn, "aborted")
+
+    async def decision_of(self, txn: str) -> str | None:
+        return self.state.get("decisions", {}).get(txn)
+
+    # -- internals -------------------------------------------------------
+    async def _record(self, txn: str, decision: str) -> None:
+        """Append to the decision log and persist (TransactionLog.cs)."""
+        self.state.setdefault("decisions", {})[txn] = decision
+        active = self.state.pop("active", None)  # volatile: don't persist
+        try:
+            await self.write_state()
+        finally:
+            if active is not None:
+                self.state["active"] = active
+
+    async def _notify(self, info: dict, method: str, txn: str) -> None:
+        for gid, iface in info["participants"].values():
+            try:
+                await self._call(gid, iface, method, txn)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _call(self, grain_id: GrainId, iface: str, method: str, *args):
+        silo = self._activation.runtime
+        cls = silo.registry.resolve(iface)
+        if cls is None:
+            raise TransactionError(f"participant class {iface} unknown")
+        return silo.runtime_client.send_request(
+            target_grain=grain_id, grain_class=cls, interface_name=iface,
+            method_name=method, args=args, kwargs={},
+            is_always_interleave=True)
+
+
+class TransactionAgent:
+    """Per-silo facade to the TM (TransactionAgent.cs:98); installed as
+    ``silo.transactions``."""
+
+    def __init__(self, silo: "Silo"):
+        self.silo = silo
+
+    def _tm(self):
+        return self.silo.grain_factory.get_grain(TransactionManagerGrain, 0)
+
+    async def start(self, timeout: float = DEFAULT_TXN_TIMEOUT) -> str:
+        self.silo.stats.increment("transactions.started")
+        return await self._tm().start_transaction(timeout)
+
+    async def join(self, txn: str, grain_id: GrainId, iface: str) -> None:
+        await self._tm().join(txn, grain_id, iface)
+
+    async def commit(self, txn: str) -> bool:
+        ok = await self._tm().commit_transaction(txn)
+        self.silo.stats.increment(
+            "transactions.committed" if ok else "transactions.aborted")
+        return ok
+
+    async def abort(self, txn: str) -> None:
+        self.silo.stats.increment("transactions.aborted")
+        await self._tm().abort_transaction(txn)
+
+
+def transactional(fn=None, *, option: str = "required"):
+    """Method decorator opening a transaction scope ([Transaction(...)];
+    scope semantics of InsideRuntimeClient.Invoke:313-438).
+
+    options: "required" (join ambient or start new — default),
+    "requires_new" (always start a fresh transaction),
+    "suppress" (run outside any transaction).
+    """
+
+    def deco(fn):
+        @functools.wraps(fn)
+        async def wrapper(self, *args, **kwargs):
+            cur = ambient_txn()
+            if option == "suppress":
+                clear_ambient_txn()
+                try:
+                    return await fn(self, *args, **kwargs)
+                finally:
+                    if cur is not None:
+                        set_ambient_txn(cur)
+            if cur is not None and option == "required":
+                return await fn(self, *args, **kwargs)  # join ambient scope
+            agent = self._activation.runtime.transactions
+            if agent is None:
+                raise TransactionError(
+                    "no transaction agent installed (add_transactions)")
+            txn = await agent.start()
+            set_ambient_txn(txn)
+            try:
+                result = await fn(self, *args, **kwargs)
+            except BaseException:
+                clear_ambient_txn()
+                await agent.abort(txn)
+                raise
+            clear_ambient_txn()
+            if not await agent.commit(txn):
+                raise TransactionAbortedError(
+                    f"transaction {txn} aborted (conflict or participant "
+                    "failure)")
+            return result
+
+        wrapper.__orleans_transaction__ = option
+        return wrapper
+
+    return deco(fn) if fn is not None else deco
+
+
+def add_transactions(builder):
+    """Register the TM grain + install the per-silo agent on a SiloBuilder."""
+    builder.add_grains(TransactionManagerGrain)
+
+    def install(silo) -> None:
+        silo.transactions = TransactionAgent(silo)
+
+    return builder.configure(install)

@@ -1,0 +1,189 @@
+"""Transactional grain state: versioned values with 2PC participation.
+
+Re-design of /root/reference/src/Orleans.Transactions/State/
+TransactionalState.cs:611 (ITransactionalState<T> — versioned copies per
+transaction, read-version validation, prepare/commit/abort participation)
+plus the grain-facing facet. The reference validates at a central TM with
+version ranges; here validation is pushed to the participant (optimistic
+read-version check + short prepare lock), with the TM (manager.py) running
+the 2PC rounds — same outcome: serializable multi-grain transactions.
+
+Usage::
+
+    class AccountGrain(TransactionalGrain):
+        def __init__(self):
+            super().__init__()
+            self.balance = TransactionalState("balance", default=0)
+
+        @transactional
+        async def deposit(self, amount):
+            v = await self.balance.get()
+            await self.balance.set(v + amount)
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from ..core.errors import TransactionAbortedError
+from ..core.serialization import deep_copy
+from ..runtime.grain import Grain, always_interleave
+from .context import ambient_txn
+
+__all__ = ["TransactionalState", "TransactionalGrain"]
+
+PREPARE_LOCK_TTL = 10.0  # steal an expired lock: TM died mid-2PC
+
+
+class TransactionalState:
+    """One versioned value owned by a grain."""
+
+    def __init__(self, name: str, default: Any = None,
+                 storage_name: str = "Default"):
+        self.name = name
+        self.default = default
+        self.storage_name = storage_name
+        self.committed: Any = default
+        self.committed_version: int = 0
+        self.owner: "TransactionalGrain | None" = None
+        # txn id -> {"value", "read_version", "written"}
+        self.workspace: dict[str, dict] = {}
+        self.lock: tuple[str, float] | None = None  # (txn id, deadline)
+        self._etag: str | None = None  # storage etag of the committed row
+
+    # -- grain-facing API (PerformRead/PerformUpdate) -------------------
+    async def get(self) -> Any:
+        txn = ambient_txn()
+        if txn is None:
+            return deep_copy(self.committed)
+        ws = await self._enter(txn)
+        return ws["value"]
+
+    async def set(self, value: Any) -> None:
+        txn = ambient_txn()
+        if txn is None:
+            raise TransactionAbortedError(
+                f"state {self.name!r} can only be written inside a "
+                "transaction (wrap the method with @transactional)")
+        ws = await self._enter(txn)
+        ws["value"] = value
+        ws["written"] = True
+
+    async def _enter(self, txn: str) -> dict:
+        ws = self.workspace.get(txn)
+        if ws is None:
+            await self.owner._txn_join(txn)
+            ws = self.workspace[txn] = {
+                "value": deep_copy(self.committed),
+                "read_version": self.committed_version,
+                "written": False,
+            }
+        return ws
+
+    # -- 2PC participation ----------------------------------------------
+    def prepare(self, txn: str, now: float) -> bool:
+        ws = self.workspace.get(txn)
+        if ws is None:
+            return True  # joined via another state of the same grain
+        if self.lock is not None and self.lock[1] > now and \
+                self.lock[0] != txn:
+            return False  # another transaction is mid-commit on this state
+        if ws["read_version"] != self.committed_version:
+            return False  # someone committed since we read
+        self.lock = (txn, now + PREPARE_LOCK_TTL)
+        return True
+
+    def commit(self, txn: str, commit_version: int) -> bool:
+        """Apply; returns True when the value changed (needs persist)."""
+        ws = self.workspace.pop(txn, None)
+        if self.lock is not None and self.lock[0] == txn:
+            self.lock = None
+        if ws is None or not ws["written"]:
+            return False
+        self.committed = ws["value"]
+        self.committed_version = commit_version
+        return True
+
+    def abort(self, txn: str) -> None:
+        self.workspace.pop(txn, None)
+        if self.lock is not None and self.lock[0] == txn:
+            self.lock = None
+
+
+class TransactionalGrain(Grain):
+    """Base for grains holding TransactionalState: wires state discovery,
+    persistence, and the 2PC surface the TM calls (the participant half of
+    TransactionAgent.cs:98)."""
+
+    @property
+    def _txn_joined(self) -> set[str]:
+        # lazy so subclasses need not call super().__init__()
+        return self.__dict__.setdefault("_txn_joined_set", set())
+
+    def _txn_states(self) -> list[TransactionalState]:
+        out = []
+        for v in vars(self).values():
+            if isinstance(v, TransactionalState):
+                if v.owner is None:
+                    v.owner = self
+                out.append(v)
+        return out
+
+    # -- lifecycle: recover committed values from storage ----------------
+    async def on_activate(self) -> None:
+        silo = self._activation.runtime
+        for st in self._txn_states():
+            provider = silo.storage_manager.get(st.storage_name)
+            if provider is None:
+                continue
+            data, etag = await provider.read(
+                self._txn_storage_type(st), self.grain_id)
+            st._etag = etag
+            if data is not None:
+                st.committed = data["value"]
+                st.committed_version = data["version"]
+
+    def _txn_storage_type(self, st: TransactionalState) -> str:
+        return f"txn:{type(self).__name__}:{st.name}"
+
+    # -- join: register as participant with the TM -----------------------
+    async def _txn_join(self, txn: str) -> None:
+        if txn in self._txn_joined:
+            return
+        self._txn_joined.add(txn)
+        agent = self._activation.runtime.transactions
+        await agent.join(txn, self.grain_id, type(self).__name__)
+
+    # -- 2PC surface called by the TM (interleave: the root caller is
+    # blocked awaiting commit while these arrive) ------------------------
+    @always_interleave
+    async def _txn_prepare(self, txn: str) -> bool:
+        now = time.time()
+        votes = [st.prepare(txn, now) for st in self._txn_states()]
+        if not all(votes):
+            for st in self._txn_states():
+                st.abort(txn)
+            self._txn_joined.discard(txn)
+            return False
+        return True
+
+    @always_interleave
+    async def _txn_commit(self, txn: str, commit_version: int) -> None:
+        silo = self._activation.runtime
+        for st in self._txn_states():
+            if st.commit(txn, commit_version):
+                provider = silo.storage_manager.get(st.storage_name)
+                if provider is not None:
+                    st._etag = await provider.write(
+                        self._txn_storage_type(st), self.grain_id,
+                        {"value": st.committed,
+                         "version": st.committed_version},
+                        etag=st._etag)
+        self._txn_joined.discard(txn)
+
+    @always_interleave
+    async def _txn_abort(self, txn: str) -> None:
+        for st in self._txn_states():
+            st.abort(txn)
+        self._txn_joined.discard(txn)

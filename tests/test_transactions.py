@@ -1,0 +1,198 @@
+"""Transaction tests (test/Benchmarks/Transactions + Orleans.Transactions
+test tier): multi-grain atomicity, abort-on-failure rollback, conflict
+serialization, nested scopes, persistence across deactivation."""
+
+import asyncio
+
+import pytest
+
+from orleans_tpu.core.errors import TransactionAbortedError
+from orleans_tpu.runtime import ClusterClient, InProcFabric, SiloBuilder
+from orleans_tpu.storage import MemoryStorage
+from orleans_tpu.transactions import (
+    TransactionalGrain,
+    TransactionalState,
+    add_transactions,
+    transactional,
+)
+
+
+class AccountGrain(TransactionalGrain):
+    def __init__(self):
+        self.balance = TransactionalState("balance", default=100)
+
+    @transactional
+    async def deposit(self, amount):
+        v = await self.balance.get()
+        await self.balance.set(v + amount)
+
+    @transactional
+    async def withdraw(self, amount):
+        v = await self.balance.get()
+        if v < amount:
+            raise ValueError("insufficient funds")
+        await self.balance.set(v - amount)
+
+    async def get_balance(self):
+        return await self.balance.get()
+
+    async def die(self):
+        self.deactivate_on_idle()
+
+
+class BankGrain(TransactionalGrain):
+    """Coordinator grain: multi-grain atomic transfer."""
+
+    @transactional
+    async def transfer(self, src, dst, amount, fail_after_debit=False):
+        a = self.get_grain(AccountGrain, src)
+        b = self.get_grain(AccountGrain, dst)
+        await a.withdraw(amount)
+        if fail_after_debit:
+            raise RuntimeError("boom mid-transfer")
+        await b.deposit(amount)
+
+    @transactional
+    async def slow_double_read(self, src, dst, gate_key):
+        """Reads both accounts, then waits on a gate before writing —
+        lets the test force a conflicting interleaved commit."""
+        a = self.get_grain(AccountGrain, src)
+        b = self.get_grain(AccountGrain, dst)
+        va = await a.get_balance_in_txn()
+        vb = await b.get_balance_in_txn()
+        await asyncio.sleep(0.3)  # window for the rival txn to commit
+        await a.set_in_txn(va + 1)
+        await b.set_in_txn(vb + 1)
+
+
+# give AccountGrain txn-scoped read/write entry points for the conflict test
+async def get_balance_in_txn(self):
+    return await self.balance.get()
+
+
+async def set_in_txn(self, v):
+    await self.balance.set(v)
+
+
+AccountGrain.get_balance_in_txn = get_balance_in_txn
+AccountGrain.set_in_txn = set_in_txn
+
+
+async def start_cluster(n=2, storage=None):
+    fabric = InProcFabric()
+    storage = storage or MemoryStorage()
+    silos = []
+    for i in range(n):
+        b = (SiloBuilder().with_name(f"t{i}").with_fabric(fabric)
+             .add_grains(AccountGrain, BankGrain)
+             .with_storage("Default", storage)
+             .with_config(response_timeout=5.0))
+        add_transactions(b)
+        silo = b.build()
+        await silo.start()
+        silos.append(silo)
+    client = await ClusterClient(fabric).connect()
+    return fabric, silos, client
+
+
+async def stop_all(silos, client):
+    await client.close_async()
+    for s in silos:
+        if s.status not in ("Stopped", "Dead"):
+            await s.stop()
+
+
+async def test_single_grain_commit():
+    fabric, silos, client = await start_cluster()
+    try:
+        acct = client.get_grain(AccountGrain, "a1")
+        await acct.deposit(50)
+        assert await acct.get_balance() == 150
+    finally:
+        await stop_all(silos, client)
+
+
+async def test_multi_grain_atomic_transfer():
+    fabric, silos, client = await start_cluster()
+    try:
+        bank = client.get_grain(BankGrain, "bank")
+        await bank.transfer("src1", "dst1", 30)
+        assert await client.get_grain(AccountGrain, "src1").get_balance() == 70
+        assert await client.get_grain(AccountGrain, "dst1").get_balance() == 130
+    finally:
+        await stop_all(silos, client)
+
+
+async def test_failure_mid_transaction_rolls_back_all():
+    fabric, silos, client = await start_cluster()
+    try:
+        bank = client.get_grain(BankGrain, "bank2")
+        with pytest.raises(RuntimeError, match="boom"):
+            await bank.transfer("src2", "dst2", 30, fail_after_debit=True)
+        # the debit on src2 must NOT be visible: nothing committed
+        assert await client.get_grain(AccountGrain, "src2").get_balance() == 100
+        assert await client.get_grain(AccountGrain, "dst2").get_balance() == 100
+    finally:
+        await stop_all(silos, client)
+
+
+async def test_insufficient_funds_aborts_cleanly():
+    fabric, silos, client = await start_cluster()
+    try:
+        bank = client.get_grain(BankGrain, "bank3")
+        with pytest.raises(ValueError):
+            await bank.transfer("src3", "dst3", 1000)
+        assert await client.get_grain(AccountGrain, "src3").get_balance() == 100
+        assert await client.get_grain(AccountGrain, "dst3").get_balance() == 100
+    finally:
+        await stop_all(silos, client)
+
+
+async def test_conflicting_transactions_serialize():
+    """Optimistic validation: a transaction that read stale versions must
+    abort when a rival commits first."""
+    fabric, silos, client = await start_cluster()
+    try:
+        bank = client.get_grain(BankGrain, "bank4")
+        rival_bank = client.get_grain(BankGrain, "bank4-rival")
+        slow = asyncio.ensure_future(
+            bank.slow_double_read("src4", "dst4", "g"))
+        await asyncio.sleep(0.1)  # slow txn has read both balances
+        await rival_bank.transfer("src4", "dst4", 10)  # rival commits
+        with pytest.raises(TransactionAbortedError):
+            await slow
+        # rival's effects intact, slow txn fully discarded
+        assert await client.get_grain(AccountGrain, "src4").get_balance() == 90
+        assert await client.get_grain(AccountGrain, "dst4").get_balance() == 110
+    finally:
+        await stop_all(silos, client)
+
+
+async def test_committed_state_survives_deactivation():
+    storage = MemoryStorage()
+    fabric, silos, client = await start_cluster(storage=storage)
+    try:
+        acct = client.get_grain(AccountGrain, "a5")
+        await acct.deposit(25)
+        await acct.die()
+        await asyncio.sleep(0.1)
+        assert await acct.get_balance() == 125  # re-read from storage
+    finally:
+        await stop_all(silos, client)
+
+
+async def test_nested_required_joins_ambient_scope():
+    fabric, silos, client = await start_cluster()
+    try:
+        # BankGrain.transfer is @transactional and calls AccountGrain's
+        # @transactional methods — they must join the same scope: a failure
+        # in the OUTER scope after inner "commits" still rolls everything
+        # back (verified by test_failure_mid_transaction_rolls_back_all);
+        # here verify the happy path commits exactly once.
+        bank = client.get_grain(BankGrain, "bank6")
+        await bank.transfer("src6", "dst6", 10)
+        await bank.transfer("src6", "dst6", 10)
+        assert await client.get_grain(AccountGrain, "src6").get_balance() == 80
+        assert await client.get_grain(AccountGrain, "dst6").get_balance() == 120
+    finally:
+        await stop_all(silos, client)
