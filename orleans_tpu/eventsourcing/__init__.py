@@ -1,0 +1,15 @@
+"""Event sourcing / log-consistency (reference src/Orleans.EventSourcing/)."""
+
+from .journaled import (
+    CustomStorageAdaptor,
+    JournaledGrain,
+    LogStorageAdaptor,
+    LogViewAdaptor,
+    StateStorageAdaptor,
+    log_consistency,
+)
+
+__all__ = [
+    "JournaledGrain", "log_consistency", "LogViewAdaptor",
+    "LogStorageAdaptor", "StateStorageAdaptor", "CustomStorageAdaptor",
+]

@@ -1,0 +1,253 @@
+"""JournaledGrain: grain state as a fold over an event log.
+
+Re-design of /root/reference/src/Orleans.EventSourcing/:
+``JournaledGrain.cs:18,40`` (RaiseEvent/ConfirmEvents, TentativeState vs
+confirmed State, TransitionState), the three ``ILogViewAdaptor`` providers —
+``LogStorage/LogViewAdaptor.cs:389`` (full event log persisted),
+``StateStorage/LogViewAdaptor.cs:362`` (snapshot + version),
+``CustomStorage/LogViewAdaptor.cs:378`` (user-defined read/apply) — and the
+CAS-retry write loop of ``Common/PrimaryBasedLogViewAdaptor.cs:907`` (on
+etag conflict: reload the primary, replay pending entries, write again).
+Multi-cluster notification tracking is a design hook (``notify``), not
+implemented (SURVEY §2.4: geo replication out of minimum scope).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import TYPE_CHECKING, Any
+
+from ..core.errors import InconsistentStateError, OrleansError
+from ..core.serialization import deep_copy
+from ..runtime.grain import Grain
+
+if TYPE_CHECKING:
+    pass
+
+log = logging.getLogger("orleans.eventsourcing")
+
+__all__ = ["JournaledGrain", "log_consistency", "LogViewAdaptor",
+           "LogStorageAdaptor", "StateStorageAdaptor", "CustomStorageAdaptor"]
+
+MAX_WRITE_RETRIES = 16
+
+
+class LogViewAdaptor:
+    """Consistency-provider contract (ILogViewAdaptor): load the confirmed
+    view, append confirmed events."""
+
+    def __init__(self, storage_name: str = "Default"):
+        self.storage_name = storage_name
+
+    def _provider(self, grain: "JournaledGrain"):
+        provider = grain._activation.runtime.storage_manager.get(
+            self.storage_name)
+        if provider is None:
+            raise OrleansError(
+                f"no storage provider {self.storage_name!r} for journal")
+        return provider
+
+    async def load(self, grain: "JournaledGrain") -> tuple[Any, int]:
+        raise NotImplementedError
+
+    async def append(self, grain: "JournaledGrain", events: list
+                     ) -> tuple[Any, int]:
+        """Persist ``events``; returns (new confirmed state, new version).
+        Must be CAS-safe against concurrent writers (duplicate activation
+        races): conflict → reload + replay + retry."""
+        raise NotImplementedError
+
+    def notify(self, grain: "JournaledGrain", events: list) -> None:
+        """Multi-cluster notification hook (notification tracking in
+        PrimaryBasedLogViewAdaptor) — no-op in single-cluster scope."""
+
+
+class LogStorageAdaptor(LogViewAdaptor):
+    """Persists the complete event log; the view is a fold."""
+
+    def _key(self, grain) -> str:
+        return f"journal-log:{type(grain).__name__}"
+
+    async def load(self, grain):
+        provider = self._provider(grain)
+        data, etag = await provider.read(self._key(grain), grain.grain_id)
+        grain.__journal_etag__ = etag
+        events = data["log"] if data else []
+        state = grain.initial_state()
+        for e in events:
+            state = grain.apply_event(state, e)
+        return state, len(events)
+
+    async def append(self, grain, events):
+        provider = self._provider(grain)
+        for _ in range(MAX_WRITE_RETRIES):
+            data, etag = await provider.read(self._key(grain), grain.grain_id)
+            logged = data["log"] if data else []
+            try:
+                new_etag = await provider.write(
+                    self._key(grain), grain.grain_id,
+                    {"log": logged + list(events)}, etag=etag)
+            except InconsistentStateError:
+                continue  # raced another writer: reload + retry
+            grain.__journal_etag__ = new_etag
+            state = grain.initial_state()
+            for e in logged + list(events):
+                state = grain.apply_event(state, e)
+            return state, len(logged) + len(events)
+        raise OrleansError("journal append: CAS retry exhausted")
+
+
+class StateStorageAdaptor(LogViewAdaptor):
+    """Persists (snapshot, version) only — events are not retained."""
+
+    def _key(self, grain) -> str:
+        return f"journal-state:{type(grain).__name__}"
+
+    async def load(self, grain):
+        provider = self._provider(grain)
+        data, etag = await provider.read(self._key(grain), grain.grain_id)
+        grain.__journal_etag__ = etag
+        if data is None:
+            return grain.initial_state(), 0
+        return data["snapshot"], data["version"]
+
+    async def append(self, grain, events):
+        provider = self._provider(grain)
+        for _ in range(MAX_WRITE_RETRIES):
+            data, etag = await provider.read(self._key(grain), grain.grain_id)
+            if data is None:
+                state, version = grain.initial_state(), 0
+            else:
+                state, version = data["snapshot"], data["version"]
+            for e in events:
+                state = grain.apply_event(state, e)
+            version += len(events)
+            try:
+                new_etag = await provider.write(
+                    self._key(grain), grain.grain_id,
+                    {"snapshot": state, "version": version}, etag=etag)
+            except InconsistentStateError:
+                continue
+            grain.__journal_etag__ = new_etag
+            return state, version
+        raise OrleansError("journal snapshot write: CAS retry exhausted")
+
+
+class CustomStorageAdaptor(LogViewAdaptor):
+    """Delegates persistence to the grain (ICustomStorageInterface):
+    ``read_state_from_storage() -> (state, version)`` and
+    ``apply_updates_to_storage(events, expected_version) -> bool``."""
+
+    async def load(self, grain):
+        return await grain.read_state_from_storage()
+
+    async def append(self, grain, events):
+        for _ in range(MAX_WRITE_RETRIES):
+            ok = await grain.apply_updates_to_storage(
+                list(events), grain.version)
+            if ok:
+                state = grain._confirmed
+                for e in events:
+                    state = grain.apply_event(state, e)
+                return state, grain.version + len(events)
+            # version conflict: reload and retry on top of the new view
+            state, version = await grain.read_state_from_storage()
+            grain._confirmed, grain._version = state, version
+        raise OrleansError("custom-storage append: retry exhausted")
+
+
+_ADAPTORS = {
+    "log_storage": LogStorageAdaptor,
+    "state_storage": StateStorageAdaptor,
+    "custom": CustomStorageAdaptor,
+}
+
+
+def log_consistency(provider: str, storage_name: str = "Default"):
+    """Class decorator choosing the consistency provider
+    ([LogConsistencyProvider] attribute analog)."""
+    if provider not in _ADAPTORS:
+        raise ValueError(f"unknown log-consistency provider {provider!r}; "
+                         f"choose from {sorted(_ADAPTORS)}")
+
+    def deco(cls: type) -> type:
+        cls.__log_consistency__ = (provider, storage_name)
+        return cls
+
+    return deco
+
+
+class JournaledGrain(Grain):
+    """Event-sourced grain base (JournaledGrain<TState,TEvent>).
+
+    Subclasses override ``initial_state()`` and ``apply_event(state, event)``
+    (the TransitionState hook) and call ``raise_event``/``confirm_events``.
+    """
+
+    __log_consistency__ = ("log_storage", "Default")
+
+    # -- user surface ----------------------------------------------------
+    def initial_state(self) -> Any:
+        return {}
+
+    def apply_event(self, state: Any, event: Any) -> Any:
+        """Default transition: events are dicts merged into a dict state
+        (override for real domains)."""
+        merged = dict(state)
+        merged.update(event)
+        return merged
+
+    def raise_event(self, event: Any) -> None:
+        """Queue an event (RaiseEvent): reflected in tentative_state now,
+        durable after confirm_events."""
+        self._pending.append(deep_copy(event))
+
+    def raise_events(self, events: list) -> None:
+        for e in events:
+            self.raise_event(e)
+
+    async def confirm_events(self) -> None:
+        """Persist all pending events (ConfirmEvents)."""
+        if not self._pending:
+            return
+        batch, self._pending = self._pending, []
+        try:
+            state, version = await self._adaptor.append(self, batch)
+        except BaseException:
+            self._pending = batch + self._pending  # keep tentative view
+            raise
+        self._confirmed, self._version = state, version
+        self._adaptor.notify(self, batch)
+
+    @property
+    def state(self) -> Any:
+        """Confirmed view (State)."""
+        return self._confirmed
+
+    @property
+    def tentative_state(self) -> Any:
+        """Confirmed + unconfirmed events (TentativeState)."""
+        s = deep_copy(self._confirmed)
+        for e in self._pending:
+            s = self.apply_event(s, e)
+        return s
+
+    @property
+    def version(self) -> int:
+        """Confirmed version = number of confirmed events."""
+        return self._version
+
+    @property
+    def unconfirmed_events(self) -> list:
+        return list(self._pending)
+
+    async def refresh_now(self) -> None:
+        """Re-read the confirmed view from storage (RetrieveConfirmedState)."""
+        self._confirmed, self._version = await self._adaptor.load(self)
+
+    # -- lifecycle -------------------------------------------------------
+    async def on_activate(self) -> None:
+        provider, storage_name = type(self).__log_consistency__
+        self._adaptor = _ADAPTORS[provider](storage_name)
+        self._pending: list = []
+        self._confirmed, self._version = await self._adaptor.load(self)
