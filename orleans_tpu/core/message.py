@@ -87,7 +87,7 @@ class Message:
         "rejection_type", "rejection_info", "forward_count", "resend_count",
         "expires_at", "call_chain", "is_read_only", "is_always_interleave",
         "is_unordered", "immutable", "cache_invalidation", "request_context",
-        "is_new_placement", "transaction_info",
+        "is_new_placement", "transaction_info", "interface_version",
     )
 
     category: Category
@@ -117,6 +117,9 @@ class Message:
     request_context: dict | None
     is_new_placement: bool
     transaction_info: Any
+    # caller's compiled-against interface version (Runtime/Versions/
+    # enforcement at addressing, Dispatcher.cs:725-732)
+    interface_version: int
 
     # ------------------------------------------------------------------
     @property
@@ -154,6 +157,7 @@ class Message:
             request_context=None,
             is_new_placement=False,
             transaction_info=self.transaction_info,
+            interface_version=self.interface_version,
         )
 
 
@@ -175,6 +179,7 @@ def make_request(
     is_always_interleave: bool = False,
     immutable: bool = False,
     request_context: dict | None = None,
+    interface_version: int = 0,
 ) -> Message:
     """Request factory (``MessageFactory.CreateMessage``). Default 30 s expiry
     mirrors ``MessagingOptions.ResponseTimeout``."""
@@ -206,6 +211,7 @@ def make_request(
         request_context=request_context,
         is_new_placement=False,
         transaction_info=None,
+        interface_version=interface_version,
     )
 
 

@@ -47,9 +47,12 @@ class DirectoryTarget:
 
     async def dir_lookup_or_place(self, grain_id: GrainId,
                                   placement: str | None,
-                                  requester: SiloAddress):
+                                  requester: SiloAddress,
+                                  interface_name: str | None = None,
+                                  requested_version: int = 0):
         return self.locator.local_lookup_or_place(
-            grain_id, placement, requester)
+            grain_id, placement, requester, interface_name,
+            requested_version)
 
     async def dir_register(self, address: ActivationAddress):
         return self.locator.local_register(address)
@@ -80,6 +83,8 @@ class DistributedLocator:
             collections.OrderedDict()
         self.cache_size = silo.config.directory_cache_size
         self.placement = PlacementManager(load_of=self._load_of)
+        from ..versions import VersionManager
+        self.versions = VersionManager(silo)
         self.target = DirectoryTarget(self)
         self.target_id = silo.register_system_target(
             self.target, DIRECTORY_TARGET)
@@ -127,11 +132,13 @@ class DistributedLocator:
         owner = self.ring.owner(grain_id.uniform_hash) or self.silo.silo_address
         if owner == self.silo.silo_address:
             silo, is_new = self.local_lookup_or_place(
-                grain_id, placement_name, self.silo.silo_address)
+                grain_id, placement_name, self.silo.silo_address,
+                msg.interface_name, msg.interface_version)
         else:
             silo, is_new = await self._target_ref(
                 owner, "dir_lookup_or_place", grain_id, placement_name,
-                self.silo.silo_address)
+                self.silo.silo_address, msg.interface_name,
+                msg.interface_version)
         msg.is_new_placement = is_new
         self._cache_put(grain_id, silo)
         return silo
@@ -172,12 +179,28 @@ class DistributedLocator:
     # ------------------------------------------------------------------
     def local_lookup_or_place(self, grain_id: GrainId,
                               placement_name: str | None,
-                              requester: SiloAddress):
+                              requester: SiloAddress,
+                              interface_name: str | None = None,
+                              requested_version: int = 0):
         reg = self.partition.get(grain_id)
         if reg is not None and reg.silo in self.alive_set:
             return reg.silo, False
         director = self.placement.director_by_name(placement_name)
-        silo = director.place(grain_id, requester, self._alive())
+        candidates = self._alive()
+        if interface_name is not None:
+            # version gate at addressing time (Dispatcher.cs:725-732)
+            compat = self.versions.compatible_silos(
+                interface_name, requested_version, candidates)
+            if compat:
+                candidates = compat
+            elif any(self.versions.available_version(s, interface_name)
+                     is not None for s in candidates):
+                from ..core.errors import OrleansError
+                raise OrleansError(
+                    f"no silo hosts a version of {interface_name} compatible "
+                    f"with requested v{requested_version}")
+            # else: no version info reachable (cross-process) — don't gate
+        silo = director.place(grain_id, requester, candidates)
         return silo, True
 
     def local_register(self, address: ActivationAddress) -> ActivationAddress:

@@ -1,0 +1,22 @@
+"""Multi-cluster / geo federation (reference src/Orleans.Runtime/
+MultiClusterNetwork/ + GrainDirectory/MultiClusterRegistration/).
+
+SURVEY §2.4 scopes geo replication as a design hook: this package carries
+the working gossip oracle + the GSI ownership protocol over an abstract
+cross-cluster channel; DCN transport binding is deferred."""
+
+from .gossip import (
+    InMemoryGossipChannel,
+    MultiClusterData,
+    MultiClusterOracle,
+    add_multicluster,
+)
+from .gsi import (
+    GsiState,
+    GlobalSingleInstanceRegistrar,
+)
+
+__all__ = [
+    "MultiClusterData", "InMemoryGossipChannel", "MultiClusterOracle",
+    "add_multicluster", "GsiState", "GlobalSingleInstanceRegistrar",
+]

@@ -1,0 +1,134 @@
+"""Multi-cluster gossip: configuration + gateway exchange between clusters.
+
+Re-design of /root/reference/src/Orleans.Runtime/MultiClusterNetwork/
+MultiClusterOracle.cs:12 + MultiClusterGossipChannelFactory.cs: each cluster
+periodically merges its local view (its own gateways, stamped) with one or
+more gossip channels (Azure-table-backed in the reference; an in-memory
+shared object here) — last-writer-wins per cluster key.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING
+
+from ..core.ids import SiloAddress
+
+if TYPE_CHECKING:
+    from ..runtime.silo import Silo
+
+log = logging.getLogger("orleans.multicluster")
+
+__all__ = ["MultiClusterData", "InMemoryGossipChannel", "MultiClusterOracle"]
+
+
+@dataclass
+class MultiClusterData:
+    """Gossiped payload (MultiClusterData): per-cluster gateway lists +
+    stamps; merge = per-key newest stamp wins."""
+
+    clusters: dict[str, dict] = field(default_factory=dict)
+    # clusters[cluster_id] = {"gateways": [SiloAddress], "stamp": float}
+
+    def merge(self, other: "MultiClusterData") -> bool:
+        changed = False
+        for cid, entry in other.clusters.items():
+            mine = self.clusters.get(cid)
+            if mine is None or entry["stamp"] > mine["stamp"]:
+                self.clusters[cid] = dict(entry)
+                changed = True
+        return changed
+
+    def copy(self) -> "MultiClusterData":
+        return MultiClusterData({k: dict(v) for k, v in self.clusters.items()})
+
+
+class GossipChannel:
+    """Shared gossip substrate (IGossipChannel)."""
+
+    async def publish(self, data: MultiClusterData) -> None:
+        raise NotImplementedError
+
+    async def read(self) -> MultiClusterData:
+        raise NotImplementedError
+
+
+class InMemoryGossipChannel(GossipChannel):
+    """Dev/test channel: one shared object across clusters (the Azure-table
+    stand-in)."""
+
+    def __init__(self) -> None:
+        self._data = MultiClusterData()
+
+    async def publish(self, data: MultiClusterData) -> None:
+        self._data.merge(data)
+
+    async def read(self) -> MultiClusterData:
+        return self._data.copy()
+
+
+class MultiClusterOracle:
+    """Per-silo gossip oracle; silos of one cluster share a cluster_id."""
+
+    def __init__(self, silo: "Silo", cluster_id: str,
+                 channels: list[GossipChannel],
+                 gossip_period: float = 1.0):
+        self.silo = silo
+        self.cluster_id = cluster_id
+        self.channels = channels
+        self.gossip_period = gossip_period
+        self.data = MultiClusterData()
+        self._task: asyncio.Task | None = None
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self.gossip_once()
+            except Exception:  # noqa: BLE001
+                log.exception("gossip round failed")
+            await asyncio.sleep(self.gossip_period)
+
+    async def gossip_once(self) -> None:
+        """One round: stamp our view, merge every channel, publish back."""
+        self.data.clusters[self.cluster_id] = {
+            "gateways": list(self.silo.locator.alive_list),
+            "stamp": time.time(),
+        }
+        for ch in self.channels:
+            remote = await ch.read()
+            self.data.merge(remote)
+            await ch.publish(self.data)
+
+    # -- queries ---------------------------------------------------------
+    def known_clusters(self) -> list[str]:
+        return sorted(self.data.clusters)
+
+    def gateways_of(self, cluster_id: str) -> list[SiloAddress]:
+        entry = self.data.clusters.get(cluster_id)
+        return list(entry["gateways"]) if entry else []
+
+
+def add_multicluster(builder, cluster_id: str, channels: list,
+                     gossip_period: float = 1.0):
+    """Install a gossip oracle on a SiloBuilder (silo.multicluster)."""
+
+    def install(silo) -> None:
+        oracle = MultiClusterOracle(silo, cluster_id, channels, gossip_period)
+        silo.multicluster = oracle
+        from ..runtime.silo import ServiceLifecycleStage
+        silo.subscribe_lifecycle(
+            ServiceLifecycleStage.RUNTIME_GRAIN_SERVICES,
+            oracle.start, oracle.stop)
+
+    return builder.configure(install)

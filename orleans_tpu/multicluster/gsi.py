@@ -1,0 +1,113 @@
+"""Global-Single-Instance (GSI) registration protocol.
+
+Re-design of /root/reference/src/Orleans.Runtime/GrainDirectory/
+MultiClusterRegistration/: ``GlobalSingleInstanceRegistrar.cs`` +
+``ClusterGrainDirectory.cs:86-140`` — ownership states
+RequestedOwnership/Owned/Doubtful/Cached/RaceLoser with lexicographic race
+resolution, and ``GlobalSingleInstanceActivationMaintainer`` retrying
+Doubtful entries.
+
+The cross-cluster query is abstracted as ``peer_query(cluster_id, grain_id)
+-> (state, owner_cluster)``; in-proc multi-fabric tests bind it directly,
+a DCN deployment binds it to remote cluster-gateway calls.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass
+from enum import Enum
+from typing import Awaitable, Callable
+
+from ..core.ids import GrainId
+
+log = logging.getLogger("orleans.multicluster.gsi")
+
+__all__ = ["GsiState", "GsiEntry", "GlobalSingleInstanceRegistrar"]
+
+
+class GsiState(str, Enum):
+    """Ownership states (ActivationStatus in the reference protocol)."""
+
+    REQUESTED_OWNERSHIP = "RequestedOwnership"
+    OWNED = "Owned"
+    DOUBTFUL = "Doubtful"
+    CACHED = "Cached"
+    RACE_LOSER = "RaceLoser"
+
+
+@dataclass
+class GsiEntry:
+    grain_id: GrainId
+    state: GsiState
+    owner_cluster: str
+
+
+PeerQuery = Callable[[str, GrainId], Awaitable[tuple[GsiState | None, str | None]]]
+
+
+class GlobalSingleInstanceRegistrar:
+    """One per cluster: decides cluster-level ownership of grain ids."""
+
+    def __init__(self, cluster_id: str, known_clusters: Callable[[], list[str]],
+                 peer_query: PeerQuery):
+        self.cluster_id = cluster_id
+        self.known_clusters = known_clusters
+        self.peer_query = peer_query
+        self.entries: dict[GrainId, GsiEntry] = {}
+
+    def status_of(self, grain_id: GrainId) -> tuple[GsiState | None, str | None]:
+        """The remote-query surface (ClusterGrainDirectory.ProcessRequest)."""
+        e = self.entries.get(grain_id)
+        return (e.state, e.owner_cluster) if e else (None, None)
+
+    async def register(self, grain_id: GrainId) -> GsiEntry:
+        """Try to own ``grain_id`` globally (GSI protocol rounds):
+
+        1. mark RequestedOwnership locally;
+        2. query every other cluster;
+        3. any OWNED elsewhere → we become CACHED at that owner;
+           a concurrent RequestedOwnership elsewhere → lexicographically
+           smaller cluster id wins, loser becomes RACE_LOSER then CACHED;
+           peers unreachable → DOUBTFUL (owned-but-retry, maintainer job).
+        """
+        cur = self.entries.get(grain_id)
+        if cur is not None and cur.state in (GsiState.OWNED, GsiState.CACHED):
+            return cur
+        entry = GsiEntry(grain_id, GsiState.REQUESTED_OWNERSHIP,
+                         self.cluster_id)
+        self.entries[grain_id] = entry
+        peers = [c for c in self.known_clusters() if c != self.cluster_id]
+        unreachable = False
+        for peer in peers:
+            try:
+                state, owner = await self.peer_query(peer, grain_id)
+            except Exception:  # noqa: BLE001
+                unreachable = True
+                continue
+            if state == GsiState.OWNED:
+                entry.state = GsiState.CACHED
+                entry.owner_cluster = owner or peer
+                return entry
+            if state == GsiState.REQUESTED_OWNERSHIP:
+                # simultaneous race: lexicographic winner
+                if peer < self.cluster_id:
+                    entry.state = GsiState.RACE_LOSER
+                    entry.owner_cluster = peer
+                    # loser re-queries later; the winner transitions to OWNED
+                    return entry
+        entry.state = GsiState.DOUBTFUL if unreachable else GsiState.OWNED
+        entry.owner_cluster = self.cluster_id
+        return entry
+
+    async def retry_doubtful(self) -> None:
+        """GlobalSingleInstanceActivationMaintainer: re-run the protocol for
+        Doubtful and RaceLoser entries."""
+        for gid, e in list(self.entries.items()):
+            if e.state in (GsiState.DOUBTFUL, GsiState.RACE_LOSER):
+                del self.entries[gid]
+                await self.register(gid)
+
+    def unregister(self, grain_id: GrainId) -> None:
+        self.entries.pop(grain_id, None)

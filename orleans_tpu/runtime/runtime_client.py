@@ -105,6 +105,7 @@ class RuntimeClient:
             is_read_only=is_read_only,
             is_always_interleave=is_always_interleave,
             request_context=RequestContext.export(),
+            interface_version=getattr(grain_class, "__orleans_version__", 0),
         )
         return self._send(msg, is_one_way, timeout)
 

@@ -1,0 +1,9 @@
+"""Interface versioning (reference src/Orleans.Runtime/Versions/)."""
+
+from .manager import (
+    VersionManager,
+    grain_version,
+    version_of,
+)
+
+__all__ = ["grain_version", "version_of", "VersionManager"]

@@ -1,0 +1,123 @@
+"""Interface versioning: compat directors + selectors gating placement.
+
+Re-design of /root/reference/src/Orleans.Runtime/Versions/: per-interface
+version (codegen [Version(n)] attribute → ``@grain_version(n)`` here),
+compatibility directors (``Compatibility/BackwardCompatilityDirector.cs``,
+``StrictVersionCompatibilityDirector.cs``, ``AllVersionsCompatibilityDirector.cs``)
+and selectors (``Selector/MinimumVersionSelector.cs``, ``LatestVersionSelector``,
+``AllCompatibleVersions``), enforced where the reference enforces at
+addressing time (``Dispatcher.cs:725-732``): the directory owner filters
+placement candidates to silos hosting a compatible version
+(``CachedVersionSelectorManager.cs``).
+
+The cluster version map: in-proc fabrics read peer registries directly (the
+same shortcut the load publisher uses); cross-host deployments would ride
+the TypeManager exchange.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Callable
+
+from ..core.ids import SiloAddress
+
+if TYPE_CHECKING:
+    from ..runtime.silo import Silo
+
+__all__ = ["grain_version", "version_of", "VersionManager"]
+
+
+def grain_version(version: int) -> Callable[[type], type]:
+    """Class decorator declaring the grain interface version ([Version(n)])."""
+
+    def deco(cls: type) -> type:
+        cls.__orleans_version__ = version
+        return cls
+
+    return deco
+
+
+def version_of(cls: type | None) -> int:
+    return getattr(cls, "__orleans_version__", 0) if cls else 0
+
+
+# -- compatibility directors -------------------------------------------------
+
+def backward_compatible(requested: int, available: int) -> bool:
+    """BackwardCompatilityDirector: a silo can serve any request compiled
+    against its version or older."""
+    return available >= requested
+
+
+def strict_compatible(requested: int, available: int) -> bool:
+    """StrictVersionCompatibilityDirector: exact match only."""
+    return available == requested
+
+
+def all_compatible(requested: int, available: int) -> bool:
+    """AllVersionsCompatibilityDirector: anything goes."""
+    return True
+
+
+_COMPAT = {
+    "backward": backward_compatible,
+    "strict": strict_compatible,
+    "all": all_compatible,
+}
+
+_SELECTORS = ("all_compatible", "latest_version", "minimum_version")
+
+
+class VersionManager:
+    """Per-silo versioning policy: filter placement candidates for an
+    interface+requested-version pair."""
+
+    def __init__(self, silo: "Silo", compat: str = "backward",
+                 selector: str = "all_compatible"):
+        if compat not in _COMPAT:
+            raise ValueError(f"unknown compatibility strategy {compat!r}")
+        if selector not in _SELECTORS:
+            raise ValueError(f"unknown version selector {selector!r}")
+        self.silo = silo
+        self.compat = compat
+        self.selector = selector
+
+    def set_strategy(self, compat: str | None = None,
+                     selector: str | None = None) -> None:
+        """Runtime strategy update (ManagementGrain.SetCompatibilityStrategy)."""
+        if compat is not None:
+            if compat not in _COMPAT:
+                raise ValueError(f"unknown compatibility strategy {compat!r}")
+            self.compat = compat
+        if selector is not None:
+            if selector not in _SELECTORS:
+                raise ValueError(f"unknown version selector {selector!r}")
+            self.selector = selector
+
+    def available_version(self, silo: SiloAddress,
+                          interface_name: str) -> int | None:
+        """Version of ``interface_name`` hosted by ``silo`` (None = class not
+        registered there)."""
+        peer = self.silo.fabric.silos.get(silo)
+        if peer is None:
+            return None
+        cls = peer.registry.resolve(interface_name)
+        return None if cls is None else version_of(cls)
+
+    def compatible_silos(self, interface_name: str, requested: int,
+                         candidates: list[SiloAddress]) -> list[SiloAddress]:
+        ok = _COMPAT[self.compat]
+        versions = {}
+        for s in candidates:
+            v = self.available_version(s, interface_name)
+            if v is not None and ok(requested, v):
+                versions[s] = v
+        if not versions:
+            return []
+        if self.selector == "latest_version":
+            pick = max(versions.values())
+        elif self.selector == "minimum_version":
+            pick = min(versions.values())
+        else:
+            return list(versions)
+        return [s for s, v in versions.items() if v == pick]
