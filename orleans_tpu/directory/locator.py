@@ -91,9 +91,14 @@ class DistributedLocator:
 
     # ------------------------------------------------------------------
     def _load_of(self, silo: SiloAddress) -> int:
-        """Activation-count stats feed. In-proc fabric: read directly (the
-        DeploymentLoadPublisher shortcut); cross-host deployments override
-        via the management stats exchange."""
+        """Activation-count stats feed: prefer the DeploymentLoadPublisher
+        view (cross-host capable); fall back to the in-proc fabric shortcut
+        of reading the peer catalog directly."""
+        publisher = getattr(self.silo, "load_publisher", None)
+        if publisher is not None:
+            v = publisher.load_of(silo)
+            if v is not None:
+                return v
         s = self.silo.fabric.silos.get(silo)
         return s.catalog.activation_count() if s is not None else 1 << 30
 

@@ -1,0 +1,101 @@
+"""SiloControl: the per-silo management command surface.
+
+Re-design of /root/reference/src/Orleans.Runtime/Silo/SiloControl.cs:214 —
+a system target exposing runtime stats, activation enumeration/counts,
+forced collection, version-strategy updates, and the activation debug dump
+(Silo.GetDebugDump, Silo.cs:825-856). ManagementGrain fans out to these.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from ..runtime.silo import Silo
+
+SILO_CONTROL = "SiloControl"
+
+__all__ = ["SiloControl", "add_management"]
+
+
+class SiloControl:
+    """Per-silo control system target."""
+
+    _activation = None
+
+    def __init__(self, silo: "Silo"):
+        self.silo = silo
+
+    async def ctl_runtime_stats(self) -> dict:
+        """Per-silo stats snapshot (SiloRuntimeStatistics)."""
+        return {
+            "silo": str(self.silo.silo_address),
+            "status": self.silo.status,
+            "activation_count": self.silo.catalog.activation_count(),
+            "stats": self.silo.stats.snapshot(),
+        }
+
+    async def ctl_activation_count(self) -> int:
+        return self.silo.catalog.activation_count()
+
+    async def ctl_grain_stats(self) -> dict[str, int]:
+        """Activation count per grain class (GetSimpleGrainStatistics)."""
+        counts: dict[str, int] = {}
+        for act in self.silo.catalog.by_activation.values():
+            if act.grain_id.is_system_target():
+                continue  # app grains only, matching GetSimpleGrainStatistics
+            name = act.grain_class.__name__ if act.grain_class else "?"
+            counts[name] = counts.get(name, 0) + 1
+        return counts
+
+    async def ctl_force_collection(self, age_seconds: float = 0.0) -> int:
+        """Deactivate idle activations older than ``age_seconds``
+        (ForceActivationCollection)."""
+        return await self.silo.catalog.collect_idle(max_age=age_seconds)
+
+    async def ctl_debug_dump(self) -> list[dict]:
+        """All activations with mailbox depth + state (GetDebugDump)."""
+        out = []
+        for act in self.silo.catalog.by_activation.values():
+            out.append({
+                "grain": str(act.grain_id),
+                "activation": str(act.activation_id),
+                "class": act.grain_class.__name__ if act.grain_class else "?",
+                "state": str(act.state),
+                "waiting": len(act.waiting),
+                "running": len(act.running),
+            })
+        return out
+
+    async def ctl_set_compatibility_strategy(
+            self, compat: str | None = None,
+            selector: str | None = None) -> bool:
+        """SetCompatibilityStrategy / SetSelectorStrategy."""
+        self.silo.locator.versions.set_strategy(compat, selector)
+        return True
+
+    async def ctl_cache_invalidate(self, grain_id) -> bool:
+        self.silo.locator.invalidate_cache(grain_id)
+        return True
+
+
+def add_management(builder):
+    """Install SiloControl + the management grain + the load publisher on a
+    SiloBuilder."""
+    from .grain import ManagementGrain
+    from .load_publisher import DeploymentLoadPublisher
+
+    builder.add_grains(ManagementGrain)
+
+    def install(silo) -> None:
+        control = SiloControl(silo)
+        silo.register_system_target(control, SILO_CONTROL)
+        silo.silo_control = control
+        publisher = DeploymentLoadPublisher(silo)
+        silo.load_publisher = publisher
+        from ..runtime.silo import ServiceLifecycleStage
+        silo.subscribe_lifecycle(
+            ServiceLifecycleStage.RUNTIME_GRAIN_SERVICES,
+            publisher.start, publisher.stop)
+
+    return builder.configure(install)

@@ -1,0 +1,98 @@
+"""ManagementGrain: cluster-wide queries and controls.
+
+Re-design of /root/reference/src/Orleans.Runtime/Core/ManagementGrain.cs:52-424
+(GetHosts, GetRuntimeStatistics, GetSimpleGrainStatistics, GetTotalActivationCount,
+ForceActivationCollection, SetCompatibilityStrategy, FindLaggingSilos :424) —
+an ordinary grain fanning out to each silo's SiloControl system target.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..core.ids import GrainId, SiloAddress, type_code_of
+from ..core.message import Category
+from ..runtime.grain import Grain
+from .control import SILO_CONTROL, SiloControl
+
+__all__ = ["ManagementGrain"]
+
+
+class ManagementGrain(Grain):
+    """Singleton management grain (key 0 by convention)."""
+
+    # -- fan-out helper --------------------------------------------------
+    def _silos(self) -> list[SiloAddress]:
+        return list(self._activation.runtime.locator.alive_list)
+
+    def _control(self, silo: SiloAddress, method: str, *args, **kwargs):
+        runtime = self._activation.runtime
+        gid = GrainId.system_target(type_code_of(SILO_CONTROL), silo)
+        return runtime.runtime_client.send_request(
+            target_grain=gid, grain_class=SiloControl,
+            interface_name=SILO_CONTROL, method_name=method,
+            args=args, kwargs=kwargs, target_silo=silo,
+            category=Category.SYSTEM)
+
+    async def _fan_out(self, method: str, *args, **kwargs) -> dict:
+        silos = self._silos()
+        results = await asyncio.gather(
+            *(self._control(s, method, *args, **kwargs) for s in silos),
+            return_exceptions=True)
+        return {str(s): r for s, r in zip(silos, results)
+                if not isinstance(r, BaseException)}
+
+    # -- queries (ManagementGrain.cs:52-231) ------------------------------
+    async def get_hosts(self) -> dict[str, str]:
+        """Silo → status map; reads the membership oracle when installed."""
+        runtime = self._activation.runtime
+        if runtime.membership is not None:
+            out = {str(a): "Active" for a in runtime.membership.active}
+            out.update({str(a): "Dead" for a in runtime.membership.dead})
+            return out
+        return {str(a): "Active" for a in runtime.locator.alive_list}
+
+    async def get_runtime_statistics(self) -> dict:
+        return await self._fan_out("ctl_runtime_stats")
+
+    async def get_simple_grain_statistics(self) -> dict[str, int]:
+        """Cluster-wide activation count per grain class."""
+        per_silo = await self._fan_out("ctl_grain_stats")
+        totals: dict[str, int] = {}
+        for counts in per_silo.values():
+            for name, n in counts.items():
+                totals[name] = totals.get(name, 0) + n
+        return totals
+
+    async def get_total_activation_count(self) -> int:
+        per_silo = await self._fan_out("ctl_activation_count")
+        return sum(per_silo.values())
+
+    async def get_debug_dump(self) -> dict:
+        return await self._fan_out("ctl_debug_dump")
+
+    # -- controls ---------------------------------------------------------
+    async def force_activation_collection(self, age_seconds: float = 0.0
+                                          ) -> int:
+        per_silo = await self._fan_out("ctl_force_collection", age_seconds)
+        return sum(per_silo.values())
+
+    async def set_compatibility_strategy(self, compat: str | None = None,
+                                         selector: str | None = None) -> None:
+        await self._fan_out("ctl_set_compatibility_strategy", compat, selector)
+
+    async def find_lagging_silos(self, threshold: float = 0.5) -> list[str]:
+        """Silos whose control surface responds slower than ``threshold``
+        seconds (FindLaggingSilos :424)."""
+        import time
+        lagging = []
+        for s in self._silos():
+            t0 = time.monotonic()
+            try:
+                await self._control(s, "ctl_activation_count")
+            except Exception:  # noqa: BLE001 — unreachable counts as lagging
+                lagging.append(str(s))
+                continue
+            if time.monotonic() - t0 > threshold:
+                lagging.append(str(s))
+        return lagging

@@ -239,6 +239,20 @@ class Catalog:
                 if act.idle_for() > age_limit:
                     self.schedule_deactivation(act)
 
+    async def collect_idle(self, max_age: float = 0.0) -> int:
+        """Forced collection (ManagementGrain.ForceActivationCollection):
+        deactivate idle application activations idle ≥ ``max_age``."""
+        n = 0
+        for act in list(self.by_activation.values()):
+            if act.grain_id.is_system_target():
+                continue
+            if act.state != ActivationState.VALID or not act.is_inactive:
+                continue
+            if act.idle_for() >= max_age:
+                await self._deactivate(act)
+                n += 1
+        return n
+
     # ------------------------------------------------------------------
     def activation_count(self) -> int:
         """Application activations (system targets excluded, matching the

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import TYPE_CHECKING
 
 from ..core.errors import GrainOverloadedError, NonExistentActivationError
@@ -109,6 +110,7 @@ class Dispatcher:
         OnActivationCompletedRequest → RunMessagePump)."""
         token_a = current_activation.set(activation)
         RequestContext.import_(msg.request_context)
+        t0 = time.monotonic()
         try:
             result = await self.invoke(activation, msg)
             if msg.direction == Direction.REQUEST:
@@ -121,6 +123,15 @@ class Dispatcher:
                               msg.interface_name, msg.method_name)
             self.silo.catalog.on_invoke_error(activation, e)
         finally:
+            # slow-turn detection (TurnWarningLengthThreshold,
+            # OrleansTaskScheduler.cs:26)
+            elapsed = time.monotonic() - t0
+            self.silo.stats.observe("scheduler.turn_length", elapsed)
+            if elapsed > self.silo.config.turn_warning_length:
+                self.silo.stats.increment("scheduler.long_turns")
+                log.warning("long turn %.3fs: %s.%s on %s", elapsed,
+                            msg.interface_name, msg.method_name,
+                            activation.grain_id)
             RequestContext.clear()
             current_activation.reset(token_a)
             activation.reset_running(msg)

@@ -69,6 +69,7 @@ class SiloConfig:
     membership_refresh_period: float = 5.0
     membership_vote_expiration: float = 10.0
     directory_cache_size: int = 100_000
+    turn_warning_length: float = 0.2  # TurnWarningLengthThreshold
 
 
 class GrainRegistry:
