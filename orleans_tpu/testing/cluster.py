@@ -1,0 +1,248 @@
+"""TestCluster: N silos + client in one process, individually killable.
+
+Re-design of /root/reference/src/Orleans.TestingHost/TestCluster.cs:29 +
+TestClusterBuilder.cs:14: the reference isolates silos in AppDomains so they
+can be killed/restarted independently (AppDomainSiloHandle.cs:14); here each
+silo is an independent object on one event loop and "kill" drops it from
+fabric routing with no goodbye (the same observable semantics: peers must
+detect the death via the membership protocol).
+
+Defaults: shared in-memory membership table with fast liveness config,
+shared MemoryStorage, management installed. Reminders / streams /
+transactions opt in via builder methods. ``kill_silo`` = ungraceful abort,
+``restart_silo`` re-hosts the same endpoint with a higher generation,
+``start_additional_silo`` grows the cluster — mirroring the TestCluster API
+used across the reference's liveness tests
+(test/Tester/MembershipTests/LivenessTests.cs:86-88).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Callable
+
+from ..core.ids import SiloAddress
+from ..management import add_management
+from ..membership import InMemoryMembershipTable, join_cluster
+from ..runtime import ClusterClient, InProcFabric, SiloBuilder
+from ..storage import MemoryStorage
+
+__all__ = ["TestClusterBuilder", "TestCluster"]
+
+FAST_LIVENESS = dict(
+    membership_probe_period=0.1,
+    membership_probe_timeout=0.15,
+    membership_missed_probes_limit=2,
+    membership_votes_needed=2,
+    membership_iam_alive_period=0.5,
+    membership_refresh_period=0.3,
+    membership_vote_expiration=5.0,
+    response_timeout=3.0,
+)
+
+
+class TestClusterBuilder:
+    """Fluent cluster factory (TestClusterBuilder.cs:14)."""
+
+    __test__ = False  # not a pytest collectible despite the name
+
+    def __init__(self, n_silos: int = 2):
+        self.n_silos = n_silos
+        self.grains: list[type] = []
+        self.storage: Any = None
+        self.membership_table: Any = None
+        self.with_membership = True
+        self.with_management = True
+        self.config: dict = dict(FAST_LIVENESS)
+        self._silo_configurators: list[Callable[[SiloBuilder], Any]] = []
+
+    def add_grains(self, *grain_classes: type) -> "TestClusterBuilder":
+        self.grains.extend(grain_classes)
+        return self
+
+    def with_storage(self, storage) -> "TestClusterBuilder":
+        self.storage = storage
+        return self
+
+    def with_config(self, **kw) -> "TestClusterBuilder":
+        self.config.update(kw)
+        return self
+
+    def without_membership(self) -> "TestClusterBuilder":
+        """Fabric-broadcast liveness only (no oracle) — fastest tests."""
+        self.with_membership = False
+        return self
+
+    def with_reminders(self, table=None) -> "TestClusterBuilder":
+        from ..reminders import InMemoryReminderTable, add_reminders
+        table = table or InMemoryReminderTable()
+
+        def cfg(b: SiloBuilder):
+            b.configure(lambda silo: add_reminders_post(silo))
+
+        # add_reminders must run pre-start but needs the silo object;
+        # register through a builder configurator
+        def add_reminders_post(silo):
+            add_reminders(silo, table, refresh_period=0.2)
+
+        self._silo_configurators.append(cfg)
+        return self
+
+    def with_sms_streams(self, name: str = "sms", **kw) -> "TestClusterBuilder":
+        from ..streams import add_sms_streams
+        self._silo_configurators.append(
+            lambda b: add_sms_streams(b, name, **kw))
+        return self
+
+    def with_persistent_streams(self, name: str = "queue", adapter=None,
+                                **kw) -> "TestClusterBuilder":
+        from ..streams import MemoryQueueAdapter, add_persistent_streams
+        adapter = adapter or MemoryQueueAdapter(n_queues=4)
+        self._shared_adapter = adapter
+        self._silo_configurators.append(
+            lambda b: add_persistent_streams(b, name, adapter,
+                                             pull_period=0.05, **kw))
+        return self
+
+    def with_transactions(self) -> "TestClusterBuilder":
+        from ..transactions import add_transactions
+        self._silo_configurators.append(add_transactions)
+        return self
+
+    def configure_silo(self, fn: Callable[[SiloBuilder], Any]
+                       ) -> "TestClusterBuilder":
+        self._silo_configurators.append(fn)
+        return self
+
+    def build(self) -> "TestCluster":
+        return TestCluster(self)
+
+
+class TestCluster:
+    """A deployed in-proc cluster (TestCluster.cs:29)."""
+
+    __test__ = False  # not a pytest collectible despite the name
+
+    def __init__(self, builder: TestClusterBuilder):
+        self.builder = builder
+        self.fabric = InProcFabric()
+        self.storage = builder.storage or MemoryStorage()
+        self.membership_table = (builder.membership_table
+                                 or InMemoryMembershipTable())
+        self.silos: list = []
+        self.client: ClusterClient | None = None
+        self._counter = 0
+
+    # -- deployment ------------------------------------------------------
+    async def deploy(self) -> "TestCluster":
+        for _ in range(self.builder.n_silos):
+            await self.start_additional_silo()
+        self.client = await ClusterClient(self.fabric).connect()
+        if self.builder.with_membership:
+            await self.wait_for_liveness()
+        return self
+
+    def _make_silo(self):
+        i = self._counter
+        self._counter += 1
+        b = (SiloBuilder().with_name(f"silo{i}").with_fabric(self.fabric)
+             .add_grains(*self.builder.grains)
+             .with_storage("Default", self.storage)
+             .with_config(**self.builder.config))
+        if self.builder.with_management:
+            add_management(b)
+        for cfg in self.builder._silo_configurators:
+            cfg(b)
+        silo = b.build()
+        if self.builder.with_membership:
+            join_cluster(silo, self.membership_table)
+        return silo
+
+    async def start_additional_silo(self):
+        """StartAdditionalSilo: elastic grow."""
+        silo = self._make_silo()
+        await silo.start()
+        self.silos.append(silo)
+        return silo
+
+    # -- fault injection ---------------------------------------------------
+    async def kill_silo(self, silo) -> None:
+        """Abrupt death (KillSilo = AppDomain unload): no goodbye, no
+        handoff; peers must detect via probes/votes."""
+        await silo.stop(graceful=False)
+
+    async def stop_silo(self, silo) -> None:
+        """Graceful shutdown (StopSilo): goodbye row + directory handoff."""
+        await silo.stop(graceful=True)
+
+    async def restart_silo(self, silo):
+        """RestartSilo: kill, then re-host the same endpoint with a higher
+        generation (the membership prior-generation sweep must retire the
+        old incarnation)."""
+        endpoint = silo.silo_address
+        if silo.status not in ("Stopped", "Dead"):
+            await silo.stop(graceful=False)
+        self.silos.remove(silo)
+        reborn = self._make_silo()
+        reborn.silo_address = SiloAddress(
+            endpoint.host, endpoint.port, endpoint.generation + 1,
+            endpoint.mesh_index)
+        await reborn.start()
+        self.silos.append(reborn)
+        return reborn
+
+    def partition(self, a, b) -> None:
+        self.fabric.partition(a.silo_address, b.silo_address)
+
+    def heal_partition(self, a, b) -> None:
+        self.fabric.heal_partition(a.silo_address, b.silo_address)
+
+    # -- access ------------------------------------------------------------
+    def grain(self, grain_class: type, key, key_ext: str | None = None):
+        return self.client.get_grain(grain_class, key, key_ext)
+
+    @property
+    def alive_silos(self) -> list:
+        return [s for s in self.silos if s.status == "Running"]
+
+    # -- waiting helpers -----------------------------------------------------
+    async def wait_until(self, cond: Callable[[], bool], timeout: float = 10.0,
+                         msg: str = "condition") -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if cond():
+                return
+            await asyncio.sleep(0.05)
+        raise AssertionError(f"TestCluster: timed out waiting for {msg}")
+
+    async def wait_for_liveness(self, timeout: float = 10.0) -> None:
+        """Every running silo agrees on the active set."""
+        def converged() -> bool:
+            alive = self.alive_silos
+            want = {s.silo_address for s in alive}
+            return all(set(s.membership.active) == want for s in alive
+                       if s.membership is not None)
+        await self.wait_until(converged, timeout, "membership convergence")
+
+    async def wait_for_death(self, silo, timeout: float = 10.0) -> None:
+        await self.wait_until(
+            lambda: all(silo.silo_address in s.membership.dead
+                        for s in self.alive_silos
+                        if s.membership is not None),
+            timeout, f"death of {silo.silo_address}")
+
+    # -- teardown ------------------------------------------------------------
+    async def stop_all(self) -> None:
+        if self.client is not None:
+            await self.client.close_async()
+            self.client = None
+        for s in list(self.silos):
+            if s.status not in ("Stopped", "Dead"):
+                await s.stop()
+
+    async def __aenter__(self) -> "TestCluster":
+        return await self.deploy()
+
+    async def __aexit__(self, *exc) -> None:
+        await self.stop_all()

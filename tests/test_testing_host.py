@@ -1,0 +1,114 @@
+"""Tests of the test harness itself (test/Orleans.TestingHost.Tests tier):
+deploy, kill/restart/grow, partitions, feature opt-ins."""
+
+import asyncio
+
+from orleans_tpu.runtime import Grain, StatefulGrain
+from orleans_tpu.testing import TestClusterBuilder
+
+TICKS = []
+
+
+class EchoGrain(Grain):
+    async def echo(self, v):
+        return v
+
+    async def where(self):
+        return self.runtime_identity
+
+
+class CounterGrain(StatefulGrain):
+    async def incr(self):
+        self.state["n"] = self.state.get("n", 0) + 1
+        await self.write_state()
+        return self.state["n"]
+
+
+class TickerGrain(Grain):
+    async def arm(self):
+        await self.register_reminder("tick", 0.1, 0.2)
+
+    async def receive_reminder(self, name, status):
+        TICKS.append(status.current_tick_time)
+
+
+async def test_deploy_and_call():
+    async with TestClusterBuilder(3).add_grains(EchoGrain).build() as cluster:
+        assert len(cluster.alive_silos) == 3
+        assert await cluster.grain(EchoGrain, 1).echo("hi") == "hi"
+        hosts = {await cluster.grain(EchoGrain, k).where()
+                 for k in range(24)}
+        assert len(hosts) > 1  # spread across silos
+
+
+async def test_kill_and_cluster_recovers():
+    async with (TestClusterBuilder(3).add_grains(EchoGrain, CounterGrain)
+                .build()) as cluster:
+        g = cluster.grain(CounterGrain, "c")
+        assert await g.incr() == 1
+        victim = cluster.alive_silos[-1]
+        await cluster.kill_silo(victim)
+        await cluster.wait_for_death(victim)
+        # state survives via storage; calls keep working
+        assert await g.incr() == 2
+        assert len(cluster.alive_silos) == 2
+
+
+async def test_restart_silo_same_endpoint_new_generation():
+    async with TestClusterBuilder(2).add_grains(EchoGrain).build() as cluster:
+        victim = cluster.silos[0]
+        old_addr = victim.silo_address
+        reborn = await cluster.restart_silo(victim)
+        assert reborn.silo_address.same_endpoint(old_addr)
+        assert reborn.silo_address.generation == old_addr.generation + 1
+        await cluster.wait_for_liveness()
+        assert len(cluster.alive_silos) == 2
+        assert await cluster.grain(EchoGrain, 5).echo("x") == "x"
+
+
+async def test_elastic_grow():
+    async with TestClusterBuilder(2).add_grains(EchoGrain).build() as cluster:
+        await cluster.start_additional_silo()
+        await cluster.wait_for_liveness()
+        assert len(cluster.alive_silos) == 3
+
+
+async def test_partition_heals():
+    async with TestClusterBuilder(3).add_grains(EchoGrain).build() as cluster:
+        a, b = cluster.silos[0], cluster.silos[1]
+        cluster.partition(a, b)
+        # one link down does not kill anyone when votes_needed=2 and the
+        # third silo still reaches both... heal and verify convergence
+        await asyncio.sleep(0.5)
+        cluster.heal_partition(a, b)
+        await cluster.wait_for_liveness()
+        assert len(cluster.alive_silos) == 3
+
+
+async def test_feature_optins_reminders_and_transactions():
+    TICKS.clear()
+    from orleans_tpu.transactions import (
+        TransactionalGrain, TransactionalState, transactional,
+    )
+
+    class Acct(TransactionalGrain):
+        def __init__(self):
+            self.v = TransactionalState("v", default=0)
+
+        @transactional
+        async def add(self, d):
+            await self.v.set(await self.v.get() + d)
+
+        async def get(self):
+            return await self.v.get()
+
+    cluster = (TestClusterBuilder(2)
+               .add_grains(TickerGrain, Acct)
+               .with_reminders()
+               .with_transactions()
+               .build())
+    async with cluster:
+        await cluster.grain(TickerGrain, 1).arm()
+        await cluster.grain(Acct, "a").add(5)
+        assert await cluster.grain(Acct, "a").get() == 5
+        await cluster.wait_until(lambda: len(TICKS) >= 2, msg="reminder ticks")
