@@ -1,0 +1,107 @@
+"""Chirper sample — parity with /root/reference/Samples/Chirper/
+(social graph fan-out: ChirperGrains/ChirperAccount.cs — accounts follow
+each other; publishing a chirp fans it out to every follower's timeline).
+
+The fan-out path is the reference's hardest messaging shape (one publish →
+N grain calls); on the device tier this maps to the ICI all-to-all
+multicast (BASELINE.md "Chirper fan-out as ICI all-to-all"), exercised by
+the vectorized dispatch engine; this sample is the host-tier semantics.
+
+Run: python samples/chirper.py
+"""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from orleans_tpu.runtime import ClusterClient, InProcFabric, SiloBuilder, StatefulGrain
+
+TIMELINE_SIZE = 100
+
+
+class ChirperAccount(StatefulGrain):
+    """One user (ChirperAccount.cs): follower set + received timeline."""
+
+    # -- social graph -----------------------------------------------------
+    async def follow(self, user_key) -> None:
+        """I start following ``user_key`` (their chirps reach me)."""
+        await self.get_grain(ChirperAccount, user_key).add_follower(
+            self.primary_key)
+        self.state.setdefault("following", []).append(user_key)
+        await self.write_state()
+
+    async def add_follower(self, follower_key) -> None:
+        self.state.setdefault("followers", []).append(follower_key)
+        await self.write_state()
+
+    async def unfollow(self, user_key) -> None:
+        await self.get_grain(ChirperAccount, user_key).remove_follower(
+            self.primary_key)
+        following = self.state.setdefault("following", [])
+        if user_key in following:
+            following.remove(user_key)
+            await self.write_state()
+
+    async def remove_follower(self, follower_key) -> None:
+        followers = self.state.setdefault("followers", [])
+        if follower_key in followers:
+            followers.remove(follower_key)
+            await self.write_state()
+
+    # -- chirps -----------------------------------------------------------
+    async def publish_chirp(self, text: str) -> int:
+        """Fan the chirp out to all followers (the hot path)."""
+        chirp = {"author": self.primary_key, "text": text}
+        followers = self.state.get("followers", [])
+        await asyncio.gather(*(
+            self.get_grain(ChirperAccount, f).receive_chirp(chirp)
+            for f in followers))
+        return len(followers)
+
+    async def receive_chirp(self, chirp: dict) -> None:
+        timeline = self.state.setdefault("timeline", [])
+        timeline.append(chirp)
+        del timeline[:-TIMELINE_SIZE]
+
+    async def timeline(self) -> list:
+        return list(self.state.get("timeline", []))
+
+    async def follower_count(self) -> int:
+        return len(self.state.get("followers", []))
+
+
+async def main(n_users: int = 40, stars: int = 3) -> None:
+    from orleans_tpu.storage import MemoryStorage
+
+    fabric = InProcFabric()
+    storage = MemoryStorage()
+    silos = []
+    for i in range(2):
+        silo = (SiloBuilder().with_name(f"chirper{i}").with_fabric(fabric)
+                .add_grains(ChirperAccount)
+                .with_storage("Default", storage).build())
+        await silo.start()
+        silos.append(silo)
+    client = await ClusterClient(fabric).connect()
+
+    # everyone follows the star accounts
+    for star in range(stars):
+        await asyncio.gather(*(
+            client.get_grain(ChirperAccount, u).follow(star)
+            for u in range(stars, n_users)))
+
+    delivered = await client.get_grain(ChirperAccount, 0).publish_chirp(
+        "hello, world")
+    print(f"star 0 chirped to {delivered} followers")
+    tl = await client.get_grain(ChirperAccount, stars + 1).timeline()
+    print(f"user {stars + 1} timeline: {tl}")
+
+    await client.close_async()
+    for s in silos:
+        await s.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,87 @@
+"""GPSTracker sample — parity with /root/reference/Samples/GPSTracker/
+(DeviceGrain holding last position, pushing updates over an SMS stream to
+the web frontend; GPSTracker.GrainImplementation/DeviceGrain.cs,
+PushNotifierGrain.cs).
+
+DeviceGrains record position updates and push them on a per-region SMS
+stream; a PushNotifierGrain per region is an implicit subscriber batching
+the updates for delivery (the SignalR-hub stand-in).
+
+Run: python samples/gpstracker.py
+"""
+
+import asyncio
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from orleans_tpu.runtime import ClusterClient, Grain, InProcFabric, SiloBuilder
+from orleans_tpu.storage import MemoryStorage
+from orleans_tpu.streams import add_sms_streams, implicit_stream_subscription
+
+STREAM_NS = "position-updates"
+
+
+class DeviceGrain(Grain):
+    """One GPS device (DeviceGrain.cs): last-known position + stream push."""
+
+    async def process_message(self, message: dict) -> None:
+        self._last = message
+        region = message["region"]
+        stream = self.get_stream_provider("sms").get_stream(STREAM_NS, region)
+        await stream.on_next({"device": self.primary_key, **message})
+
+    async def last_position(self) -> dict | None:
+        return getattr(self, "_last", None)
+
+
+@implicit_stream_subscription(STREAM_NS)
+class PushNotifierGrain(Grain):
+    """Per-region notifier (PushNotifierGrain.cs): batches updates for the
+    frontend; implicit subscriber keyed by region."""
+
+    async def on_next(self, item, token) -> None:
+        self.__dict__.setdefault("_batch", []).append(item)
+
+    async def flush(self) -> list:
+        batch = self.__dict__.get("_batch", [])
+        self.__dict__["_batch"] = []
+        return batch
+
+
+async def main(n_devices: int = 50, updates: int = 4) -> None:
+    fabric = InProcFabric()
+    storage = MemoryStorage()
+    silos = []
+    for i in range(2):
+        b = (SiloBuilder().with_name(f"gps{i}").with_fabric(fabric)
+             .add_grains(DeviceGrain, PushNotifierGrain)
+             .with_storage("Default", storage))
+        add_sms_streams(b, "sms")
+        silo = b.build()
+        await silo.start()
+        silos.append(silo)
+    client = await ClusterClient(fabric).connect()
+
+    rng = random.Random(7)
+    regions = ["sf", "nyc"]
+    for u in range(updates):
+        await asyncio.gather(*(
+            client.get_grain(DeviceGrain, d).process_message({
+                "lat": 37.0 + rng.random(), "lon": -122.0 + rng.random(),
+                "region": regions[d % len(regions)], "seq": u,
+            }) for d in range(n_devices)))
+
+    for region in regions:
+        batch = await client.get_grain(PushNotifierGrain, region).flush()
+        print(f"region {region}: {len(batch)} position updates delivered")
+
+    await client.close_async()
+    for s in silos:
+        await s.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,108 @@
+"""Presence sample — parity with /root/reference/Samples/Presence/
+(heartbeat fan-in: PresenceGrains/PlayerGrain.cs:14, GameGrain.cs,
+PresenceGrains/PresenceGrain.cs): device heartbeats carry compressed game
+status; the presence layer decodes and routes position updates to per-game
+grains, which notify observers.
+
+Two tiers, matching the framework's two-tier catalog:
+  * host tier (this file's ``main``): PlayerGrain/GameGrain as Python
+    grains over a 2-silo cluster — the reference sample semantics;
+  * device tier: the same workload vectorized as a VectorGrain batched
+    heartbeat kernel is the bench.py north star (BASELINE.md: 1M players).
+
+Run: python samples/presence.py
+"""
+
+import asyncio
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from orleans_tpu.runtime import (
+    ClusterClient,
+    Grain,
+    InProcFabric,
+    SiloBuilder,
+    StatefulGrain,
+)
+from orleans_tpu.storage import MemoryStorage
+
+
+class GameGrain(StatefulGrain):
+    """Per-game fan-in target (GameGrain.cs): tracks players + score."""
+
+    async def update_game_status(self, player_key, position, score) -> None:
+        players = self.state.setdefault("players", {})
+        players[player_key] = {"position": position, "score": score}
+
+    async def join(self, player_key) -> None:
+        self.state.setdefault("roster", []).append(player_key)
+        await self.write_state()
+
+    async def leave(self, player_key) -> None:
+        roster = self.state.setdefault("roster", [])
+        if player_key in roster:
+            roster.remove(player_key)
+            await self.write_state()
+
+    async def game_status(self) -> dict:
+        return dict(self.state.get("players", {}))
+
+
+class PlayerGrain(Grain):
+    """One player (PlayerGrain.cs:14): heartbeats update the current game."""
+
+    async def join_game(self, game_key) -> None:
+        self._game = game_key
+        await self.get_grain(GameGrain, game_key).join(self.primary_key)
+
+    async def heartbeat(self, position, score) -> None:
+        """The hot call: one decoded device heartbeat."""
+        game = getattr(self, "_game", None)
+        if game is None:
+            return
+        await self.get_grain(GameGrain, game).update_game_status(
+            self.primary_key, position, score)
+
+    async def leave_game(self) -> None:
+        game = getattr(self, "_game", None)
+        if game is not None:
+            await self.get_grain(GameGrain, game).leave(self.primary_key)
+            self._game = None
+
+
+async def main(n_players: int = 100, n_games: int = 8,
+               rounds: int = 5) -> None:
+    fabric = InProcFabric()
+    storage = MemoryStorage()
+    silos = []
+    for i in range(2):
+        silo = (SiloBuilder().with_name(f"presence{i}").with_fabric(fabric)
+                .add_grains(PlayerGrain, GameGrain)
+                .with_storage("Default", storage).build())
+        await silo.start()
+        silos.append(silo)
+    client = await ClusterClient(fabric).connect()
+
+    players = [client.get_grain(PlayerGrain, k) for k in range(n_players)]
+    await asyncio.gather(*(p.join_game(k % n_games)
+                           for k, p in enumerate(players)))
+
+    rng = random.Random(0)
+    for r in range(rounds):
+        await asyncio.gather(*(
+            p.heartbeat((rng.random(), rng.random()), r) for p in players))
+    status = await client.get_grain(GameGrain, 0).game_status()
+    print(f"game 0: {len(status)} players reporting, "
+          f"sample: {sorted(status)[:5]}")
+
+    await asyncio.gather(*(p.leave_game() for p in players))
+    await client.close_async()
+    for s in silos:
+        await s.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())
