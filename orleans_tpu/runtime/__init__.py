@@ -3,11 +3,13 @@ L3/L4/L8/L9/L10)."""
 
 from .activation import ActivationData, ActivationState  # noqa: F401
 from .cluster import ClusterClient, InProcFabric  # noqa: F401
+from .socket_fabric import GatewayClient, SocketFabric  # noqa: F401
 from .context import RequestContext  # noqa: F401
 from .grain import (  # noqa: F401
     Grain,
     StatefulGrain,
     always_interleave,
+    collection_age,
     one_way,
     placement,
     read_only,

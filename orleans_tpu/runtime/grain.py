@@ -25,7 +25,8 @@ T = TypeVar("T")
 
 __all__ = [
     "Grain", "StatefulGrain", "reentrant", "stateless_worker", "read_only",
-    "always_interleave", "one_way", "placement", "grain_type_of",
+    "always_interleave", "one_way", "placement", "collection_age",
+    "grain_type_of",
 ]
 
 
@@ -59,6 +60,16 @@ def placement(strategy: str) -> Callable[[type], type]:
     'activation_count' (PlacementAttribute.cs)."""
     def deco(cls: type) -> type:
         cls.__orleans_placement__ = strategy
+        return cls
+    return deco
+
+
+def collection_age(seconds: float) -> Callable[[type], type]:
+    """``[CollectionAgeLimit]`` — per-class idle-deactivation age override
+    (GrainCollectionOptions.ClassSpecificCollectionAge; consumed by the
+    catalog's idle collector)."""
+    def deco(cls: type) -> type:
+        cls.__orleans_collection_age__ = float(seconds)
         return cls
     return deco
 

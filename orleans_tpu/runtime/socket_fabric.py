@@ -1,0 +1,580 @@
+"""TCP socket fabric: cross-process silo-to-silo transport + client gateway.
+
+Re-design of the reference's socket layer
+(/root/reference/src/Orleans.Core/Messaging/SocketManager.cs:1-261,
+``IncomingMessageAcceptor.cs:12`` accept/receive loop,
+``OutboundMessageQueue.cs:38-44`` per-target senders,
+``Runtime/Messaging/Gateway.cs:17`` + ``GatewayAcceptor.cs`` client ingress,
+``Core/Messaging/ClientMessageCenter.cs:63`` + ``GatewayManager.cs`` client
+side) for silos living in **separate processes/hosts**.
+
+Architecture (departures from the reference are deliberate):
+
+* One asyncio TCP server per silo accepts both peer-silo and client
+  connections; the first frame is a handshake declaring the peer kind and
+  address (GatewayAcceptor.cs:63 handshake-carried client id analog).
+* Outbound: one lazily-dialed connection + send queue per target endpoint
+  (the reference hashes targets over N sender threads; one asyncio sender
+  task per endpoint gives the same per-target FIFO order without threads).
+* Clients are addressed *via their gateway*: a client's pseudo
+  ``SiloAddress`` carries the gateway's host:port and a client-unique
+  generation, so any silo can reply by dialing the gateway, which forwards
+  over the client's live connection (``Gateway.TryDeliverToProxy:229``).
+* This fabric carries the **control plane and host-tier grain calls**. The
+  vectorized data plane rides device collectives over ICI
+  (orleans_tpu.parallel.transport) and never touches these sockets.
+
+In-process clusters and liveness tests keep using
+orleans_tpu.runtime.cluster.InProcFabric; this module exists for real
+multi-process deployments and is exercised by tests over localhost sockets.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import random
+import socket
+import time
+from typing import TYPE_CHECKING, Any
+
+from ..core.errors import SiloUnavailableError
+from ..core.ids import SiloAddress
+from ..core.message import Direction, Message
+from .references import GrainFactory
+from .runtime_client import RuntimeClient
+from .wire import (
+    FrameError,
+    _BodyDecodeError,
+    decode_handshake,
+    decode_message,
+    encode_frame,
+    encode_handshake,
+    encode_message,
+    read_frame,
+)
+
+if TYPE_CHECKING:
+    from .silo import Silo
+
+log = logging.getLogger("orleans.socket")
+
+__all__ = ["SocketFabric", "GatewayClient"]
+
+_CONNECT_RETRIES = 3
+_CONNECT_BACKOFF = 0.2
+
+
+def _fresh_generation() -> int:
+    """Epoch stamp distinguishing restarts at the same endpoint
+    (SiloAddress.cs generation): full millisecond timestamp in the high bits
+    so a later restart ALWAYS gets a higher generation (the membership join
+    protocol requires strict monotonicity to declare prior incarnations
+    dead); randomized low bits avoid same-millisecond collisions."""
+    return (int(time.time() * 1000) << 12) | random.getrandbits(12)
+
+
+class _Sender:
+    """Per-endpoint outbound queue + writer task (the SiloMessageSender
+    analog — per-target FIFO, lazy dial, bounded reconnect)."""
+
+    def __init__(self, fabric: "SocketFabric", endpoint: str):
+        self.fabric = fabric
+        self.endpoint = endpoint
+        self.queue: asyncio.Queue[Message] = asyncio.Queue()
+        self.task = asyncio.get_running_loop().create_task(self._run())
+        self.writer: asyncio.StreamWriter | None = None
+
+    async def _connect(self) -> asyncio.StreamWriter:
+        host, port = self.endpoint.rsplit(":", 1)
+        last: Exception | None = None
+        for attempt in range(_CONNECT_RETRIES):
+            try:
+                _, writer = await asyncio.open_connection(host, int(port))
+                writer.write(encode_handshake(
+                    "silo", self.fabric.local_address()))
+                await writer.drain()
+                return writer
+            except OSError as e:
+                last = e
+                await asyncio.sleep(_CONNECT_BACKOFF * (attempt + 1))
+        raise SiloUnavailableError(
+            f"cannot connect to {self.endpoint}: {last}")
+
+    async def _run(self) -> None:
+        while True:
+            msg = await self.queue.get()
+            if self.fabric.is_endpoint_dead(self.endpoint):
+                continue  # dead-silo drop (MessageCenter SiloDeadOracle)
+            try:
+                data = encode_message(msg)
+            except Exception as e:  # noqa: BLE001 — per-message, not the link
+                self.fabric.bounce_unencodable(msg, e)
+                continue
+            try:
+                if self.writer is None or self.writer.is_closing():
+                    self.writer = await self._connect()
+                self.writer.write(data)
+                await self.writer.drain()
+            except (SiloUnavailableError, OSError, FrameError) as e:
+                log.warning("send to %s failed: %s", self.endpoint, e)
+                if self.writer is not None:
+                    self.writer.close()
+                    self.writer = None
+                # dropped: senders learn via response timeout / membership
+
+    def close(self) -> None:
+        self.task.cancel()
+        if self.writer is not None:
+            self.writer.close()
+            self.writer = None
+
+
+class SocketFabric:
+    """Drop-in fabric (same surface the Silo/clients use as InProcFabric)
+    whose wire is real TCP. One instance per process; it may host several
+    silos (each with its own listening socket) for tests."""
+
+    def __init__(self, host: str = "127.0.0.1"):
+        self.host = host
+        self.silos: dict[SiloAddress, Any] = {}      # local silos only
+        self.dead: set[SiloAddress] = set()
+        self._dead_endpoints: set[str] = set()
+        self._listen_socks: dict[str, socket.socket] = {}  # name -> bound sock
+        self._servers: dict[SiloAddress, asyncio.base_events.Server] = {}
+        self._senders: dict[str, _Sender] = {}
+        # client pseudo-address -> writer for clients connected to our gateway
+        self.client_routes: dict[SiloAddress, asyncio.StreamWriter] = {}
+        # which local silo's gateway each client route belongs to
+        self._route_owner: dict[SiloAddress, SiloAddress] = {}
+        self._conn_tasks: set[asyncio.Task] = set()
+        self.partitions: set[tuple[str, str]] = set()
+        self._names = itertools.count(1)
+
+    # -- address allocation ---------------------------------------------
+    def allocate_address(self, name: str) -> SiloAddress:
+        """Bind + listen immediately so peers can connect (backlog) even
+        before the asyncio server attaches in register_silo — no startup
+        race between silos dialing each other."""
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind((self.host, 0))
+        sock.listen(128)
+        sock.setblocking(False)
+        port = sock.getsockname()[1]
+        addr = SiloAddress(self.host, port, _fresh_generation())
+        self._listen_socks[addr.endpoint] = sock
+        return addr
+
+    def local_address(self) -> SiloAddress:
+        if not self.silos:
+            raise SiloUnavailableError("no local silo registered")
+        return next(iter(self.silos))
+
+    # -- silo lifecycle ----------------------------------------------------
+    def register_silo(self, silo: "Silo") -> None:
+        addr = silo.silo_address
+        self.silos[addr] = silo
+        self.dead.discard(addr)
+        sock = self._listen_socks.get(addr.endpoint)
+        if sock is None:
+            raise SiloUnavailableError(
+                f"silo address {addr} was not allocated by this fabric")
+        loop = asyncio.get_running_loop()
+        t = loop.create_task(self._serve(silo, sock))
+        self._conn_tasks.add(t)
+        t.add_done_callback(self._conn_tasks.discard)
+        if silo.membership is not None:
+            silo.membership.subscribe(self._on_membership_change)
+
+    async def _serve(self, silo: "Silo", sock: socket.socket) -> None:
+        server = await asyncio.start_server(
+            lambda r, w: self._handle_conn(silo, r, w), sock=sock)
+        self._servers[silo.silo_address] = server
+
+    def unregister_silo(self, silo: "Silo", dead: bool = False) -> None:
+        addr = silo.silo_address
+        self.silos.pop(addr, None)
+        if dead:
+            self.dead.add(addr)
+        server = self._servers.pop(addr, None)
+        if server is not None:
+            server.close()
+        self._listen_socks.pop(addr.endpoint, None)
+        # close only the routes of clients attached to THIS silo's gateway
+        for caddr, owner in list(self._route_owner.items()):
+            if owner == addr:
+                self._route_owner.pop(caddr, None)
+                w = self.client_routes.pop(caddr, None)
+                if w is not None:
+                    w.close()
+        # shared outbound senders survive while other local silos need them
+        if not self.silos:
+            for s in list(self._senders.values()):
+                s.close()
+            self._senders.clear()
+            for w in self.client_routes.values():
+                w.close()
+            self.client_routes.clear()
+            self._route_owner.clear()
+            for t in list(self._conn_tasks):
+                t.cancel()
+
+    # -- membership-driven liveness ---------------------------------------
+    def _on_membership_change(self, alive: list[SiloAddress],
+                              dead: list[SiloAddress]) -> None:
+        for d in dead:
+            self.dead.add(d)
+            self._dead_endpoints.add(d.endpoint)
+            sender = self._senders.pop(d.endpoint, None)
+            if sender is not None:
+                sender.close()
+        # a restarted silo reuses an endpoint with a new generation
+        for a in alive:
+            self._dead_endpoints.discard(a.endpoint)
+
+    def is_dead(self, addr: SiloAddress) -> bool:
+        return addr in self.dead
+
+    def is_endpoint_dead(self, endpoint: str) -> bool:
+        return endpoint in self._dead_endpoints
+
+    def alive_silos(self) -> list[SiloAddress]:
+        """Cluster view: from the membership oracle when running, else the
+        local silos (bootstrap)."""
+        for silo in self.silos.values():
+            if silo.membership is not None:
+                return silo.membership.active_silos()
+        return [a for a, s in self.silos.items()
+                if s.status in ("Running", "Joining")]
+
+    # -- fault injection (parity with InProcFabric) ------------------------
+    def partition(self, a: SiloAddress, b: SiloAddress) -> None:
+        self.partitions.add((a.endpoint, b.endpoint))
+        self.partitions.add((b.endpoint, a.endpoint))
+
+    def heal_partition(self, a: SiloAddress, b: SiloAddress) -> None:
+        self.partitions.discard((a.endpoint, b.endpoint))
+        self.partitions.discard((b.endpoint, a.endpoint))
+
+    # -- the wire ----------------------------------------------------------
+    def deliver(self, msg: Message) -> None:
+        target = msg.target_silo
+        if target is None:
+            log.warning("dropping unaddressed message %s", msg.method_name)
+            return
+        if msg.sending_silo is not None and \
+                (msg.sending_silo.endpoint, target.endpoint) in self.partitions:
+            return
+        local = self.silos.get(target)
+        if local is not None:
+            local.message_center.deliver(msg)
+            return
+        client_writer = self.client_routes.get(target)
+        if client_writer is not None:
+            self._write_to_client(target, client_writer, msg)
+            return
+        if target in self.dead:
+            return
+        sender = self._senders.get(target.endpoint)
+        if sender is None:
+            sender = self._senders[target.endpoint] = _Sender(
+                self, target.endpoint)
+        sender.queue.put_nowait(msg)
+
+    def _write_to_client(self, addr: SiloAddress,
+                         writer: asyncio.StreamWriter, msg: Message) -> None:
+        try:
+            data = encode_message(msg)
+        except Exception as e:  # noqa: BLE001 — encode failure: the route is
+            # healthy, only this payload is bad. Fail the call promptly
+            # instead of letting the client time out.
+            log.warning("unencodable message to client %s: %s", addr, e)
+            if msg.direction == Direction.RESPONSE:
+                from ..core.message import ResponseKind
+                fallback = Message.__new__(Message)
+                for s in Message.__slots__:
+                    setattr(fallback, s, getattr(msg, s))
+                fallback.response_kind = ResponseKind.ERROR
+                fallback.body = SiloUnavailableError(
+                    f"response to {msg.interface_name}.{msg.method_name} "
+                    f"could not cross the wire: {e}")
+                try:
+                    writer.write(encode_message(fallback))
+                except Exception:  # noqa: BLE001
+                    log.exception("error-response fallback failed")
+            return
+        try:
+            writer.write(data)
+        except Exception:  # noqa: BLE001 — client gone mid-write
+            log.info("dropping message to disconnected client %s", addr)
+            self.client_routes.pop(addr, None)
+            self._route_owner.pop(addr, None)
+
+    # -- inbound connections ----------------------------------------------
+    async def _handle_conn(self, silo: "Silo", reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        peer_addr: SiloAddress | None = None
+        is_client = False
+        try:
+            headers, _ = await read_frame(reader)
+            hs = decode_handshake(headers)
+            peer_addr = hs["address"]
+            is_client = hs["kind"] == "client"
+            if is_client:
+                # Gateway: record the client route (ClientObserverRegistrar
+                # records gateway routes; here route == live connection)
+                self.client_routes[peer_addr] = writer
+                self._route_owner[peer_addr] = silo.silo_address
+            while True:
+                headers, body = await read_frame(reader)
+                try:
+                    msg = decode_message(headers, body)
+                except _BodyDecodeError as e:
+                    self._bounce_undecodable(e.message, str(e))
+                    continue
+                self._route_inbound(silo, msg)
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass  # clean EOF / peer died
+        except FrameError as e:
+            log.warning("dropping connection from %s: %s", peer_addr, e)
+        except Exception:  # noqa: BLE001
+            log.exception("connection handler failed (peer=%s)", peer_addr)
+        finally:
+            if is_client and peer_addr is not None:
+                self.client_routes.pop(peer_addr, None)
+                self._route_owner.pop(peer_addr, None)
+            writer.close()
+
+    def _route_inbound(self, silo: "Silo", msg: Message) -> None:
+        target = msg.target_silo
+        if target is not None:
+            local = self.silos.get(target)
+            if local is not None:
+                local.message_center.deliver(msg)
+                return
+            client_writer = self.client_routes.get(target)
+            if client_writer is not None:
+                # gateway forwarding to a connected client
+                # (Gateway.TryDeliverToProxy:229)
+                self._write_to_client(target, client_writer, msg)
+                return
+            if target.same_endpoint(silo.silo_address):
+                # addressed to a client of ours that disconnected, or to an
+                # older generation of this silo: drop (sender times out /
+                # re-addresses via directory)
+                log.info("dropping message for unknown local target %s",
+                         target)
+                return
+            # misrouted: relay toward the addressed silo
+            self.deliver(msg)
+            return
+        # unaddressed (client gateway ingress): this silo addresses it
+        silo.message_center.deliver(msg)
+
+    def bounce_unencodable(self, msg: Message, exc: Exception) -> None:
+        """A message failed to *encode* (unpicklable payload). Requests get
+        an error response back to the caller; anything else is dropped."""
+        if msg.direction == Direction.RESPONSE or msg.sending_silo is None:
+            log.warning("dropping unencodable %s: %s", msg.method_name, exc)
+            return
+        from ..core.message import make_error_response
+        self.deliver(make_error_response(msg, SiloUnavailableError(
+            f"wire encode failed for {msg.interface_name}.{msg.method_name}: "
+            f"{exc}")))
+
+    def _bounce_undecodable(self, msg: Message, info: str) -> None:
+        """Body failed to decode; headers survived, so reject back to the
+        sender instead of letting the call time out."""
+        if msg.direction == Direction.RESPONSE or msg.sending_silo is None:
+            log.warning("dropping undecodable %s: %s", msg.method_name, info)
+            return
+        from ..core.message import RejectionType, make_rejection
+        rej = make_rejection(msg, RejectionType.UNRECOVERABLE,
+                             f"wire decode failed: {info}")
+        self.deliver(rej)
+
+    # -- in-proc client compatibility --------------------------------------
+    def register_client(self, client) -> None:  # pragma: no cover
+        raise NotImplementedError(
+            "SocketFabric clients connect via GatewayClient, not in-proc")
+
+    def deliver_via_gateway(self, gateway: SiloAddress,
+                            msg: Message) -> None:  # pragma: no cover
+        raise NotImplementedError(
+            "SocketFabric clients connect via GatewayClient, not in-proc")
+
+
+# ---------------------------------------------------------------------------
+# Out-of-process client
+# ---------------------------------------------------------------------------
+
+class _GatewayConnection:
+    """One TCP connection to one gateway silo (GatewayConnection.cs)."""
+
+    def __init__(self, client: "GatewayClient", endpoint: str):
+        self.client = client
+        self.endpoint = endpoint
+        host, port = endpoint.rsplit(":", 1)
+        self.pseudo_address = SiloAddress(host, int(port), client.generation)
+        self.writer: asyncio.StreamWriter | None = None
+        self.reader_task: asyncio.Task | None = None
+        self.queue: asyncio.Queue[Message] = asyncio.Queue()
+        self.sender_task: asyncio.Task | None = None
+        self.live = False
+
+    async def connect(self) -> None:
+        host, port = self.endpoint.rsplit(":", 1)
+        reader, writer = await asyncio.open_connection(host, int(port))
+        writer.write(encode_handshake("client", self.pseudo_address))
+        await writer.drain()
+        self.writer = writer
+        self.live = True
+        loop = asyncio.get_running_loop()
+        self.reader_task = loop.create_task(self._pump(reader))
+        self.sender_task = loop.create_task(self._send_loop())
+
+    async def _pump(self, reader: asyncio.StreamReader) -> None:
+        """Client message pump (OutsideRuntimeClient.RunClientMessagePump:235)."""
+        try:
+            while True:
+                headers, body = await read_frame(reader)
+                try:
+                    msg = decode_message(headers, body)
+                except _BodyDecodeError as e:
+                    # a response we cannot decode still completes the call
+                    msg = e.message
+                    from ..core.message import ResponseKind
+                    if msg.direction == Direction.RESPONSE:
+                        msg.response_kind = ResponseKind.ERROR
+                        msg.body = SiloUnavailableError(
+                            f"undecodable response: {e}")
+                    else:
+                        continue
+                self.client.deliver(msg)
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
+            pass
+        finally:
+            self.live = False
+            if self.writer is not None:
+                self.writer.close()
+
+    async def _send_loop(self) -> None:
+        while True:
+            msg = await self.queue.get()
+            try:
+                data = encode_message(msg)
+            except Exception as e:  # noqa: BLE001 — unpicklable payload
+                if msg.direction != Direction.RESPONSE:
+                    from ..core.message import make_error_response
+                    self.client.deliver(make_error_response(
+                        msg, SiloUnavailableError(
+                            f"wire encode failed for "
+                            f"{msg.interface_name}.{msg.method_name}: {e}")))
+                continue
+            try:
+                assert self.writer is not None
+                self.writer.write(data)
+                await self.writer.drain()
+            except (OSError, AssertionError) as e:
+                self.live = False
+                log.warning("gateway %s send failed: %s", self.endpoint, e)
+                # the connection is known-dead: fail the call promptly
+                # instead of letting it wait out the response timeout
+                if msg.direction != Direction.RESPONSE:
+                    from ..core.message import make_error_response
+                    self.client.deliver(make_error_response(
+                        msg, SiloUnavailableError(
+                            f"gateway {self.endpoint} connection lost")))
+
+    def close(self) -> None:
+        self.live = False
+        for t in (self.reader_task, self.sender_task):
+            if t is not None:
+                t.cancel()
+        if self.writer is not None:
+            self.writer.close()
+
+
+class GatewayClient(RuntimeClient):
+    """Out-of-process cluster client over TCP gateways
+    (OutsideRuntimeClient.cs:22 + GatewayManager.cs): N gateway connections,
+    per-grain affinity routing with round-robin fallback, response pump,
+    reconnect-on-demand."""
+
+    def __init__(self, gateways: list[str], response_timeout: float = 30.0):
+        super().__init__(response_timeout=response_timeout)
+        if not gateways:
+            raise ValueError("at least one gateway endpoint required")
+        self.generation = _fresh_generation()
+        self.conns = [_GatewayConnection(self, ep) for ep in gateways]
+        self.grain_factory = GrainFactory(self)
+        self._rr = 0
+        self.connected = False
+        self._reconnect_period = 0.5
+        self._reconnector: asyncio.Task | None = None
+
+    # -- RuntimeClient surface --------------------------------------------
+    @property
+    def silo_address(self) -> SiloAddress | None:
+        live = self._live()
+        return live[0].pseudo_address if live else None
+
+    def _live(self) -> list[_GatewayConnection]:
+        return [c for c in self.conns if c.live]
+
+    def transmit(self, msg: Message) -> None:
+        live = self._live()
+        if not live:
+            raise SiloUnavailableError("no live gateway connections")
+        if msg.target_grain is not None:
+            conn = live[msg.target_grain.uniform_hash % len(live)]
+        else:
+            self._rr = (self._rr + 1) % len(live)
+            conn = live[self._rr]
+        msg.sending_silo = conn.pseudo_address
+        conn.queue.put_nowait(msg)
+
+    def deliver(self, msg: Message) -> None:
+        if msg.direction == Direction.RESPONSE:
+            self.receive_response(msg)
+        # grain→client observer pushes would land here
+
+    # -- lifecycle ---------------------------------------------------------
+    async def connect(self) -> "GatewayClient":
+        results = await asyncio.gather(
+            *(c.connect() for c in self.conns), return_exceptions=True)
+        if not self._live():
+            raise SiloUnavailableError(
+                f"could not reach any gateway: {results}")
+        self.connected = True
+        self._reconnector = asyncio.get_running_loop().create_task(
+            self._reconnect_loop())
+        return self
+
+    async def _reconnect_loop(self) -> None:
+        """Revive dropped gateway connections (GatewayManager keeps retrying
+        dead gateways and returns them to rotation when reachable)."""
+        while True:
+            await asyncio.sleep(self._reconnect_period)
+            for c in self.conns:
+                if not c.live:
+                    c.close()  # reap stale pump/sender tasks
+                    try:
+                        await c.connect()
+                        log.info("gateway %s reconnected", c.endpoint)
+                    except OSError:
+                        pass  # still down; retry next period
+
+    async def close_async(self) -> None:
+        if self._reconnector is not None:
+            self._reconnector.cancel()
+            self._reconnector = None
+        for c in self.conns:
+            c.close()
+        self.connected = False
+        self.close()
+
+    def get_grain(self, grain_class: type, key, key_ext: str | None = None):
+        return self.grain_factory.get_grain(grain_class, key, key_ext)

@@ -1,0 +1,138 @@
+"""Wire framing for cross-process messaging (L2 wire tier).
+
+Re-design of the reference's framing layer: length-prefixed
+``[4B headers-len][4B body-len][headers][body]`` frames
+(/root/reference/src/Orleans.Core/Messaging/IncomingMessageBuffer.cs:125-163,
+``Message.LENGTH_HEADER_SIZE`` Message.cs:14-15, ``Message.Serialize:481``).
+
+Departures from the reference:
+
+* Headers and body are encoded with the wire tier of
+  :mod:`orleans_tpu.core.serialization` (restricted-unpickler codec with a
+  type allowlist) instead of the token-stream binary format — the hot data
+  path on TPU never touches this codec (vectorized payloads ride device
+  collectives; see orleans_tpu.parallel.transport), so the control plane
+  optimizes for fidelity over bytes.
+* ``expires_at`` is a ``time.monotonic`` stamp, meaningless across process
+  boundaries — it is rebased through a relative TTL carried on the wire.
+* A connection opens with a handshake frame identifying the peer
+  (``kind`` silo/client + its SiloAddress) — the analog of the gateway
+  handshake-carried client id (GatewayAcceptor.cs:63,
+  ClientMessageCenter.cs:453).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+import time
+from typing import Any
+
+from ..core.ids import SiloAddress
+from ..core.message import Message
+from ..core.serialization import deserialize, serialize
+
+__all__ = [
+    "MAX_FRAME_SEGMENT", "FrameError", "WireDecodeError",
+    "encode_frame", "read_frame",
+    "encode_message", "decode_message",
+    "encode_handshake", "decode_handshake",
+]
+
+_LEN = struct.Struct("<II")  # headers-len, body-len (LENGTH_HEADER_SIZE = 8)
+
+# Refuse absurd frames before allocating (the reference caps via
+# MaxMessageBodySize / buffer-pool discipline).
+MAX_FRAME_SEGMENT = 128 * 1024 * 1024
+
+
+class FrameError(Exception):
+    """Malformed or oversized frame — the connection must be dropped."""
+
+
+class WireDecodeError(Exception):
+    """Frame arrived intact but its payload failed to decode (unregistered
+    type, version skew). Scoped to one message, not the connection."""
+
+
+def encode_frame(headers: bytes, body: bytes) -> bytes:
+    if len(headers) > MAX_FRAME_SEGMENT or len(body) > MAX_FRAME_SEGMENT:
+        raise FrameError(
+            f"frame segment exceeds {MAX_FRAME_SEGMENT} bytes "
+            f"(headers={len(headers)}, body={len(body)})")
+    return _LEN.pack(len(headers), len(body)) + headers + body
+
+
+async def read_frame(reader: asyncio.StreamReader) -> tuple[bytes, bytes]:
+    """Read one complete frame; raises IncompleteReadError at clean EOF."""
+    prefix = await reader.readexactly(_LEN.size)
+    hlen, blen = _LEN.unpack(prefix)
+    if hlen > MAX_FRAME_SEGMENT or blen > MAX_FRAME_SEGMENT:
+        raise FrameError(f"oversized frame announced: {hlen}+{blen}")
+    headers = await reader.readexactly(hlen) if hlen else b""
+    body = await reader.readexactly(blen) if blen else b""
+    return headers, body
+
+
+# ---------------------------------------------------------------------------
+# Message <-> frame
+# ---------------------------------------------------------------------------
+
+# Every Message slot except the lazily-decoded body (the headers/body split
+# of Message.HeadersContainer, Message.cs:725) and expires_at (rebased).
+_HEADER_SLOTS = tuple(s for s in Message.__slots__
+                      if s not in ("body", "expires_at"))
+
+
+def encode_message(msg: Message) -> bytes:
+    ttl = None
+    if msg.expires_at is not None:
+        ttl = max(0.0, msg.expires_at - time.monotonic())
+    headers = serialize(
+        (tuple(getattr(msg, s) for s in _HEADER_SLOTS), ttl))
+    body = serialize(msg.body)
+    return encode_frame(headers, body)
+
+
+def decode_message(headers: bytes, body: bytes) -> Message:
+    try:
+        fields, ttl = deserialize(headers)
+        values = dict(zip(_HEADER_SLOTS, fields, strict=True))
+    except Exception as e:  # noqa: BLE001 — headers must decode or the msg is lost
+        raise WireDecodeError(f"undecodable message headers: {e}") from e
+    msg = Message.__new__(Message)
+    for k, v in values.items():
+        setattr(msg, k, v)
+    msg.expires_at = None if ttl is None else time.monotonic() + ttl
+    try:
+        msg.body = deserialize(body)
+    except Exception as e:  # noqa: BLE001 — body failure is per-message
+        msg.body = None
+        raise _BodyDecodeError(msg, e) from e
+    return msg
+
+
+class _BodyDecodeError(WireDecodeError):
+    """Body failed to decode but headers did: carries the headers-only
+    message so the receiver can still route an error response."""
+
+    def __init__(self, msg: Message, cause: Exception):
+        super().__init__(f"undecodable message body: {cause}")
+        self.message = msg
+
+
+# ---------------------------------------------------------------------------
+# Handshake
+# ---------------------------------------------------------------------------
+
+def encode_handshake(kind: str, address: SiloAddress,
+                     extra: dict[str, Any] | None = None) -> bytes:
+    return encode_frame(
+        serialize({"kind": kind, "address": address, **(extra or {})}), b"")
+
+
+def decode_handshake(headers: bytes) -> dict[str, Any]:
+    hs = deserialize(headers)
+    if not isinstance(hs, dict) or "kind" not in hs or "address" not in hs:
+        raise FrameError(f"malformed handshake: {hs!r}")
+    return hs

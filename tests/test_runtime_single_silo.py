@@ -324,6 +324,33 @@ async def test_idle_collection():
         await silo.stop()
 
 
+async def test_per_class_collection_age_overrides_silo_default():
+    from orleans_tpu.runtime import collection_age
+
+    @collection_age(0.05)
+    class ShortLivedGrain(Grain):
+        async def ping(self) -> str:
+            return "pong"
+
+    # silo default is long; the class override must win
+    silo = (SiloBuilder().with_name("s1")
+            .add_grains(*ALL_GRAINS, ShortLivedGrain)
+            .with_config(collection_age=3600.0, collection_quantum=0.05)
+            .build())
+    await silo.start()
+    client = await ClusterClient(silo.fabric).connect()
+    try:
+        g = client.get_grain(ShortLivedGrain, 1)
+        h = client.get_grain(HelloGrain, 8)
+        await asyncio.gather(g.ping(), h.say_hello("hi"))
+        assert silo.catalog.activation_count() == 2
+        await asyncio.sleep(0.3)
+        # ShortLivedGrain collected, HelloGrain (silo default 1h) survives
+        assert silo.catalog.activation_count() == 1
+    finally:
+        await silo.stop()
+
+
 async def test_stateless_worker_actually_adds_replicas():
     """Regression: all-busy stateless worker must scale out past 1 replica."""
     silo, client = await start_silo()

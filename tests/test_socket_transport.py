@@ -1,0 +1,303 @@
+"""TCP socket fabric tests: wire framing, gateway clients over real
+localhost sockets, and cross-fabric (process-boundary-shaped) clusters with
+a shared file membership table — the socket analog of the reference's
+liveness/gateway test tiers."""
+
+import asyncio
+import time
+
+import pytest
+
+from orleans_tpu.core.ids import GrainId, GrainType, SiloAddress
+from orleans_tpu.core.message import make_request, make_response
+from orleans_tpu.membership import FileMembershipTable, join_cluster
+from orleans_tpu.runtime import (
+    GatewayClient,
+    Grain,
+    SiloBuilder,
+    SocketFabric,
+)
+from orleans_tpu.runtime.wire import (
+    decode_message,
+    encode_message,
+    read_frame,
+)
+
+FAST = dict(
+    membership_probe_period=0.1,
+    membership_probe_timeout=0.2,
+    membership_missed_probes_limit=2,
+    membership_votes_needed=1,
+    membership_iam_alive_period=0.5,
+    membership_refresh_period=0.2,
+    membership_vote_expiration=5.0,
+    response_timeout=5.0,
+)
+
+
+class EchoGrain(Grain):
+    async def echo(self, text: str) -> str:
+        return f"{self.primary_key}:{text}"
+
+    async def where(self) -> str:
+        return self.runtime_identity
+
+
+class RelayGrain(Grain):
+    """Cross-silo grain→grain call path."""
+
+    async def relay(self, target_key: int, text: str) -> str:
+        target = self.get_grain(EchoGrain, target_key)
+        return await target.echo(text)
+
+
+# ---------------------------------------------------------------------------
+# Wire framing
+# ---------------------------------------------------------------------------
+
+class _BufReader:
+    """Minimal StreamReader stand-in over a bytes buffer."""
+
+    def __init__(self, data: bytes):
+        self._data = data
+        self._pos = 0
+
+    async def readexactly(self, n: int) -> bytes:
+        if self._pos + n > len(self._data):
+            raise asyncio.IncompleteReadError(b"", n)
+        out = self._data[self._pos:self._pos + n]
+        self._pos += n
+        return out
+
+
+async def test_wire_roundtrip_preserves_headers_and_rebases_ttl():
+    gid = GrainId.for_grain(GrainType.of("EchoGrain"), 42)
+    msg = make_request(
+        target_grain=gid, interface_name="EchoGrain", method_name="echo",
+        body=(("hello",), {}), timeout=10.0,
+        sending_silo=SiloAddress("10.0.0.1", 5000, 7),
+        request_context={"trace": "abc"})
+    msg.call_chain = (GrainId.for_grain(GrainType.of("Caller"), 1),)
+    data = encode_message(msg)
+    headers, body = await read_frame(_BufReader(data))
+    out = decode_message(headers, body)
+    assert out.target_grain == gid
+    assert out.method_name == "echo"
+    assert out.body == (("hello",), {})
+    assert out.id == msg.id
+    assert out.sending_silo == msg.sending_silo
+    assert out.call_chain == msg.call_chain
+    assert out.request_context == {"trace": "abc"}
+    # TTL rebased to the receiver's monotonic clock, not copied raw
+    assert out.expires_at is not None
+    remaining = out.expires_at - time.monotonic()
+    assert 8.0 < remaining <= 10.0
+
+    resp = make_response(out, "result")
+    headers, body = await read_frame(_BufReader(encode_message(resp)))
+    rout = decode_message(headers, body)
+    assert rout.body == "result"
+    assert rout.id == msg.id
+
+
+# ---------------------------------------------------------------------------
+# Single silo + TCP gateway client
+# ---------------------------------------------------------------------------
+
+async def _start_socket_silo(name, table, *, grains=(EchoGrain, RelayGrain)):
+    fabric = SocketFabric()
+    silo = (SiloBuilder().with_name(name).with_fabric(fabric)
+            .add_grains(*grains).with_config(**FAST).build())
+    join_cluster(silo, table)
+    await silo.start()
+    return fabric, silo
+
+
+async def test_gateway_client_end_to_end(tmp_path):
+    table = FileMembershipTable(str(tmp_path / "mbr.json"))
+    fabric, silo = await _start_socket_silo("s1", table)
+    client = None
+    try:
+        gw = silo.silo_address.endpoint
+        client = await GatewayClient([gw], response_timeout=5.0).connect()
+        g = client.get_grain(EchoGrain, 7)
+        assert await g.echo("hi") == "7:hi"
+        # many concurrent calls through the same socket
+        outs = await asyncio.gather(
+            *(client.get_grain(EchoGrain, i).echo("x") for i in range(50)))
+        assert outs == [f"{i}:x" for i in range(50)]
+    finally:
+        if client is not None:
+            await client.close_async()
+        await silo.stop()
+
+
+async def test_two_silos_over_sockets_cross_silo_calls(tmp_path):
+    """Two silos in separate fabrics (the process-boundary shape): placement
+    spreads grains, grain→grain calls cross the TCP wire, and the client
+    reaches grains on both silos through one gateway."""
+    table = FileMembershipTable(str(tmp_path / "mbr.json"))
+    fabric1, silo1 = await _start_socket_silo("s1", table)
+    fabric2, silo2 = await _start_socket_silo("s2", table)
+    client = None
+    try:
+        # membership convergence across fabrics
+        async def converged():
+            while True:
+                views = [set(s.membership.active) for s in (silo1, silo2)]
+                if all(len(v) == 2 for v in views) and views[0] == views[1]:
+                    return
+                await asyncio.sleep(0.05)
+        await asyncio.wait_for(converged(), timeout=10.0)
+
+        client = await GatewayClient(
+            [silo1.silo_address.endpoint], response_timeout=5.0).connect()
+        # touch many grains; hash placement must land some on each silo
+        wheres = await asyncio.gather(
+            *(client.get_grain(EchoGrain, i).where() for i in range(40)))
+        assert len(set(wheres)) == 2, f"all activations on one silo: {set(wheres)}"
+        counts = (silo1.catalog.activation_count(),
+                  silo2.catalog.activation_count())
+        assert all(c > 0 for c in counts)
+
+        # grain→grain across the wire: relay grain on some silo calls echo
+        # grains wherever they live
+        outs = await asyncio.gather(
+            *(client.get_grain(RelayGrain, i).relay(100 + i, "r")
+              for i in range(10)))
+        assert outs == [f"{100 + i}:r" for i in range(10)]
+    finally:
+        if client is not None:
+            await client.close_async()
+        await silo2.stop()
+        await silo1.stop()
+
+
+async def test_gateway_client_multiple_gateways_affinity(tmp_path):
+    table = FileMembershipTable(str(tmp_path / "mbr.json"))
+    fabric1, silo1 = await _start_socket_silo("s1", table)
+    fabric2, silo2 = await _start_socket_silo("s2", table)
+    client = None
+    try:
+        client = await GatewayClient(
+            [silo1.silo_address.endpoint, silo2.silo_address.endpoint],
+            response_timeout=5.0).connect()
+        assert len(client._live()) == 2
+        outs = await asyncio.gather(
+            *(client.get_grain(EchoGrain, i).echo("y") for i in range(30)))
+        assert outs == [f"{i}:y" for i in range(30)]
+        # same grain always routes through the same gateway (affinity)
+        g = client.get_grain(EchoGrain, 3)
+        first = await g.echo("a")
+        assert first == "3:a"
+    finally:
+        if client is not None:
+            await client.close_async()
+        await silo2.stop()
+        await silo1.stop()
+
+
+async def test_silo_death_detected_over_sockets(tmp_path):
+    """Kill one of two socket silos: the survivor's probe/vote protocol must
+    declare it dead over the real wire, and client calls must re-route
+    (virtual-actor recreation) instead of hanging."""
+    table = FileMembershipTable(str(tmp_path / "mbr.json"))
+    fabric1, silo1 = await _start_socket_silo("s1", table)
+    fabric2, silo2 = await _start_socket_silo("s2", table)
+    client = None
+    try:
+        async def converged(n):
+            while True:
+                if all(len(s.membership.active) == n
+                       for s in (silo1,) if s.status == "Running"):
+                    if n != 2 or len(silo2.membership.active) == 2:
+                        return
+                await asyncio.sleep(0.05)
+        await asyncio.wait_for(converged(2), timeout=10.0)
+
+        client = await GatewayClient(
+            [silo1.silo_address.endpoint], response_timeout=5.0).connect()
+        await asyncio.gather(
+            *(client.get_grain(EchoGrain, i).echo("pre") for i in range(20)))
+
+        dead_addr = silo2.silo_address
+        await silo2.stop(graceful=False)  # kill: no goodbye row
+
+        async def declared_dead():
+            while dead_addr not in silo1.membership.dead:
+                await asyncio.sleep(0.05)
+        await asyncio.wait_for(declared_dead(), timeout=10.0)
+
+        # every grain is callable again — recreated on the survivor
+        outs = await asyncio.gather(
+            *(client.get_grain(EchoGrain, i).echo("post") for i in range(20)),
+            return_exceptions=True)
+        errs = [o for o in outs if isinstance(o, Exception)]
+        assert not errs, f"calls failed after failover: {errs[:3]}"
+        assert outs == [f"{i}:post" for i in range(20)]
+    finally:
+        if client is not None:
+            await client.close_async()
+        await silo1.stop()
+
+
+async def test_gateway_client_reconnects_after_connection_blip(tmp_path):
+    table = FileMembershipTable(str(tmp_path / "mbr.json"))
+    fabric, silo = await _start_socket_silo("s1", table)
+    client = None
+    try:
+        client = await GatewayClient(
+            [silo.silo_address.endpoint], response_timeout=5.0).connect()
+        client._reconnect_period = 0.05
+        g = client.get_grain(EchoGrain, 1)
+        assert await g.echo("a") == "1:a"
+        # sever the TCP connection out from under the client
+        client.conns[0].writer.close()
+        await asyncio.sleep(0.3)  # reconnect loop revives the link
+
+        async def retry():
+            while True:
+                try:
+                    return await g.echo("b")
+                except Exception:
+                    await asyncio.sleep(0.05)
+        out = await asyncio.wait_for(retry(), timeout=5.0)
+        assert out == "1:b"
+    finally:
+        if client is not None:
+            await client.close_async()
+        await silo.stop()
+
+
+class _ModuleLevelUnregistered:
+    """Pickles by reference to the 'tests' module, which is outside the wire
+    allowlist — decodes fail at the receiving silo."""
+
+
+async def test_undecodable_payload_is_rejected_not_hung(tmp_path):
+    """Payload types the wire cannot carry must produce a prompt error at
+    the caller (the serializer registration gate), not a timeout — on both
+    the encode side (unpicklable local class) and the decode side
+    (unregistered module)."""
+    class NotEncodable:
+        pass
+
+    table = FileMembershipTable(str(tmp_path / "mbr.json"))
+    fabric, silo = await _start_socket_silo("s1", table)
+    client = None
+    try:
+        client = await GatewayClient(
+            [silo.silo_address.endpoint], response_timeout=5.0).connect()
+        g = client.get_grain(EchoGrain, 1)
+        t0 = time.monotonic()
+        with pytest.raises(Exception, match="encode"):
+            await g.echo(NotEncodable())
+        with pytest.raises(Exception, match="decode"):
+            await g.echo(_ModuleLevelUnregistered())
+        assert time.monotonic() - t0 < 4.0, "should fail fast, not time out"
+        # the connection survives for subsequent valid calls
+        assert await g.echo("ok") == "1:ok"
+    finally:
+        if client is not None:
+            await client.close_async()
+        await silo.stop()
