@@ -1,5 +1,12 @@
 """Core identity, message, and serialization layers (reference L0/L1)."""
 
+from .asyncs import (  # noqa: F401
+    AsyncPipeline,
+    AsyncSerialExecutor,
+    BatchWorker,
+    ExponentialBackoff,
+    retry,
+)
 from .errors import *  # noqa: F401,F403
 from .ids import (  # noqa: F401
     ActivationAddress,

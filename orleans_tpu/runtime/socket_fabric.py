@@ -39,6 +39,7 @@ import socket
 import time
 from typing import TYPE_CHECKING, Any
 
+from ..core.asyncs import ExponentialBackoff, retry
 from ..core.errors import SiloUnavailableError
 from ..core.ids import SiloAddress
 from ..core.message import Direction, Message
@@ -46,10 +47,10 @@ from .references import GrainFactory
 from .runtime_client import RuntimeClient
 from .wire import (
     FrameError,
+    WireDecodeError,
     _BodyDecodeError,
     decode_handshake,
     decode_message,
-    encode_frame,
     encode_handshake,
     encode_message,
     read_frame,
@@ -88,19 +89,24 @@ class _Sender:
 
     async def _connect(self) -> asyncio.StreamWriter:
         host, port = self.endpoint.rsplit(":", 1)
-        last: Exception | None = None
-        for attempt in range(_CONNECT_RETRIES):
-            try:
-                _, writer = await asyncio.open_connection(host, int(port))
-                writer.write(encode_handshake(
-                    "silo", self.fabric.local_address()))
-                await writer.drain()
-                return writer
-            except OSError as e:
-                last = e
-                await asyncio.sleep(_CONNECT_BACKOFF * (attempt + 1))
-        raise SiloUnavailableError(
-            f"cannot connect to {self.endpoint}: {last}")
+
+        async def dial() -> asyncio.StreamWriter:
+            _, writer = await asyncio.open_connection(host, int(port))
+            writer.write(encode_handshake(
+                "silo", self.fabric.local_address()))
+            await writer.drain()
+            return writer
+
+        try:
+            # jittered backoff so N senders dialing a restarted silo don't
+            # retry in lockstep
+            return await retry(
+                dial, max_attempts=_CONNECT_RETRIES, retry_on=OSError,
+                backoff=ExponentialBackoff(min_delay=_CONNECT_BACKOFF,
+                                           max_delay=2.0))
+        except OSError as e:
+            raise SiloUnavailableError(
+                f"cannot connect to {self.endpoint}: {e}") from e
 
     async def _run(self) -> None:
         while True:
@@ -334,6 +340,12 @@ class SocketFabric:
                 except _BodyDecodeError as e:
                     self._bounce_undecodable(e.message, str(e))
                     continue
+                except WireDecodeError as e:
+                    # headers undecodable: scoped to this message — the
+                    # frame was fully consumed, the connection is fine
+                    log.warning("dropping message with undecodable "
+                                "headers: %s", e)
+                    continue
                 self._route_inbound(silo, msg)
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass  # clean EOF / peer died
@@ -342,7 +354,11 @@ class SocketFabric:
         except Exception:  # noqa: BLE001
             log.exception("connection handler failed (peer=%s)", peer_addr)
         finally:
-            if is_client and peer_addr is not None:
+            # a reconnected client may have re-handshaked on a NEW connection
+            # that overwrote this route — only remove the route if it is
+            # still ours
+            if is_client and peer_addr is not None and \
+                    self.client_routes.get(peer_addr) is writer:
                 self.client_routes.pop(peer_addr, None)
                 self._route_owner.pop(peer_addr, None)
             writer.close()
@@ -452,6 +468,10 @@ class _GatewayConnection:
                             f"undecodable response: {e}")
                     else:
                         continue
+                except WireDecodeError as e:
+                    log.warning("dropping message with undecodable "
+                                "headers: %s", e)
+                    continue
                 self.client.deliver(msg)
         except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
             pass

@@ -1,0 +1,141 @@
+"""Async utility tests (Core/Async/* analog: retry, BatchWorker,
+AsyncSerialExecutor, AsyncPipeline)."""
+
+import asyncio
+
+import pytest
+
+from orleans_tpu.core import (
+    AsyncPipeline,
+    AsyncSerialExecutor,
+    BatchWorker,
+    ExponentialBackoff,
+    retry,
+)
+
+FAST_BACKOFF = ExponentialBackoff(min_delay=0.001, max_delay=0.005)
+
+
+async def test_retry_succeeds_after_transient_failures():
+    calls = []
+
+    async def flaky(attempt):
+        calls.append(attempt)
+        if attempt < 2:
+            raise ConnectionError("transient")
+        return "ok"
+
+    assert await retry(flaky, max_attempts=5, backoff=FAST_BACKOFF) == "ok"
+    assert calls == [0, 1, 2]
+
+
+async def test_retry_gives_up_after_max_attempts():
+    async def always_fails():
+        raise ValueError("nope")
+
+    with pytest.raises(ValueError):
+        await retry(always_fails, max_attempts=3, backoff=FAST_BACKOFF)
+
+
+async def test_retry_respects_filter():
+    calls = []
+
+    async def fails():
+        calls.append(1)
+        raise KeyError("fatal")
+
+    with pytest.raises(KeyError):
+        await retry(fails, max_attempts=5, backoff=FAST_BACKOFF,
+                    retry_on=ConnectionError)
+    assert len(calls) == 1  # non-matching error is not retried
+
+
+async def test_batch_worker_coalesces():
+    runs = []
+
+    async def work():
+        runs.append(1)
+        await asyncio.sleep(0.02)
+
+    w = BatchWorker(work)
+    # burst of notifies while the first batch runs → exactly one more run
+    w.notify()
+    await asyncio.sleep(0.005)
+    for _ in range(10):
+        w.notify()
+    await w.wait_idle()
+    assert len(runs) == 2, f"expected coalescing to 2 runs, got {len(runs)}"
+    # new notify after idle runs again
+    await w.notify_and_wait()
+    assert len(runs) == 3
+    w.close()
+
+
+async def test_serial_executor_is_serial_and_ordered():
+    order = []
+    running = 0
+    max_running = 0
+
+    async def job(i):
+        nonlocal running, max_running
+        running += 1
+        max_running = max(max_running, running)
+        await asyncio.sleep(0.001)
+        order.append(i)
+        running -= 1
+        return i
+
+    ex = AsyncSerialExecutor()
+    results = await asyncio.gather(
+        *(ex.execute(lambda i=i: job(i)) for i in range(10)))
+    assert results == list(range(10))
+    assert order == list(range(10))
+    assert max_running == 1
+
+
+async def test_serial_executor_propagates_errors():
+    ex = AsyncSerialExecutor()
+
+    async def boom():
+        raise RuntimeError("x")
+
+    with pytest.raises(RuntimeError):
+        await ex.execute(boom)
+    # executor still works afterwards
+    async def ok():
+        return 42
+    assert await ex.execute(ok) == 42
+
+
+async def test_pipeline_bounds_concurrency():
+    running = 0
+    max_running = 0
+
+    async def job():
+        nonlocal running, max_running
+        running += 1
+        max_running = max(max_running, running)
+        await asyncio.sleep(0.005)
+        running -= 1
+
+    p = AsyncPipeline(capacity=3)
+    for _ in range(12):
+        await p.add(job())
+    await p.wait_complete()
+    assert max_running <= 3
+    assert p.count == 0
+
+
+async def test_pipeline_surfaces_errors():
+    async def boom():
+        raise ValueError("pipeline error")
+
+    async def ok():
+        await asyncio.sleep(0.001)
+
+    p = AsyncPipeline(capacity=2)
+    await p.add(ok())
+    await p.add(boom())
+    await p.add(ok())
+    with pytest.raises(ValueError):
+        await p.wait_complete()
