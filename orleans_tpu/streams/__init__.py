@@ -2,6 +2,14 @@
 src/Orleans.Runtime/Streams/): SMS direct fan-out + persistent queue-backed
 providers over grain-call delivery."""
 
+from .balancer import (
+    BestFitBalancer,
+    DeploymentBasedBalancer,
+    LeaseBasedBalancer,
+    MemoryLeaseProvider,
+    QueueBalancer,
+)
+from .cache import PooledQueueCache, QueueCacheCursor
 from .core import StreamId, StreamProvider, StreamRef, SubscriptionHandle
 from .persistent import (
     MemoryQueueAdapter,
@@ -20,4 +28,7 @@ __all__ = [
     "QueueAdapter", "QueueReceiver", "QueueBatch", "MemoryQueueAdapter",
     "PersistentStreamProvider", "add_persistent_streams",
     "PubSubRendezvousGrain", "implicit_stream_subscription",
+    "QueueBalancer", "DeploymentBasedBalancer", "BestFitBalancer",
+    "LeaseBasedBalancer", "MemoryLeaseProvider",
+    "PooledQueueCache", "QueueCacheCursor",
 ]

@@ -19,7 +19,9 @@ import logging
 from dataclasses import dataclass
 from typing import TYPE_CHECKING, Callable
 
-from ..core.ids import SiloAddress, stable_hash64
+from ..core.asyncs import ExponentialBackoff, retry
+from .balancer import DeploymentBasedBalancer, QueueBalancer
+from .cache import PooledQueueCache
 from .core import StreamId, StreamProvider, SubscriptionHandle
 from .pubsub import PubSubRendezvousGrain, deliver_to_consumer, resolve_consumers
 
@@ -30,7 +32,8 @@ log = logging.getLogger("orleans.streams.persistent")
 
 __all__ = [
     "QueueBatch", "QueueAdapter", "QueueReceiver", "MemoryQueueAdapter",
-    "PersistentStreamProvider", "PullingManager", "add_persistent_streams",
+    "PersistentStreamProvider", "PullingManager", "PullingAgent",
+    "add_persistent_streams",
 ]
 
 
@@ -66,6 +69,11 @@ class QueueReceiver:
     async def ack(self, batch: QueueBatch) -> None:  # noqa: B027
         pass
 
+    def shutdown(self) -> None:  # noqa: B027
+        """Abandon the receiver: unacked batches must become visible to the
+        queue's next owner (IQueueAdapterReceiver.Shutdown — at-least-once
+        across queue-ownership handoff)."""
+
 
 class MemoryQueueAdapter(QueueAdapter):
     """In-proc shared queue bank: the dev/test "external queue service".
@@ -90,51 +98,104 @@ class MemoryQueueAdapter(QueueAdapter):
 class _MemoryReceiver(QueueReceiver):
     def __init__(self, queue: collections.deque):
         self._queue = queue
+        # ALL delivered-but-unacked batches, across pulls — acks may arrive
+        # long after later pulls (cursor-paced consumers)
         self._inflight: list[QueueBatch] = []
 
     async def get_messages(self, max_count: int) -> list[QueueBatch]:
         out = []
         while self._queue and len(out) < max_count:
             out.append(self._queue.popleft())
-        # keep a separate inflight list: ack() mutates it while the agent
-        # iterates the returned list
-        self._inflight = list(out)
+        self._inflight.extend(out)
         return out
 
     async def ack(self, batch: QueueBatch) -> None:
         if batch in self._inflight:
             self._inflight.remove(batch)
 
+    def shutdown(self) -> None:
+        """Return unacked batches to the head of the shared queue (in order)
+        so the queue's next owner redelivers them."""
+        for batch in reversed(self._inflight):
+            self._queue.appendleft(batch)
+        self._inflight.clear()
 
-def deployment_balancer(queue_id: int, adapter_name: str,
-                        silos: list[SiloAddress]) -> SiloAddress | None:
-    """Queue→silo assignment by consistent hash over the alive set
-    (DeploymentBasedQueueBalancer.cs:40 — deterministic, membership-driven,
-    no coordination needed: every silo computes the same mapping)."""
-    if not silos:
-        return None
-    # rendezvous (highest-random-weight) hashing: minimal churn on join/leave
-    return min(silos, key=lambda s: stable_hash64(
-        f"qb|{adapter_name}|{queue_id}|{s.endpoint}|{s.generation}"))
+
+class _ConsumerPump:
+    """One consumer's delivery loop over the agent's cache: an independent
+    cursor + serial task, so a slow consumer throttles only itself (and,
+    via cache pressure, the pull) — never other consumers."""
+
+    def __init__(self, agent: "PullingAgent", stream: StreamId, handle):
+        self.agent = agent
+        self.stream = stream
+        self.handle = handle
+        self.key = (stream, handle.handle_id)
+        self.cursor = agent.cache.new_cursor(self.key, from_oldest=True)
+        self.wake = asyncio.Event()
+        self.task = asyncio.get_running_loop().create_task(self._run())
+
+    async def _run(self) -> None:
+        agent = self.agent
+        while True:
+            # clear BEFORE checking so a set() racing the check is kept
+            self.wake.clear()
+            cb = self._next_mine()
+            if cb is None:
+                await agent.evict_and_ack()  # yields: new batches may land
+                cb = self._next_mine()
+                if cb is None:
+                    await self.wake.wait()
+                    continue
+            await self._deliver(cb.batch)
+
+    def _next_mine(self):
+        """Advance past other streams' batches to the next batch of ours."""
+        while True:
+            cb = self.agent.cache.next(self.cursor)
+            if cb is None or cb.batch.stream == self.stream:
+                return cb
+
+    async def _deliver(self, batch: QueueBatch) -> None:
+        silo = self.agent.provider.silo
+        try:
+            await retry(
+                lambda: deliver_to_consumer(
+                    silo, self.handle, batch.items, batch.seq),
+                max_attempts=self.agent.max_delivery_attempts,
+                backoff=ExponentialBackoff(min_delay=0.05, max_delay=2.0))
+        except Exception as exc:  # noqa: BLE001 — retries exhausted
+            self.agent.provider.on_delivery_failure(
+                self.handle, self.stream, batch, exc)
+
+    def stop(self) -> None:
+        self.agent.cache.remove_cursor(self.key)
+        self.task.cancel()
 
 
 class PullingAgent:
-    """One owned queue's pump (PersistentStreamPullingAgent.cs:13): pull a
-    batch, resolve subscribers, deliver in order with bounded backoff retry,
-    then ack. A small bounded cache of recent batches supports diagnostics
-    (the SimpleQueueCache stand-in)."""
+    """One owned queue's pump (PersistentStreamPullingAgent.cs:13): pull
+    into a cursor-based PooledQueueCache, fan out via independent
+    per-consumer pumps, ack batches upstream only once every cursor has
+    passed them, and pause pulling while the cache is under pressure —
+    slow consumers throttle the pull instead of forcing redelivery."""
 
     def __init__(self, provider: "PersistentStreamProvider", queue_id: int,
                  pull_period: float, max_batch: int,
-                 max_delivery_attempts: int = 3, cache_size: int = 1024):
+                 max_delivery_attempts: int = 3, cache_capacity: int = 256,
+                 consumer_refresh_period: float = 1.0):
         self.provider = provider
         self.queue_id = queue_id
         self.pull_period = pull_period
         self.max_batch = max_batch
         self.max_delivery_attempts = max_delivery_attempts
+        self.consumer_refresh_period = consumer_refresh_period
         self.receiver = provider.adapter.create_receiver(queue_id)
-        self.cache: collections.deque[QueueBatch] = collections.deque(
-            maxlen=cache_size)
+        self.cache = PooledQueueCache(capacity=cache_capacity)
+        self.pumps: dict[tuple, _ConsumerPump] = {}
+        self._streams_seen: dict[StreamId, float] = {}  # stream -> last refresh
+        self._stream_activity: dict[StreamId, float] = {}  # stream -> last batch
+        self.stream_idle_ttl = 5 * consumer_refresh_period
         self._task: asyncio.Task | None = None
 
     def start(self) -> None:
@@ -144,46 +205,91 @@ class PullingAgent:
         if self._task is not None:
             self._task.cancel()
             self._task = None
+        for pump in self.pumps.values():
+            pump.stop()
+        self.pumps.clear()
+        # hand unacked work back to the queue for the next owner
+        try:
+            self.receiver.shutdown()
+        except Exception:  # noqa: BLE001
+            log.exception("receiver shutdown failed for queue %d",
+                          self.queue_id)
 
     async def _run(self) -> None:
         silo = self.provider.silo
+        loop = asyncio.get_running_loop()
         while True:
+            if self.cache.under_pressure:
+                # backpressure: the slowest consumer gates the pull
+                # (SimpleQueueCache under-pressure semantics)
+                await asyncio.sleep(self.pull_period)
+                await self.evict_and_ack()
+                continue
             try:
                 batches = await self.receiver.get_messages(self.max_batch)
             except Exception:  # noqa: BLE001
                 log.exception("queue %d read failed", self.queue_id)
                 batches = []
-            if not batches:
-                await asyncio.sleep(self.pull_period)
-                continue
             for batch in batches:
-                self.cache.append(batch)
+                self.cache.add(batch)
                 silo.stats.increment("streams.persistent.pulled",
                                      len(batch.items))
-                await self._deliver_batch(batch)
-                await self.receiver.ack(batch)
-
-    async def _deliver_batch(self, batch: QueueBatch) -> None:
-        silo = self.provider.silo
-        try:
-            consumers = await resolve_consumers(silo, batch.stream)
-        except Exception:  # noqa: BLE001
-            log.exception("pubsub resolve failed for %s", batch.stream)
-            return
-        for handle in consumers:
-            backoff = 0.05
-            for attempt in range(self.max_delivery_attempts):
-                try:
-                    await deliver_to_consumer(
-                        silo, handle, batch.items, batch.seq)
-                    break
-                except Exception as exc:  # noqa: BLE001
-                    if attempt + 1 == self.max_delivery_attempts:
-                        self.provider.on_delivery_failure(
-                            handle, batch.stream, batch, exc)
+            streams = {b.stream for b in batches}
+            now = loop.time()
+            for stream in streams:
+                self._stream_activity[stream] = now
+            # refresh pub-sub views for streams that are new or stale;
+            # prune streams gone idle with no consumers and nothing cached
+            # (the agent's stream-TTL purge — otherwise dead streams are
+            # re-resolved forever)
+            cached_streams = self.cache.cached_streams() \
+                if len(streams) < len(self._streams_seen) else set()
+            for stream in list(self._streams_seen):
+                if now - self._streams_seen[stream] \
+                        > self.consumer_refresh_period:
+                    has_pump = any(k[0] == stream for k in self.pumps)
+                    idle = now - self._stream_activity.get(stream, now) \
+                        > self.stream_idle_ttl
+                    if idle and not has_pump and stream not in cached_streams:
+                        self._streams_seen.pop(stream, None)
+                        self._stream_activity.pop(stream, None)
                     else:
-                        await asyncio.sleep(backoff)
-                        backoff *= 2
+                        streams.add(stream)
+            for stream in streams:
+                await self._refresh_consumers(stream, now)
+            if batches:
+                for pump in self.pumps.values():
+                    pump.wake.set()
+            else:
+                await asyncio.sleep(self.pull_period)
+
+    async def _refresh_consumers(self, stream: StreamId, now: float) -> None:
+        """Reconcile per-consumer pumps with the pub-sub view
+        (the agent's AddSubscriber/RemoveSubscriber path)."""
+        self._streams_seen[stream] = now
+        try:
+            handles = await resolve_consumers(self.provider.silo, stream)
+        except Exception:  # noqa: BLE001
+            log.exception("pubsub resolve failed for %s", stream)
+            return
+        live = {(stream, h.handle_id) for h in handles}
+        for key in [k for k in self.pumps if k[0] == stream and k not in live]:
+            self.pumps.pop(key).stop()
+        for h in handles:
+            key = (stream, h.handle_id)
+            if key not in self.pumps:
+                self.pumps[key] = _ConsumerPump(self, stream, h)
+                self.pumps[key].wake.set()
+
+    async def evict_and_ack(self) -> None:
+        """Evict fully-consumed batches and ack them upstream — at-least-once
+        delivery: a batch leaves the external queue only after every
+        consumer cursor has passed it."""
+        for batch in self.cache.purge():
+            try:
+                await self.receiver.ack(batch)
+            except Exception:  # noqa: BLE001
+                log.exception("ack failed for queue %d", self.queue_id)
 
 
 class PullingManager:
@@ -213,6 +319,7 @@ class PullingManager:
         for agent in self.agents.values():
             agent.stop()
         self.agents.clear()
+        self.provider.balancer.close(self.provider.silo.silo_address)
 
     async def _loop(self) -> None:
         while True:
@@ -223,22 +330,25 @@ class PullingManager:
                 pass
             self._kick.clear()
             try:
-                self._rebalance()
+                await self._rebalance()
             except Exception:  # noqa: BLE001
                 log.exception("stream queue rebalance failed")
 
-    def _rebalance(self) -> None:
+    async def _rebalance(self) -> None:
+        """Recompute owned queues via the provider's balancer; the loop's
+        period doubles as the lease renewal timer for LeaseBasedBalancer."""
         p = self.provider
         me = p.silo.silo_address
         alive = p.silo.locator.alive_list
-        mine = {q for q in range(p.adapter.n_queues)
-                if deployment_balancer(q, p.adapter.name, alive) == me}
+        mine = await p.balancer.owned_queues(
+            p.adapter.n_queues, p.adapter.name, me, alive)
         for q in list(self.agents):
             if q not in mine:
                 self.agents.pop(q).stop()
         for q in mine:
             if q not in self.agents:
-                agent = PullingAgent(p, q, p.pull_period, p.max_batch)
+                agent = PullingAgent(p, q, p.pull_period, p.max_batch,
+                                     cache_capacity=p.cache_capacity)
                 agent.start()
                 self.agents[q] = agent
 
@@ -248,13 +358,18 @@ class PersistentStreamProvider(StreamProvider):
 
     def __init__(self, silo: "Silo", name: str, adapter: QueueAdapter,
                  pull_period: float = 0.1, max_batch: int = 32,
-                 failure_handler: Callable | None = None):
+                 failure_handler: Callable | None = None,
+                 balancer: "QueueBalancer | None" = None,
+                 cache_capacity: int = 256,
+                 rebalance_period: float = 2.0):
         super().__init__(silo, name)
         self.adapter = adapter
         self.pull_period = pull_period
         self.max_batch = max_batch
         self.failure_handler = failure_handler
-        self.manager = PullingManager(self)
+        self.balancer = balancer or DeploymentBasedBalancer()
+        self.cache_capacity = cache_capacity
+        self.manager = PullingManager(self, rebalance_period=rebalance_period)
 
     async def produce(self, stream: StreamId, items: list) -> None:
         queue_id = stream.uniform_hash % self.adapter.n_queues

@@ -1,0 +1,222 @@
+"""Queue balancer + pooled cache tests (QueueBalancer/ + PooledCache/
+analogs): assignment coverage and churn, lease failover, cursor isolation,
+backpressure, and the slow-consumer integration path."""
+
+import asyncio
+
+from orleans_tpu.core.ids import SiloAddress
+from orleans_tpu.streams import (
+    BestFitBalancer,
+    DeploymentBasedBalancer,
+    LeaseBasedBalancer,
+    MemoryLeaseProvider,
+    MemoryQueueAdapter,
+    PooledQueueCache,
+)
+from orleans_tpu.streams.persistent import QueueBatch
+from orleans_tpu.streams.core import StreamId
+
+
+def _silos(n):
+    return [SiloAddress("10.0.0.%d" % i, 5000, i + 1) for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# Balancers
+# ---------------------------------------------------------------------------
+
+async def test_deployment_balancer_covers_all_queues_exactly_once():
+    silos = _silos(3)
+    b = DeploymentBasedBalancer()
+    owned = [await b.owned_queues(16, "q", s, silos) for s in silos]
+    union = set().union(*owned)
+    assert union == set(range(16))
+    assert sum(len(o) for o in owned) == 16  # no double ownership
+
+
+async def test_deployment_balancer_minimal_churn_on_leave():
+    silos = _silos(4)
+    b = DeploymentBasedBalancer()
+    before = {s: await b.owned_queues(32, "q", s, silos) for s in silos}
+    survivors = silos[:3]
+    after = {s: await b.owned_queues(32, "q", s, survivors)
+             for s in survivors}
+    # rendezvous hashing: survivors keep everything they had
+    for s in survivors:
+        assert before[s] <= after[s]
+    assert set().union(*after.values()) == set(range(32))
+
+
+async def test_best_fit_balancer_even_counts():
+    silos = _silos(3)
+    b = BestFitBalancer()
+    owned = [await b.owned_queues(8, "q", s, silos) for s in silos]
+    counts = sorted(len(o) for o in owned)
+    assert counts == [2, 3, 3]
+    assert set().union(*owned) == set(range(8))
+
+
+async def test_lease_balancer_acquires_fair_share_and_fails_over():
+    provider = MemoryLeaseProvider()
+    silos = _silos(2)
+    b1 = LeaseBasedBalancer(provider, ttl=0.2)
+    b2 = LeaseBasedBalancer(provider, ttl=0.2)
+    o1 = await b1.owned_queues(8, "q", silos[0], silos)
+    o2 = await b2.owned_queues(8, "q", silos[1], silos)
+    assert len(o1) == 4 and len(o2) == 4
+    assert o1 | o2 == set(range(8)) and not (o1 & o2)
+
+    # silo 1 dies (stops renewing): its leases expire and silo 2 takes over
+    await asyncio.sleep(0.25)
+    o2b = await b2.owned_queues(8, "q", silos[1], [silos[1]])
+    assert o2b == set(range(8))
+
+
+async def test_lease_balancer_sheds_excess_when_silo_joins():
+    provider = MemoryLeaseProvider()
+    silos = _silos(2)
+    b1 = LeaseBasedBalancer(provider, ttl=5.0)
+    all_mine = await b1.owned_queues(8, "q", silos[0], [silos[0]])
+    assert all_mine == set(range(8))
+    # a peer joins: fair share drops to 4, excess leases are released
+    mine_now = await b1.owned_queues(8, "q", silos[0], silos)
+    assert len(mine_now) == 4
+    b2 = LeaseBasedBalancer(provider, ttl=5.0)
+    theirs = await b2.owned_queues(8, "q", silos[1], silos)
+    assert len(theirs) == 4 and not (mine_now & theirs)
+
+
+async def test_receiver_shutdown_requeues_unacked_batches():
+    """At-least-once across queue-ownership handoff: an abandoned receiver
+    must return unacked batches to the queue for the next owner."""
+    adapter = MemoryQueueAdapter(n_queues=1)
+    sid = StreamId("mem", "ns", "s")
+    for i in range(5):
+        await adapter.queue_message_batch(0, sid, [i])
+    r1 = adapter.create_receiver(0)
+    got = await r1.get_messages(5)
+    assert len(got) == 5
+    await r1.ack(got[0])
+    await r1.ack(got[1])
+    r1.shutdown()  # owner dies with 3 batches unacked
+
+    r2 = adapter.create_receiver(0)
+    redelivered = await r2.get_messages(10)
+    assert [b.items[0] for b in redelivered] == [2, 3, 4]
+
+
+# ---------------------------------------------------------------------------
+# Pooled cache
+# ---------------------------------------------------------------------------
+
+def _batch(stream_name: str, seq: int):
+    return QueueBatch(StreamId("mem", "ns", stream_name), [seq], seq)
+
+
+def test_cache_cursors_are_independent():
+    c = PooledQueueCache(capacity=16)
+    for i in range(4):
+        c.add(_batch("a", i))
+    fast = c.new_cursor("fast")
+    slow = c.new_cursor("slow")
+    got_fast = [c.next(fast).batch.seq for _ in range(4)]
+    assert got_fast == [0, 1, 2, 3]
+    assert c.next(fast) is None
+    # slow cursor still sees everything; nothing evicted yet
+    assert not c.purge()
+    got_slow = [c.next(slow).batch.seq for _ in range(4)]
+    assert got_slow == [0, 1, 2, 3]
+    evicted = c.purge()
+    assert [b.seq for b in evicted] == [0, 1, 2, 3]
+    assert c.count == 0
+
+
+def test_cache_pressure_and_purge_without_cursors():
+    c = PooledQueueCache(capacity=4, pressure_threshold=0.75)
+    assert not c.under_pressure
+    for i in range(3):
+        c.add(_batch("a", i))
+    assert c.under_pressure
+    # no cursors: everything is evictable
+    assert len(c.purge()) == 3
+    assert not c.under_pressure
+
+
+async def test_slow_consumer_does_not_block_fast_consumer():
+    """Two consumers of one persistent stream: one sleeps per event. The
+    fast one must finish long before the slow one (independent cursor
+    pumps), instead of being serialized behind it."""
+    from orleans_tpu.runtime import ClusterClient, Grain, InProcFabric, \
+        SiloBuilder
+    from orleans_tpu.storage import MemoryStorage
+    from orleans_tpu.streams import add_persistent_streams
+
+    done = {}
+
+    class SlowConsumer(Grain):
+        async def join(self):
+            stream = self.get_stream_provider("q").get_stream("ns", "s")
+            await stream.subscribe(self.on_event)
+
+        async def on_event(self, item, token):
+            await asyncio.sleep(0.05)
+            done.setdefault("slow", []).append(item)
+
+    class FastConsumer(Grain):
+        async def join(self):
+            stream = self.get_stream_provider("q").get_stream("ns", "s")
+            await stream.subscribe(self.on_event)
+
+        async def on_event(self, item, token):
+            done.setdefault("fast", []).append(item)
+
+    class Producer(Grain):
+        async def publish(self, items):
+            stream = self.get_stream_provider("q").get_stream("ns", "s")
+            await stream.on_next_batch(items)
+
+    fabric = InProcFabric()
+    adapter = MemoryQueueAdapter(n_queues=2)
+    b = (SiloBuilder().with_name("sb").with_fabric(fabric)
+         .add_grains(SlowConsumer, FastConsumer, Producer)
+         .with_storage("Default", MemoryStorage()))
+    add_persistent_streams(b, "q", adapter, pull_period=0.02)
+    silo = b.build()
+    await silo.start()
+    client = await ClusterClient(fabric).connect()
+    try:
+        await client.get_grain(SlowConsumer, 1).join()
+        await client.get_grain(FastConsumer, 2).join()
+        await client.get_grain(Producer, 3).publish(list(range(10)))
+
+        async def fast_done():
+            while len(done.get("fast", [])) < 10:
+                await asyncio.sleep(0.01)
+        t0 = asyncio.get_running_loop().time()
+        await asyncio.wait_for(fast_done(), timeout=5.0)
+        fast_t = asyncio.get_running_loop().time() - t0
+        # slow consumer needs ≥0.5s total; fast must not be gated on it
+        assert len(done.get("slow", [])) < 10
+        assert fast_t < 0.4, f"fast consumer was serialized: {fast_t:.2f}s"
+
+        async def slow_done():
+            while len(done.get("slow", [])) < 10:
+                await asyncio.sleep(0.02)
+        await asyncio.wait_for(slow_done(), timeout=5.0)
+        assert done["slow"] == list(range(10))  # order preserved
+        assert done["fast"] == list(range(10))
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+def test_cache_late_cursor_starts_at_oldest_or_latest():
+    c = PooledQueueCache(capacity=16)
+    for i in range(3):
+        c.add(_batch("a", i))
+    old = c.new_cursor("old", from_oldest=True)
+    new = c.new_cursor("new", from_oldest=False)
+    assert c.next(old).batch.seq == 0
+    assert c.next(new) is None  # only future batches
+    c.add(_batch("a", 3))
+    assert c.next(new).batch.seq == 3
