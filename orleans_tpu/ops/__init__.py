@@ -1,0 +1,31 @@
+"""TPU hot-op kernel library (Pallas + MXU-shaped XLA).
+
+The reference implements its performance-critical machinery as raw sockets,
+pinned buffer pools and IL-emitted serializers (SURVEY.md preamble;
+/root/reference/src/Orleans.Core/Messaging/SocketManager.cs,
+Serialization/ILSerializerGenerator.cs). The TPU build's native tier is
+this module: the per-tick dispatch hot ops re-expressed for the MXU/VPU —
+fan-in reduction as blocked one-hot matmuls, destination ranking as a
+triangular matmul instead of a sort, and directory lookup as vectorized
+hash probing — with Pallas kernels where blocking/fusion beats what XLA
+emits.
+"""
+
+from .hash_probe import DeviceDirectory, build_directory_arrays, device_lookup
+from .route import pack_by_dest, rank_by_dest
+from .segment_reduce import (
+    segment_sum,
+    segment_sum_onehot,
+    segment_sum_pallas,
+)
+
+__all__ = [
+    "segment_sum",
+    "segment_sum_onehot",
+    "segment_sum_pallas",
+    "rank_by_dest",
+    "pack_by_dest",
+    "device_lookup",
+    "build_directory_arrays",
+    "DeviceDirectory",
+]

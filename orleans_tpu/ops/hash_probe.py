@@ -1,0 +1,194 @@
+"""Device-resident directory: batched hash-probe lookup on the chip.
+
+The reference's grain directory is a host hash map partitioned over silos
+(GrainDirectoryPartition.cs:207,215) with LRU/adaptive caches in front
+(LRUBasedGrainDirectoryCache.cs, AdaptiveGrainDirectoryCache.cs). On TPU
+the cache tier moves onto the device: an open-addressing table (power-of-
+two capacity, linear probing, multiplicative hashing) stored as two int32
+arrays. Inserts/removes are host-side (activation create/destroy is the
+cold path — Catalog.GetOrCreateActivation, Catalog.cs:443); lookups are a
+batched device op on the hot path, so a tick can resolve thousands of
+``key → slot`` routes without a host round-trip.
+
+Lookup is P parallel gathers (probe depth is static), not a Pallas kernel
+*by design*: XLA lowers a [B, P] gather from an HBM-resident table
+optimally, and there is no fusion or blocking a hand-written kernel would
+add — the Pallas wins live in the reduce/pack ops (segment_reduce, route).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["EMPTY", "build_directory_arrays", "device_lookup",
+           "DeviceDirectory"]
+
+EMPTY = -1
+_MULT = np.uint32(2654435761)  # Knuth multiplicative hash
+
+
+def _hash_np(keys: np.ndarray, cap: int) -> np.ndarray:
+    return ((keys.astype(np.uint32) * _MULT) >> np.uint32(1)) % np.uint32(cap)
+
+
+def _hash_jnp(keys: jax.Array, cap: int) -> jax.Array:
+    h = (keys.astype(jnp.uint32) * jnp.uint32(_MULT)) >> jnp.uint32(1)
+    return (h % jnp.uint32(cap)).astype(jnp.int32)
+
+
+def build_directory_arrays(entries: dict[int, int], capacity: int,
+                           max_probes: int = 16):
+    """Host-build (tkeys, tvals) int32 arrays from key→value pairs.
+
+    capacity must be a power of two and > len(entries) (keep load factor
+    ≤ 0.5 so ``max_probes`` bounds hold).
+    """
+    if capacity & (capacity - 1):
+        raise ValueError("capacity must be a power of two")
+    if len(entries) * 2 > capacity:
+        raise ValueError(
+            f"load factor too high: {len(entries)} entries / {capacity}")
+    tkeys = np.full(capacity, EMPTY, dtype=np.int32)
+    tvals = np.zeros(capacity, dtype=np.int32)
+    for k, v in entries.items():
+        k31 = k & 0x7FFFFFFF
+        h = int(_hash_np(np.asarray(k31), capacity))
+        for p in range(max_probes):
+            idx = (h + p) % capacity
+            if tkeys[idx] == EMPTY or tkeys[idx] == k31:
+                tkeys[idx] = k31
+                tvals[idx] = v
+                break
+        else:
+            raise RuntimeError(
+                f"probe depth {max_probes} exhausted inserting {k}")
+    return tkeys, tvals
+
+
+def device_lookup(tkeys: jax.Array, tvals: jax.Array, keys: jax.Array,
+                  max_probes: int = 16):
+    """Batched lookup: keys [B] → (vals [B] int32, found [B] bool).
+
+    jit/shard_map-safe; missing keys return (0, False).
+    """
+    cap = tkeys.shape[0]
+    k31 = (keys & 0x7FFFFFFF).astype(jnp.int32)
+    h = _hash_jnp(k31, cap)                                  # [B]
+    probes = (h[:, None] + jnp.arange(max_probes, dtype=jnp.int32)) % cap
+    tk = tkeys[probes]                                       # [B, P]
+    match = tk == k31[:, None]
+    # linear probing invariant: the first EMPTY terminates the chain
+    before_empty = jnp.cumprod((tk != EMPTY).astype(jnp.int32),
+                               axis=1).astype(bool)
+    hit = match & before_empty
+    found = jnp.any(hit, axis=1)
+    first = jnp.argmax(hit, axis=1)
+    vals = tvals[jnp.take_along_axis(probes, first[:, None], axis=1)[:, 0]]
+    return jnp.where(found, vals, 0), found
+
+
+class DeviceDirectory:
+    """Host-mutated, device-queried key→slot directory (the on-chip
+    directory-cache tier; see module docstring).
+
+    Host writes go to numpy shadows; the device copy refreshes lazily on
+    the next batched lookup (write-behind, like the adaptive cache
+    maintainer's batched revalidation — AdaptiveDirectoryCacheMaintainer.cs).
+    """
+
+    def __init__(self, capacity: int = 1024, max_probes: int = 16):
+        if capacity & (capacity - 1):
+            raise ValueError("capacity must be a power of two")
+        self.capacity = capacity
+        self.max_probes = max_probes
+        self.tkeys = np.full(capacity, EMPTY, dtype=np.int32)
+        self.tvals = np.zeros(capacity, dtype=np.int32)
+        self.count = 0
+        self._dev: tuple[jax.Array, jax.Array] | None = None
+
+    def _probe_host(self, k31: int) -> int | None:
+        h = int(_hash_np(np.asarray(k31), self.capacity))
+        for p in range(self.max_probes):
+            idx = (h + p) % self.capacity
+            tk = self.tkeys[idx]
+            if tk == EMPTY or tk == k31:
+                return idx
+        return None
+
+    def insert(self, key: int, val: int) -> None:
+        if (self.count + 1) * 2 > self.capacity:
+            self._grow()
+        k31 = key & 0x7FFFFFFF
+        idx = self._probe_host(k31)
+        if idx is None:
+            self._grow()
+            idx = self._probe_host(k31)
+            assert idx is not None
+        if self.tkeys[idx] == EMPTY:
+            self.count += 1
+        self.tkeys[idx] = k31
+        self.tvals[idx] = val
+        self._dev = None
+
+    def remove(self, key: int) -> bool:
+        """Tombstone-free removal: re-insert the tail of the probe cluster
+        (standard open-addressing backward-shift delete)."""
+        k31 = key & 0x7FFFFFFF
+        h = int(_hash_np(np.asarray(k31), self.capacity))
+        idx = None
+        for p in range(self.max_probes):
+            i = (h + p) % self.capacity
+            if self.tkeys[i] == k31:
+                idx = i
+                break
+            if self.tkeys[i] == EMPTY:
+                return False
+        if idx is None:
+            return False
+        # backward-shift: rehash the contiguous cluster after idx
+        self.tkeys[idx] = EMPTY
+        self.count -= 1
+        j = (idx + 1) % self.capacity
+        moved: list[tuple[int, int]] = []
+        while self.tkeys[j] != EMPTY:
+            moved.append((int(self.tkeys[j]), int(self.tvals[j])))
+            self.tkeys[j] = EMPTY
+            self.count -= 1
+            j = (j + 1) % self.capacity
+        for k, v in moved:
+            # re-insert without growth: these entries already fit at this
+            # capacity, and _grow here would drop the not-yet-reinserted tail
+            i2 = self._probe_host(k)
+            assert i2 is not None
+            if self.tkeys[i2] == EMPTY:
+                self.count += 1
+            self.tkeys[i2] = k
+            self.tvals[i2] = v
+        self._dev = None
+        return True
+
+    def _grow(self) -> None:
+        entries = {int(k): int(v)
+                   for k, v in zip(self.tkeys, self.tvals) if k != EMPTY}
+        self.capacity *= 2
+        self.tkeys, self.tvals = build_directory_arrays(
+            entries, self.capacity, self.max_probes)
+        self._dev = None
+
+    def device_arrays(self) -> tuple[jax.Array, jax.Array]:
+        if self._dev is None:
+            self._dev = (jnp.asarray(self.tkeys), jnp.asarray(self.tvals))
+        return self._dev
+
+    def lookup_batch(self, keys) -> tuple[jax.Array, jax.Array]:
+        tk, tv = self.device_arrays()
+        return device_lookup(tk, tv, jnp.asarray(keys), self.max_probes)
+
+    def lookup(self, key: int) -> int | None:
+        k31 = key & 0x7FFFFFFF
+        idx = self._probe_host(k31)
+        if idx is None or self.tkeys[idx] != k31:
+            return None
+        return int(self.tvals[idx])

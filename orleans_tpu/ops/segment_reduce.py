@@ -1,0 +1,122 @@
+"""Fan-in segment reduction as MXU matmuls.
+
+The fan-in hot op: N messages carrying values land on S target actors
+(Presence GameGrain aggregating player heartbeats — reference
+/root/reference/Samples/Presence/Grains/GameGrain.cs; every stream-consumer
+fan-in has the same shape). The obvious ``jax.ops.segment_sum`` lowers to
+an XLA scatter-add, which TPUs execute (mostly) serially — it is the
+classic TPU anti-pattern. Both implementations here instead ride the MXU:
+
+``segment_sum_onehot``
+    out[s] = sum_i (seg_ids[i] == s) * values[i]  ==  onehot(seg_ids).T @ values
+    — one [S, B] x [B, D] matmul. XLA fuses the one-hot mask into the
+    matmul operand, so the O(S*B) mask is never materialized in HBM.
+
+``segment_sum_pallas``
+    The same contraction, hand-blocked: grid over (segment tiles, message
+    tiles), the mask block built in VMEM from a broadcasted iota and fed
+    straight to the MXU via ``jnp.dot``. Accumulates across message tiles
+    in the output block (grid is sequential on TPU), so HBM traffic is
+    one read of values/ids + one write of out.
+
+``segment_sum`` picks the Pallas path on TPU for well-tiled shapes and the
+one-hot path otherwise (and everywhere on CPU, where Pallas runs in
+interpret mode only for tests).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["segment_sum", "segment_sum_onehot", "segment_sum_pallas"]
+
+
+def _as_2d(values: jax.Array) -> tuple[jax.Array, bool]:
+    if values.ndim == 1:
+        return values[:, None], True
+    if values.ndim == 2:
+        return values, False
+    raise ValueError(f"values must be [B] or [B, D], got {values.shape}")
+
+
+def segment_sum_onehot(values: jax.Array, seg_ids: jax.Array,
+                       num_segments: int) -> jax.Array:
+    """MXU segment sum: ``onehot(seg_ids).T @ values``.
+
+    values: [B] or [B, D]; seg_ids: [B] int (out-of-range ids contribute
+    nothing). Returns [S] or [S, D] in values.dtype (accumulated in f32).
+    """
+    v, squeeze = _as_2d(values)
+    ids = seg_ids.astype(jnp.int32)
+    seg_range = jax.lax.broadcasted_iota(jnp.int32, (num_segments, 1), 0)
+    mask = (seg_range == ids[None, :]).astype(jnp.float32)  # [S, B]
+    out = jnp.dot(mask, v.astype(jnp.float32),
+                  preferred_element_type=jnp.float32)
+    out = out.astype(values.dtype)
+    return out[:, 0] if squeeze else out
+
+
+def _seg_kernel(ids_ref, v_ref, out_ref, *, block_s: int):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    i = pl.program_id(0)
+    seg_base = i * block_s
+    ids = ids_ref[0, :]                                  # [TB]
+    seg = jax.lax.broadcasted_iota(jnp.int32, (block_s, ids.shape[0]), 0)
+    mask = (seg + seg_base == ids[None, :]).astype(jnp.float32)  # [TS, TB]
+    out_ref[:] += jnp.dot(mask, v_ref[:].astype(jnp.float32),
+                          preferred_element_type=jnp.float32)
+
+
+def segment_sum_pallas(values: jax.Array, seg_ids: jax.Array,
+                       num_segments: int, *, block_s: int = 256,
+                       block_b: int = 512,
+                       interpret: bool | None = None) -> jax.Array:
+    """Blocked-MXU segment sum (see module docstring). Pads B and S up to
+    tile multiples; out-of-range ids never match a segment tile."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    v, squeeze = _as_2d(values)
+    B, D = v.shape
+    ids = seg_ids.astype(jnp.int32)
+    block_s = min(block_s, max(8, num_segments))
+    block_b = min(block_b, max(128, B))
+    Bp = -(-B // block_b) * block_b
+    Sp = -(-num_segments // block_s) * block_s
+    if Bp != B:
+        v = jnp.pad(v, ((0, Bp - B), (0, 0)))
+        ids = jnp.pad(ids, (0, Bp - B), constant_values=-1)
+    out = pl.pallas_call(
+        functools.partial(_seg_kernel, block_s=block_s),
+        grid=(Sp // block_s, Bp // block_b),
+        in_specs=[
+            pl.BlockSpec((1, block_b), lambda i, j: (0, j)),
+            pl.BlockSpec((block_b, D), lambda i, j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_s, D), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((Sp, D), jnp.float32),
+        interpret=interpret,
+    )(ids[None, :], v)
+    out = out[:num_segments].astype(values.dtype)
+    return out[:, 0] if squeeze else out
+
+
+def segment_sum(values: jax.Array, seg_ids: jax.Array,
+                num_segments: int) -> jax.Array:
+    """Fan-in reduction, MXU-shaped. Dispatches to the Pallas kernel on TPU
+    when the shape tiles well; the fused one-hot matmul otherwise."""
+    v2, _ = _as_2d(values)
+    B, D = v2.shape
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu and B >= 1024 and num_segments >= 256 and D % 128 == 0:
+        return segment_sum_pallas(values, seg_ids, num_segments,
+                                  interpret=False)
+    return segment_sum_onehot(values, seg_ids, num_segments)

@@ -1,0 +1,166 @@
+"""Hot-op kernel tests (orleans_tpu.ops) — run on the CPU backend with
+Pallas in interpret mode; numerical references are plain numpy."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from orleans_tpu.ops import (
+    DeviceDirectory,
+    build_directory_arrays,
+    device_lookup,
+    pack_by_dest,
+    rank_by_dest,
+    segment_sum,
+    segment_sum_onehot,
+    segment_sum_pallas,
+)
+
+
+def _np_segment_sum(values, ids, S):
+    out = np.zeros((S, *values.shape[1:]), np.float64)
+    for i, s in enumerate(ids):
+        if 0 <= s < S:
+            out[s] += values[i]
+    return out
+
+
+class TestSegmentSum:
+    def test_onehot_matches_numpy_1d(self):
+        rng = np.random.default_rng(1)
+        v = rng.normal(size=300).astype(np.float32)
+        ids = rng.integers(0, 40, size=300)
+        got = segment_sum_onehot(jnp.asarray(v), jnp.asarray(ids), 40)
+        np.testing.assert_allclose(got, _np_segment_sum(v, ids, 40),
+                                   rtol=1e-5)
+
+    def test_onehot_2d_and_out_of_range(self):
+        rng = np.random.default_rng(2)
+        v = rng.normal(size=(64, 3)).astype(np.float32)
+        ids = rng.integers(-2, 10, size=64)  # some out of range
+        got = segment_sum_onehot(jnp.asarray(v), jnp.asarray(ids), 8)
+        np.testing.assert_allclose(got, _np_segment_sum(v, ids, 8),
+                                   rtol=1e-5)
+
+    @pytest.mark.parametrize("B,S,D", [(100, 17, 3), (1024, 300, 1),
+                                       (513, 8, 5)])
+    def test_pallas_matches_numpy(self, B, S, D):
+        rng = np.random.default_rng(3)
+        v = rng.normal(size=(B, D)).astype(np.float32)
+        ids = rng.integers(0, S, size=B)
+        got = segment_sum_pallas(jnp.asarray(v), jnp.asarray(ids), S,
+                                 block_s=64, block_b=128, interpret=True)
+        np.testing.assert_allclose(got, _np_segment_sum(v, ids, S),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_pallas_1d_values(self):
+        v = np.ones(50, np.float32)
+        ids = np.arange(50) % 7
+        got = segment_sum_pallas(jnp.asarray(v), jnp.asarray(ids), 7,
+                                 interpret=True)
+        assert got.shape == (7,)
+        np.testing.assert_allclose(got, _np_segment_sum(v, ids, 7))
+
+    def test_dispatcher_entrypoint(self):
+        v = np.ones((33, 2), np.float32)
+        ids = np.zeros(33, np.int64)
+        got = segment_sum(jnp.asarray(v), jnp.asarray(ids), 4)
+        assert got[0, 0] == 33 and got[1].sum() == 0
+
+
+class TestRankByDest:
+    def _np_rank(self, d):
+        seen: dict[int, int] = {}
+        out = []
+        for x in d:
+            out.append(seen.get(x, 0))
+            seen[x] = seen.get(x, 0) + 1
+        return np.array(out)
+
+    @pytest.mark.parametrize("B,S", [(37, 5), (256, 9), (700, 33)])
+    def test_small_path(self, B, S):
+        rng = np.random.default_rng(4)
+        d = rng.integers(0, S, size=B)
+        got = rank_by_dest(jnp.asarray(d), S, use_pallas=False)
+        np.testing.assert_array_equal(got, self._np_rank(d))
+
+    @pytest.mark.parametrize("B,S", [(512, 7), (777, 40)])
+    def test_pallas_path(self, B, S):
+        rng = np.random.default_rng(5)
+        d = rng.integers(0, S, size=B)
+        got = rank_by_dest(jnp.asarray(d), S, use_pallas=True, block=128,
+                           interpret=True)
+        np.testing.assert_array_equal(got, self._np_rank(d))
+
+
+class TestPackByDest:
+    def test_matches_semantics(self):
+        rng = np.random.default_rng(6)
+        B, S, CAP = 200, 6, 16
+        d = rng.integers(-1, S + 1, size=B)  # includes out-of-range
+        valid = rng.random(B) < 0.8
+        payload = {"x": rng.normal(size=(B, 2)).astype(np.float32)}
+        out, ovalid, drops = pack_by_dest(
+            jnp.asarray(d), jnp.asarray(valid),
+            {"x": jnp.asarray(payload["x"])}, S, CAP, use_pallas=False)
+        ovalid = np.asarray(ovalid)
+        outx = np.asarray(out["x"])
+        # every valid in-range message appears exactly once, in dest order
+        for s in range(S):
+            msgs = [payload["x"][i] for i in range(B)
+                    if valid[i] and d[i] == s][:CAP]
+            assert int(ovalid[s].sum()) == len(msgs)
+            for r, m in enumerate(msgs):
+                np.testing.assert_allclose(outx[s, r], m)
+        n_ok = int(sum(1 for i in range(B) if valid[i] and 0 <= d[i] < S))
+        assert int(ovalid.sum()) + int(drops) - int(
+            np.sum(valid & ((d < 0) | (d >= S)))) <= n_ok
+        assert int(ovalid.sum()) <= n_ok
+
+    def test_overflow_drops(self):
+        d = np.zeros(10, np.int64)
+        valid = np.ones(10, bool)
+        out, ovalid, drops = pack_by_dest(
+            jnp.asarray(d), jnp.asarray(valid), {"v": jnp.arange(10.0)},
+            n_dest=2, capacity=4, use_pallas=False)
+        assert int(drops) == 6
+        assert int(np.asarray(ovalid).sum()) == 4
+        np.testing.assert_allclose(np.asarray(out["v"])[0, :4],
+                                   [0, 1, 2, 3])
+
+
+class TestDeviceDirectory:
+    def test_build_and_lookup(self):
+        entries = {i * 7 + 1: i for i in range(100)}
+        tk, tv = build_directory_arrays(entries, 256)
+        keys = jnp.asarray(list(entries) + [9999, 12345])
+        vals, found = device_lookup(jnp.asarray(tk), jnp.asarray(tv), keys)
+        assert np.asarray(found)[:100].all()
+        assert not np.asarray(found)[100:].any()
+        np.testing.assert_array_equal(np.asarray(vals)[:100],
+                                      list(entries.values()))
+
+    def test_insert_remove_grow(self):
+        d = DeviceDirectory(capacity=16)
+        for i in range(200):  # forces several growths
+            d.insert(i * 13 + 5, i)
+        assert d.count == 200
+        for i in range(0, 200, 2):
+            assert d.remove(i * 13 + 5)
+        assert d.count == 100
+        vals, found = d.lookup_batch(
+            np.array([i * 13 + 5 for i in range(200)]))
+        found = np.asarray(found)
+        assert found[1::2].all() and not found[0::2].any()
+        np.testing.assert_array_equal(np.asarray(vals)[1::2],
+                                      np.arange(1, 200, 2))
+
+    def test_update_existing(self):
+        d = DeviceDirectory(capacity=16)
+        d.insert(42, 1)
+        d.insert(42, 2)
+        assert d.count == 1
+        assert d.lookup(42) == 2
+        assert d.remove(42) and not d.remove(42)
+        assert d.lookup(42) is None
