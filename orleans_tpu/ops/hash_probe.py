@@ -38,6 +38,18 @@ def _hash_jnp(keys: jax.Array, cap: int) -> jax.Array:
     return (h % jnp.uint32(cap)).astype(jnp.int32)
 
 
+def _check_key(key: int) -> int:
+    """Keys live in the 31-bit uniform-hash domain (int32 table cells with
+    EMPTY = -1). Callers hold the full GrainId; what they index by here is
+    its uniform hash already reduced to 31 bits (dispatch.engine masks with
+    & 0x7FFFFFFF). Reject anything wider instead of silently aliasing."""
+    if not 0 <= key < 2**31:
+        raise ValueError(
+            f"directory keys must be 31-bit uniform hashes, got {key}; "
+            f"reduce with `key & 0x7FFFFFFF` at the call site")
+    return key
+
+
 def build_directory_arrays(entries: dict[int, int], capacity: int,
                            max_probes: int = 16):
     """Host-build (tkeys, tvals) int32 arrays from key→value pairs.
@@ -53,7 +65,7 @@ def build_directory_arrays(entries: dict[int, int], capacity: int,
     tkeys = np.full(capacity, EMPTY, dtype=np.int32)
     tvals = np.zeros(capacity, dtype=np.int32)
     for k, v in entries.items():
-        k31 = k & 0x7FFFFFFF
+        k31 = _check_key(k)
         h = int(_hash_np(np.asarray(k31), capacity))
         for p in range(max_probes):
             idx = (h + p) % capacity
@@ -71,7 +83,9 @@ def device_lookup(tkeys: jax.Array, tvals: jax.Array, keys: jax.Array,
                   max_probes: int = 16):
     """Batched lookup: keys [B] → (vals [B] int32, found [B] bool).
 
-    jit/shard_map-safe; missing keys return (0, False).
+    jit/shard_map-safe; missing keys return (0, False). Traced keys are
+    reduced to the 31-bit domain with ``& 0x7FFFFFFF`` (a jit-traced array
+    cannot raise); hosts inserting via DeviceDirectory are validated.
     """
     cap = tkeys.shape[0]
     k31 = (keys & 0x7FFFFFFF).astype(jnp.int32)
@@ -120,7 +134,7 @@ class DeviceDirectory:
     def insert(self, key: int, val: int) -> None:
         if (self.count + 1) * 2 > self.capacity:
             self._grow()
-        k31 = key & 0x7FFFFFFF
+        k31 = _check_key(key)
         idx = self._probe_host(k31)
         if idx is None:
             self._grow()
@@ -135,7 +149,7 @@ class DeviceDirectory:
     def remove(self, key: int) -> bool:
         """Tombstone-free removal: re-insert the tail of the probe cluster
         (standard open-addressing backward-shift delete)."""
-        k31 = key & 0x7FFFFFFF
+        k31 = _check_key(key)
         h = int(_hash_np(np.asarray(k31), self.capacity))
         idx = None
         for p in range(self.max_probes):
@@ -187,7 +201,7 @@ class DeviceDirectory:
         return device_lookup(tk, tv, jnp.asarray(keys), self.max_probes)
 
     def lookup(self, key: int) -> int | None:
-        k31 = key & 0x7FFFFFFF
+        k31 = _check_key(key)
         idx = self._probe_host(k31)
         if idx is None or self.tkeys[idx] != k31:
             return None

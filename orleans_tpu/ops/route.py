@@ -1,10 +1,10 @@
 """Message routing (outbox pack) without a sort.
 
 The ICI transport packs each tick's outbound messages into per-destination
-buckets (``parallel.transport._pack_outbox``). The portable implementation
-ranks messages within their destination group via ``argsort`` — but sorts
-are among the weakest ops on TPU (O(B log^2 B) sorting networks on the
-VPU). The rank is really a *prefix count*:
+buckets (``parallel.transport._pack_outbox`` delegates here). The obvious
+implementation ranks messages within their destination group via
+``argsort`` — but sorts are among the weakest ops on TPU (O(B log^2 B)
+sorting networks on the VPU). The rank is really a *prefix count*:
 
     rank[i] = #{ j < i : dest[j] == dest[i] }  ==  (L @ onehot(dest))[i, dest[i]]
 

@@ -39,34 +39,14 @@ def _pack_outbox(dest: jax.Array, valid: jax.Array, payload: dict,
     payload: dict of [B, ...]. Returns (outbox payload dict
     [n_shards, capacity, ...], outbox_valid [n_shards, capacity],
     drops scalar).
+
+    Implemented sort-free: within-destination ranks come from the MXU
+    prefix-count kernel (ops.route) rather than an argsort — sorts are the
+    weak op on TPU; matmuls are the strong one.
     """
-    B = dest.shape[0]
-    # Invalid lanes and out-of-range destinations route to a virtual
-    # destination n_shards (sliced off); out-of-range counts as a drop.
-    in_range = (dest >= 0) & (dest < n_shards)
-    d = jnp.where(valid & in_range, dest, n_shards)
-    order = jnp.argsort(d)  # stable: groups by destination
-    d_sorted = d[order]
-    # position of each message within its destination group
-    starts = jnp.searchsorted(d_sorted, jnp.arange(n_shards + 1))
-    pos = jnp.arange(B) - starts[d_sorted]
-    keep = (pos < capacity) & (d_sorted < n_shards)
-    overflow = jnp.sum((~keep) & (d_sorted < n_shards))
-    drops = overflow + jnp.sum(valid & ~in_range)
-    # flat outbox index; dropped lanes write to the sink row
-    sink = n_shards * capacity
-    flat = jnp.where(keep, d_sorted * capacity + jnp.minimum(pos, capacity - 1),
-                     sink)
+    from ..ops.route import pack_by_dest
 
-    def scatter(x):
-        buf = jnp.zeros((n_shards * capacity + 1, *x.shape[1:]), x.dtype)
-        return buf.at[flat].set(x[order])[:-1].reshape(
-            n_shards, capacity, *x.shape[1:])
-
-    out_payload = jax.tree_util.tree_map(scatter, payload)
-    ovalid = jnp.zeros((n_shards * capacity + 1,), bool).at[flat].set(
-        keep)[:-1].reshape(n_shards, capacity)
-    return out_payload, ovalid, drops
+    return pack_by_dest(dest, valid, payload, n_shards, capacity)
 
 
 def build_exchange(mesh, capacity: int):
