@@ -1,0 +1,16 @@
+"""Benchmark harnesses, mirroring the reference's test/Benchmarks tree
+(/root/reference/test/Benchmarks/): Ping (grain-call throughput),
+MapReduce (dataflow pipeline wall-clock), Serialization (ns/op), and
+Transactions (commit throughput) — plus the TPU-native vectorized-dispatch
+variants the reference has no analog for. Each harness prints one JSON
+line per metric (the reference prints its numbers at run time too;
+BASELINE.md: "no published numbers, self-measuring harnesses").
+
+`bench.py` at the repo root remains the single metric-of-record entry
+point; these harnesses are the wider measurement surface.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))

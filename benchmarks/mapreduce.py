@@ -1,0 +1,121 @@
+"""MapReduce benchmark — grain-dataflow pipeline wall-clock.
+
+Mirrors /root/reference/test/Benchmarks/MapReduce/MapReduceBenchmark.cs
+(driver test/Benchmarks/Program.cs:18-30): a word-count dataflow built
+from grains — N mapper grains tokenize text blocks, send counts to R
+reducer grains (hash-partitioned by word), a collector grain folds the
+final table; prints elapsed ms for the whole pipeline.
+"""
+
+import argparse
+import asyncio
+import collections
+import json
+import random
+import time
+
+if __package__ in (None, ""):
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from orleans_tpu.runtime import ClusterClient, Grain, SiloBuilder
+
+_WORDS = ("actor grain silo tick mesh shard stream kernel batch "
+          "directory message placement reminder storage").split()
+
+
+def make_text(n_words: int, seed: int) -> str:
+    rng = random.Random(seed)
+    return " ".join(rng.choice(_WORDS) for _ in range(n_words))
+
+
+class MapperGrain(Grain):
+    """Tokenize a block and push partial counts to reducers
+    (MapReduce/WordCount mapper dataflow node)."""
+
+    async def map_block(self, text: str, n_reducers: int) -> int:
+        counts: dict[str, int] = collections.Counter(text.split())
+        by_reducer: dict[int, dict[str, int]] = {}
+        for w, c in counts.items():
+            by_reducer.setdefault(hash(w) % n_reducers, {})[w] = c
+        await asyncio.gather(*(
+            self.get_grain(ReducerGrain, r).reduce_partial(part)
+            for r, part in by_reducer.items()))
+        return len(counts)
+
+
+class ReducerGrain(Grain):
+    def __init__(self):
+        self.counts: dict[str, int] = collections.Counter()
+
+    async def reduce_partial(self, partial: dict) -> None:
+        for w, c in partial.items():
+            self.counts[w] += c
+
+    async def drain(self) -> dict:
+        out, self.counts = dict(self.counts), collections.Counter()
+        return out
+
+
+class CollectorGrain(Grain):
+    async def collect(self, n_reducers: int) -> dict:
+        tables = await asyncio.gather(*(
+            self.get_grain(ReducerGrain, r).drain()
+            for r in range(n_reducers)))
+        total: dict[str, int] = collections.Counter()
+        for t in tables:
+            total.update(t)
+        return dict(total)
+
+
+async def run(n_mappers: int = 16, n_reducers: int = 4,
+              words_per_block: int = 2000, repeats: int = 3) -> dict:
+    silo = (SiloBuilder().with_name("mr-silo")
+            .add_grains(MapperGrain, ReducerGrain, CollectorGrain).build())
+    await silo.start()
+    client = await ClusterClient(silo.fabric).connect()
+    blocks = [make_text(words_per_block, seed) for seed in range(n_mappers)]
+
+    expected: dict[str, int] = collections.Counter()
+    for b in blocks:
+        expected.update(b.split())
+
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        await asyncio.gather(*(
+            client.get_grain(MapperGrain, i).map_block(blocks[i], n_reducers)
+            for i in range(n_mappers)))
+        table = await client.get_grain(CollectorGrain, 0).collect(n_reducers)
+        times.append(time.perf_counter() - t0)
+        assert table == dict(expected), "word-count mismatch"
+    await client.close_async()
+    await silo.stop()
+
+    best = min(times)
+    total_words = n_mappers * words_per_block
+    return {
+        "metric": "mapreduce_pipeline_ms",
+        "value": round(best * 1e3, 2),
+        "unit": "ms",
+        "vs_baseline": None,
+        "extra": {"n_mappers": n_mappers, "n_reducers": n_reducers,
+                  "total_words": total_words,
+                  "words_per_sec": round(total_words / best, 1)},
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mappers", type=int, default=16)
+    ap.add_argument("--reducers", type=int, default=4)
+    ap.add_argument("--words", type=int, default=2000)
+    ap.add_argument("--repeats", type=int, default=3)
+    a = ap.parse_args()
+    print(json.dumps(asyncio.run(
+        run(a.mappers, a.reducers, a.words, a.repeats))))
+
+
+if __name__ == "__main__":
+    main()

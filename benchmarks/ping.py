@@ -1,0 +1,149 @@
+"""Ping benchmark — grain-call throughput.
+
+Mirrors /root/reference/test/Benchmarks/Ping/PingBenchmark.cs:35-46: N
+EchoGrains, C concurrent in-flight pings, timed loop, prints calls/sec.
+Two tiers are measured:
+
+* **host tier** — arbitrary-Python grains through the full silo path
+  (client → dispatcher → catalog → activation turn), the analog of the
+  reference's measurement;
+* **vector tier** — the same no-op echo as a VectorGrain through the
+  batched dispatch engine (per-key futures coalesced into per-tick
+  kernels), the batched-dispatch acceptance config of BASELINE.md
+  ("10k EchoGrains, batched no-op invoke").
+"""
+
+import argparse
+import asyncio
+import json
+import time
+
+import numpy as np
+
+if __package__ in (None, ""):
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from orleans_tpu.runtime import ClusterClient, Grain, SiloBuilder
+
+
+class EchoGrain(Grain):
+    """EchoGrain (test/Benchmarks/Grains/PingGrain-style no-op)."""
+
+    async def ping(self, x: int) -> int:
+        return x
+
+
+async def bench_host_tier(n_grains: int, concurrency: int,
+                          seconds: float) -> dict:
+    silo = SiloBuilder().with_name("ping-silo").add_grains(EchoGrain).build()
+    await silo.start()
+    client = await ClusterClient(silo.fabric).connect()
+    grains = [client.get_grain(EchoGrain, k) for k in range(n_grains)]
+
+    # warmup: activate every grain
+    await asyncio.gather(*(g.ping(0) for g in grains))
+
+    calls = 0
+    lat: list[float] = []
+    stop_at = time.perf_counter() + seconds
+
+    async def worker(wid: int) -> int:
+        nonlocal calls
+        i = wid
+        n = 0
+        while time.perf_counter() < stop_at:
+            t0 = time.perf_counter()
+            await grains[i % n_grains].ping(i)
+            lat.append(time.perf_counter() - t0)
+            i += concurrency
+            n += 1
+        return n
+
+    t0 = time.perf_counter()
+    counts = await asyncio.gather(*(worker(w) for w in range(concurrency)))
+    elapsed = time.perf_counter() - t0
+    calls = sum(counts)
+    await client.close_async()
+    await silo.stop()
+    return {
+        "metric": "ping_host_calls_per_sec",
+        "value": round(calls / elapsed, 1),
+        "unit": "calls/sec",
+        "vs_baseline": None,
+        "extra": {
+            "n_grains": n_grains,
+            "concurrency": concurrency,
+            "calls": calls,
+            "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
+            "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
+        },
+    }
+
+
+async def bench_vector_tier(n_grains: int, rounds: int) -> dict:
+    import jax.numpy as jnp
+
+    from orleans_tpu.dispatch import VectorGrain, VectorRuntime, actor_method
+    from orleans_tpu.parallel import make_mesh
+
+    class EchoVectorGrain(VectorGrain):
+        STATE = {"pings": (jnp.int32, ())}
+
+        @staticmethod
+        def initial_state(key_hash):
+            return {"pings": jnp.int32(0)}
+
+        @actor_method(args={"x": (jnp.int32, ())})
+        def ping(state, args):
+            return {"pings": state["pings"] + 1}, args["x"]
+
+    rt = VectorRuntime(mesh=make_mesh(1), capacity_per_shard=n_grains)
+    rt.table(EchoVectorGrain).ensure_dense(n_grains)
+    keys = np.arange(n_grains)
+    x = np.arange(n_grains, dtype=np.int32)
+    plan = rt.make_dense_plan(EchoVectorGrain, keys)
+
+    out = rt.call_batch(EchoVectorGrain, "ping", keys, {"x": x}, plan=plan)
+    np.testing.assert_array_equal(out, x)  # warmup + correctness
+
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        rt.call_batch(EchoVectorGrain, "ping", keys, {"x": x}, plan=plan)
+    elapsed = time.perf_counter() - t0
+    calls = rounds * n_grains
+    return {
+        "metric": "ping_vector_calls_per_sec",
+        "value": round(calls / elapsed, 1),
+        "unit": "calls/sec",
+        "vs_baseline": None,
+        "extra": {"n_grains": n_grains, "rounds": rounds,
+                  "tick_ms": round(elapsed / rounds * 1e3, 3)},
+    }
+
+
+async def run(n_grains: int = 10_000, concurrency: int = 100,
+              seconds: float = 5.0, rounds: int = 50,
+              host_grains: int | None = None) -> list[dict]:
+    results = [
+        await bench_host_tier(host_grains or min(n_grains, 1000),
+                              concurrency, seconds),
+        await bench_vector_tier(n_grains, rounds),
+    ]
+    return results
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--grains", type=int, default=10_000)
+    ap.add_argument("--concurrency", type=int, default=100)
+    ap.add_argument("--seconds", type=float, default=5.0)
+    ap.add_argument("--rounds", type=int, default=50)
+    a = ap.parse_args()
+    for r in asyncio.run(run(a.grains, a.concurrency, a.seconds, a.rounds)):
+        print(json.dumps(r))
+
+
+if __name__ == "__main__":
+    main()

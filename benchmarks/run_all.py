@@ -1,0 +1,30 @@
+"""Run every benchmark harness with moderate sizes; one JSON line each.
+
+(The metric of record for the driver stays `python bench.py` at the repo
+root — this is the wider surface, mirroring test/Benchmarks/Program.cs's
+menu of Ping/MapReduce/Serialization/Transactions harnesses.)
+"""
+
+import asyncio
+import json
+
+if __package__ in (None, ""):
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from benchmarks import mapreduce, ping, serialization, transactions
+
+
+def main() -> None:
+    for r in asyncio.run(ping.run(n_grains=10_000, concurrency=100,
+                                  seconds=3.0, rounds=30)):
+        print(json.dumps(r))
+    print(json.dumps(asyncio.run(mapreduce.run())))
+    for r in serialization.run():
+        print(json.dumps(r))
+    print(json.dumps(asyncio.run(transactions.run(seconds=3.0))))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,115 @@
+"""Serialization benchmarks — ns/op for the wire paths.
+
+Mirrors /root/reference/test/Benchmarks/Serialization/
+SerializationBenchmarks.cs (BenchmarkDotNet micro-bench over the
+token-stream serializers). Three paths matter here:
+
+* **message wire** — full Message header+body encode/decode (the
+  SocketManager framing path, Message.Serialize Message.cs:481);
+* **payload pickle** — the restricted-pickle fallback serializer
+  (SerializationManager's fallback tier, SerializationManager.cs:50,133);
+* **array schema pack** — the fixed-layout batch pack used by the device
+  tier (the codegen'd-serializer analog: schema-driven, no per-object
+  dispatch) — this is the path the TPU cares about.
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+if __package__ in (None, ""):
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from orleans_tpu.core.ids import GrainId, GrainType, SiloAddress
+from orleans_tpu.core.message import make_request
+from orleans_tpu.core.serialization import ArraySchema, deserialize, serialize
+from orleans_tpu.runtime.wire import decode_message, encode_message
+
+
+def _time_op(fn, n: int) -> float:
+    fn()  # warmup
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fn()
+    return (time.perf_counter() - t0) / n
+
+
+def bench_message_wire(n: int) -> dict:
+    msg = make_request(
+        target_grain=GrainId.for_grain(GrainType.of("EchoGrain"), 42),
+        interface_name="EchoGrain", method_name="ping",
+        body={"args": (123,), "kwargs": {}},
+        sending_silo=SiloAddress("10.0.0.1", 11111, 1),
+        target_silo=SiloAddress("10.0.0.2", 11111, 2))
+    enc = _time_op(lambda: encode_message(msg), n)
+    frame = encode_message(msg)
+    hlen, blen = int.from_bytes(frame[:4], "little"), \
+        int.from_bytes(frame[4:8], "little")
+    headers, body = frame[8:8 + hlen], frame[8 + hlen:8 + hlen + blen]
+
+    def dec():
+        out = decode_message(headers, body)
+        assert out.method_name == "ping"
+
+    return {
+        "metric": "serialization_message_roundtrip_ns",
+        "value": round((enc + _time_op(dec, n)) * 1e9, 1),
+        "unit": "ns/op",
+        "vs_baseline": None,
+        "extra": {"frame_bytes": len(frame),
+                  "encode_ns": round(enc * 1e9, 1)},
+    }
+
+
+def bench_payload_pickle(n: int) -> dict:
+    payload = {"scores": list(range(32)), "name": "player-7",
+               "pos": (1.5, 2.5), "tags": {"a": 1, "b": 2}}
+    op = _time_op(lambda: deserialize(serialize(payload)), n)
+    return {
+        "metric": "serialization_pickle_roundtrip_ns",
+        "value": round(op * 1e9, 1),
+        "unit": "ns/op",
+        "vs_baseline": None,
+        "extra": {"bytes": len(serialize(payload))},
+    }
+
+
+def bench_schema_pack(n: int, batch: int = 1024) -> dict:
+    schema = ArraySchema.of(pos=(np.float32, (2,)), beat=(np.int32, ()))
+    payloads = [{"pos": np.array([i, i + 1], np.float32),
+                 "beat": np.int32(i)} for i in range(batch)]
+
+    def pack():
+        b = schema.stack(payloads, pad_to=batch)
+        assert b["pos"].shape == (batch, 2)
+
+    per_batch = _time_op(pack, max(1, n // batch))
+    return {
+        "metric": "serialization_schema_pack_ns_per_msg",
+        "value": round(per_batch / batch * 1e9, 1),
+        "unit": "ns/op",
+        "vs_baseline": None,
+        "extra": {"batch": batch,
+                  "batch_us": round(per_batch * 1e6, 1)},
+    }
+
+
+def run(n: int = 20_000) -> list[dict]:
+    return [bench_message_wire(n), bench_payload_pickle(n),
+            bench_schema_pack(n)]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", type=int, default=20_000)
+    a = ap.parse_args()
+    for r in run(a.ops):
+        print(json.dumps(r))
+
+
+if __name__ == "__main__":
+    main()

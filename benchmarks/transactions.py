@@ -1,0 +1,115 @@
+"""Transaction throughput benchmark.
+
+Mirrors /root/reference/test/Benchmarks/TransactionManager/
+TransactionManagerBentchmarks.cs and Transactions/TransactionBenchmark.cs:
+C concurrent workers each running commit loops of two-account atomic
+transfers through the in-cluster TM grain; prints committed txns/sec.
+Conservation (sum of balances) is asserted at the end — a benchmark that
+breaks atomicity doesn't count.
+"""
+
+import argparse
+import asyncio
+import json
+import time
+
+if __package__ in (None, ""):
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from orleans_tpu.core.errors import TransactionAbortedError
+from orleans_tpu.runtime import ClusterClient, SiloBuilder
+from orleans_tpu.transactions import (
+    TransactionalGrain,
+    TransactionalState,
+    add_transactions,
+    transactional,
+)
+
+START_BALANCE = 1_000_000
+
+
+class AccountGrain(TransactionalGrain):
+    def __init__(self):
+        self.balance = TransactionalState("balance", default=START_BALANCE)
+
+    @transactional
+    async def deposit(self, amount: int) -> None:
+        await self.balance.set(await self.balance.get() + amount)
+
+    @transactional
+    async def withdraw(self, amount: int) -> None:
+        await self.balance.set(await self.balance.get() - amount)
+
+    async def get_balance(self) -> int:
+        return await self.balance.get()
+
+
+class TransferGrain(TransactionalGrain):
+    @transactional
+    async def transfer(self, src: int, dst: int, amount: int) -> None:
+        await self.get_grain(AccountGrain, src).withdraw(amount)
+        await self.get_grain(AccountGrain, dst).deposit(amount)
+
+
+async def run(n_accounts: int = 32, concurrency: int = 8,
+              seconds: float = 5.0) -> dict:
+    silo = add_transactions(
+        SiloBuilder().with_name("txn-silo")
+        .add_grains(AccountGrain, TransferGrain)).build()
+    await silo.start()
+    client = await ClusterClient(silo.fabric).connect()
+
+    committed = 0
+    aborted = 0
+    stop_at = time.perf_counter() + seconds
+
+    async def worker(wid: int) -> None:
+        nonlocal committed, aborted
+        mover = client.get_grain(TransferGrain, wid)
+        i = wid
+        while time.perf_counter() < stop_at:
+            src = i % n_accounts
+            dst = (i * 7 + 1) % n_accounts
+            if src == dst:
+                dst = (dst + 1) % n_accounts
+            try:
+                await mover.transfer(src, dst, 1)
+                committed += 1
+            except TransactionAbortedError:
+                aborted += 1  # conflicts are expected under contention
+            i += 1
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*(worker(w) for w in range(concurrency)))
+    elapsed = time.perf_counter() - t0
+
+    balances = await asyncio.gather(*(
+        client.get_grain(AccountGrain, a).get_balance()
+        for a in range(n_accounts)))
+    assert sum(balances) == n_accounts * START_BALANCE, "conservation broken"
+    await client.close_async()
+    await silo.stop()
+
+    return {
+        "metric": "transactions_committed_per_sec",
+        "value": round(committed / elapsed, 1),
+        "unit": "txns/sec",
+        "vs_baseline": None,
+        "extra": {"committed": committed, "aborted": aborted,
+                  "concurrency": concurrency, "accounts": n_accounts},
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--accounts", type=int, default=32)
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--seconds", type=float, default=5.0)
+    a = ap.parse_args()
+    print(json.dumps(asyncio.run(run(a.accounts, a.concurrency, a.seconds))))
+
+
+if __name__ == "__main__":
+    main()

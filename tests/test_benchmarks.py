@@ -1,0 +1,34 @@
+"""Benchmark harnesses run end-to-end with tiny sizes (the reference ships
+its harnesses inside the test tree too — test/Benchmarks builds against
+TestCluster). Correctness assertions inside each harness (echo values,
+word-count table, balance conservation) are the point; speed is not."""
+
+from benchmarks import mapreduce, ping, serialization, transactions
+
+
+def _check(r: dict) -> None:
+    assert set(r) >= {"metric", "value", "unit", "vs_baseline"}
+    assert r["value"] > 0
+
+
+async def test_ping_harness():
+    for r in await ping.run(n_grains=64, concurrency=8, seconds=0.3,
+                            rounds=3, host_grains=16):
+        _check(r)
+
+
+async def test_mapreduce_harness():
+    r = await mapreduce.run(n_mappers=4, n_reducers=2, words_per_block=200,
+                            repeats=1)
+    _check(r)
+
+
+def test_serialization_harness():
+    for r in serialization.run(n=200):
+        _check(r)
+
+
+async def test_transactions_harness():
+    r = await transactions.run(n_accounts=8, concurrency=3, seconds=0.3)
+    _check(r)
+    assert r["extra"]["committed"] > 0
