@@ -1,0 +1,205 @@
+"""Typed options groups + validators (the reference's config system).
+
+Re-design of /root/reference/src/Orleans.Core/Configuration/Options/*
+(ClusterOptions, MessagingOptions, PerformanceTuningOptions, …), the
+runtime-side groups (SiloMessagingOptions, SchedulingOptions,
+GrainCollectionOptions — Runtime/Configuration/Options/), the validators
+(Core/Configuration/Validators/) and the startup options dump
+(Runtime/OptionsLogger/). The groups flatten into the runtime's flat
+``SiloConfig`` view via :func:`flatten`; ``SiloBuilder.with_options``
+consumes them fluently (the ``.Configure<XOptions>(...)`` idiom,
+SiloHostBuilder.cs:13).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, fields
+
+from .core.errors import ConfigurationError
+from .runtime.silo import SiloConfig
+
+log = logging.getLogger("orleans.options")
+
+__all__ = [
+    "ClusterOptions", "MessagingOptions", "SchedulingOptions",
+    "GrainCollectionOptions", "MembershipOptions", "DirectoryOptions",
+    "DispatchOptions", "flatten", "apply_options", "validate_options",
+    "log_options",
+]
+
+
+def _positive(opts, *names: str) -> None:
+    for n in names:
+        v = getattr(opts, n)
+        if not (isinstance(v, (int, float)) and v > 0):
+            raise ConfigurationError(
+                f"{type(opts).__name__}.{n} must be > 0, got {v!r}")
+
+
+@dataclass
+class ClusterOptions:
+    """ClusterOptions (Core/Configuration/Options/ClusterOptions.cs):
+    cluster/service identity."""
+
+    cluster_id: str = "default"
+    service_id: str = "default"
+
+    def validate(self) -> None:
+        if not self.cluster_id or not self.service_id:
+            raise ConfigurationError(
+                "cluster_id and service_id must be non-empty "
+                "(ClusterOptionsValidator semantics)")
+
+
+@dataclass
+class MessagingOptions:
+    """MessagingOptions / SiloMessagingOptions: timeouts, queue limits."""
+
+    response_timeout: float = 30.0
+    max_enqueued_requests: int = 5000
+
+    def validate(self) -> None:
+        _positive(self, "response_timeout", "max_enqueued_requests")
+
+
+@dataclass
+class SchedulingOptions:
+    """SchedulingOptions: turn-length warning (TurnWarningLengthThreshold,
+    OrleansTaskScheduler.cs:26) + deadlock detection
+    (PerformDeadlockDetection)."""
+
+    turn_warning_length: float = 0.2
+    detect_deadlocks: bool = False
+
+    def validate(self) -> None:
+        _positive(self, "turn_warning_length")
+
+
+@dataclass
+class GrainCollectionOptions:
+    """GrainCollectionOptions: idle-activation GC ages + quantum
+    (ActivationCollector.cs:15)."""
+
+    collection_age: float = 2 * 3600.0
+    collection_quantum: float = 60.0
+    deactivation_timeout: float = 5.0
+
+    def validate(self) -> None:
+        _positive(self, "collection_age", "collection_quantum",
+                  "deactivation_timeout")
+        if self.collection_age < self.collection_quantum:
+            raise ConfigurationError(
+                "collection_age must be >= collection_quantum "
+                "(GrainCollectionOptionsValidator semantics)")
+
+
+@dataclass
+class MembershipOptions:
+    """MembershipOptions (Core/Configuration/Options/MembershipOptions.cs):
+    probe cadence, vote thresholds, refresh periods."""
+
+    probe_period: float = 1.0
+    probe_timeout: float = 1.0
+    missed_probes_limit: int = 3
+    votes_needed: int = 2
+    num_probed: int = 3
+    iam_alive_period: float = 5.0
+    refresh_period: float = 5.0
+    vote_expiration: float = 10.0
+
+    def validate(self) -> None:
+        _positive(self, "probe_period", "probe_timeout",
+                  "missed_probes_limit", "votes_needed", "num_probed",
+                  "iam_alive_period", "refresh_period", "vote_expiration")
+        if self.votes_needed > self.num_probed + 1:
+            raise ConfigurationError(
+                f"votes_needed ({self.votes_needed}) can never be reached "
+                f"with num_probed={self.num_probed} probers")
+
+
+@dataclass
+class DirectoryOptions:
+    """Grain-directory caching (GrainDirectoryOptions: CachingStrategy,
+    CacheSize)."""
+
+    cache_size: int = 100_000
+
+    def validate(self) -> None:
+        _positive(self, "cache_size")
+
+
+@dataclass
+class DispatchOptions:
+    """TPU vector-dispatch tier (no reference analog — the batched engine's
+    knobs): per-shard slot-pool capacity and exchange lane capacity."""
+
+    capacity_per_shard: int = 1024
+    exchange_capacity: int = 256
+
+    def validate(self) -> None:
+        _positive(self, "capacity_per_shard", "exchange_capacity")
+
+
+# flat SiloConfig field ← (options group, group field)
+_FLAT_MAP = {
+    "response_timeout": (MessagingOptions, "response_timeout"),
+    "max_enqueued_requests": (MessagingOptions, "max_enqueued_requests"),
+    "turn_warning_length": (SchedulingOptions, "turn_warning_length"),
+    "detect_deadlocks": (SchedulingOptions, "detect_deadlocks"),
+    "collection_age": (GrainCollectionOptions, "collection_age"),
+    "collection_quantum": (GrainCollectionOptions, "collection_quantum"),
+    "deactivation_timeout": (GrainCollectionOptions, "deactivation_timeout"),
+    "membership_probe_period": (MembershipOptions, "probe_period"),
+    "membership_probe_timeout": (MembershipOptions, "probe_timeout"),
+    "membership_missed_probes_limit": (MembershipOptions,
+                                       "missed_probes_limit"),
+    "membership_votes_needed": (MembershipOptions, "votes_needed"),
+    "membership_num_probed": (MembershipOptions, "num_probed"),
+    "membership_iam_alive_period": (MembershipOptions, "iam_alive_period"),
+    "membership_refresh_period": (MembershipOptions, "refresh_period"),
+    "membership_vote_expiration": (MembershipOptions, "vote_expiration"),
+    "directory_cache_size": (DirectoryOptions, "cache_size"),
+}
+
+
+def validate_options(*groups) -> None:
+    """Run every group's validator (the IConfigurationValidator pass the
+    silo runs before start — DefaultSiloServices registers one per group)."""
+    for g in groups:
+        g.validate()
+
+
+def flatten(*groups, name: str = "silo") -> SiloConfig:
+    """Validate + flatten typed groups into the runtime's ``SiloConfig``.
+    Unspecified groups keep their defaults."""
+    validate_options(*groups)
+    by_type = {type(g): g for g in groups}
+    cfg = SiloConfig(name=name)
+    for flat_field, (group_cls, group_field) in _FLAT_MAP.items():
+        g = by_type.get(group_cls)
+        if g is not None:
+            setattr(cfg, flat_field, getattr(g, group_field))
+    return cfg
+
+
+def log_options(*groups, logger: logging.Logger | None = None) -> None:
+    """Dump every option value at startup (Runtime/OptionsLogger/ — the
+    reference logs all bound options when the silo boots)."""
+    lg = logger or log
+    for g in groups:
+        for f in fields(g):
+            lg.info("%s.%s = %r", type(g).__name__, f.name,
+                    getattr(g, f.name))
+
+
+def apply_options(cfg: SiloConfig, *groups) -> SiloConfig:
+    """Validate the groups and overlay their values on a flat config
+    (consumed by ``SiloBuilder.with_options``)."""
+    validate_options(*groups)
+    by_type = {type(g): g for g in groups}
+    for flat_field, (group_cls, group_field) in _FLAT_MAP.items():
+        g = by_type.get(group_cls)
+        if g is not None:
+            setattr(cfg, flat_field, getattr(g, group_field))
+    return cfg

@@ -13,12 +13,17 @@ __all__ = [
     "NonExistentActivationError", "InconsistentStateError", "DeadlockError",
     "GatewayTooBusyError", "GrainOverloadedError", "RejectionError",
     "ClusterMembershipError", "ReminderError", "StreamError",
-    "TransactionError", "TransactionAbortedError",
+    "TransactionError", "TransactionAbortedError", "ConfigurationError",
 ]
 
 
 class OrleansError(Exception):
     """Base for all framework errors (``OrleansException``)."""
+
+
+class ConfigurationError(OrleansError):
+    """Invalid options rejected by a validator
+    (``OrleansConfigurationException``, Core/Configuration/Validators/)."""
 
 
 class SiloUnavailableError(OrleansError):

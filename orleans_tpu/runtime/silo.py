@@ -320,6 +320,15 @@ class SiloBuilder:
             setattr(self.config, k, v)
         return self
 
+    def with_options(self, *groups) -> "SiloBuilder":
+        """Typed options groups (the ``.Configure<XOptions>(...)`` idiom):
+        ``builder.with_options(MessagingOptions(response_timeout=5))`` —
+        validates each group, then overlays it on the flat config."""
+        from ..config import apply_options
+
+        apply_options(self.config, *groups)
+        return self
+
     def add_grains(self, *grain_classes: type) -> "SiloBuilder":
         self.registry.register(*grain_classes)
         return self

@@ -1,0 +1,90 @@
+"""Typed options groups + validators (reference: Options classes bound via
+MS.Options with IConfigurationValidator passes — NonSilo.Tests'
+builder/config unit-test tier)."""
+
+import logging
+
+import pytest
+
+from orleans_tpu.config import (
+    ClusterOptions,
+    DirectoryOptions,
+    GrainCollectionOptions,
+    MembershipOptions,
+    MessagingOptions,
+    SchedulingOptions,
+    apply_options,
+    flatten,
+    log_options,
+    validate_options,
+)
+from orleans_tpu.core.errors import ConfigurationError
+from orleans_tpu.runtime import SiloBuilder
+
+
+class TestValidators:
+    def test_defaults_all_valid(self):
+        validate_options(ClusterOptions(), MessagingOptions(),
+                         SchedulingOptions(), GrainCollectionOptions(),
+                         MembershipOptions(), DirectoryOptions())
+
+    def test_nonpositive_rejected(self):
+        with pytest.raises(ConfigurationError, match="response_timeout"):
+            MessagingOptions(response_timeout=0).validate()
+        with pytest.raises(ConfigurationError, match="cache_size"):
+            DirectoryOptions(cache_size=-1).validate()
+
+    def test_cross_field_rules(self):
+        with pytest.raises(ConfigurationError, match="collection_age"):
+            GrainCollectionOptions(collection_age=10,
+                                   collection_quantum=60).validate()
+        with pytest.raises(ConfigurationError, match="never be reached"):
+            MembershipOptions(votes_needed=5, num_probed=2).validate()
+        with pytest.raises(ConfigurationError, match="non-empty"):
+            ClusterOptions(cluster_id="").validate()
+
+
+class TestFlatten:
+    def test_flatten_overlays_groups(self):
+        cfg = flatten(MessagingOptions(response_timeout=7.5),
+                      MembershipOptions(probe_period=0.25),
+                      name="s1")
+        assert cfg.name == "s1"
+        assert cfg.response_timeout == 7.5
+        assert cfg.membership_probe_period == 0.25
+        # untouched groups keep SiloConfig defaults
+        assert cfg.collection_quantum == 60.0
+
+    def test_flatten_validates(self):
+        with pytest.raises(ConfigurationError):
+            flatten(MessagingOptions(response_timeout=-1))
+
+    def test_apply_options_on_existing_config(self):
+        from orleans_tpu.runtime.silo import SiloConfig
+        cfg = SiloConfig(name="x")
+        apply_options(cfg, SchedulingOptions(detect_deadlocks=True,
+                                             turn_warning_length=0.5))
+        assert cfg.detect_deadlocks is True
+        assert cfg.turn_warning_length == 0.5
+
+
+class TestBuilderIntegration:
+    def test_with_options(self):
+        b = (SiloBuilder().with_name("opt-silo")
+             .with_options(MessagingOptions(response_timeout=3.0),
+                           GrainCollectionOptions(collection_age=120,
+                                                  collection_quantum=30)))
+        assert b.config.response_timeout == 3.0
+        assert b.config.collection_age == 120
+
+    def test_with_options_rejects_invalid(self):
+        with pytest.raises(ConfigurationError):
+            SiloBuilder().with_options(MembershipOptions(num_probed=0))
+
+
+def test_log_options_dumps_every_field(caplog):
+    with caplog.at_level(logging.INFO, logger="orleans.options"):
+        log_options(MessagingOptions(), MembershipOptions())
+    text = caplog.text
+    assert "MessagingOptions.response_timeout" in text
+    assert "MembershipOptions.votes_needed" in text
