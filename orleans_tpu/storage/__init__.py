@@ -1,5 +1,7 @@
-"""Grain persistence providers (reference L11 persistence)."""
+"""Grain persistence providers (reference L11 persistence) + device-tier
+checkpoint/resume (orbax table snapshots, write-behind row persistence)."""
 
+from .checkpoint import VectorCheckpointer, VectorStorageBridge  # noqa: F401
 from .core import (  # noqa: F401
     ErrorInjectionStorage,
     FileStorage,

@@ -1,0 +1,230 @@
+"""Checkpoint/resume for the device tier: orbax table snapshots +
+write-behind per-actor persistence.
+
+The reference has no cluster-wide checkpoint — durable truth is per-grain
+storage (Grain<TState> via StateStorageBridge.cs:11,49,80,107) plus the
+membership table (SURVEY.md §5 "Checkpoint / resume"). The TPU build keeps
+that contract and adds the device-tier analog the survey prescribes:
+sharded activation-state arrays periodically flushed via orbax-style async
+checkpointing, plus a write-behind bridge that maps individual VectorGrain
+rows onto the ordinary ``GrainStorage`` providers (the "TpuGrainStorage
+IStorageProvider" of the north-star design) so a single actor's state
+survives restart even without a full table snapshot.
+
+Two recovery paths:
+* **whole-silo resume** — ``VectorCheckpointer.save(step)`` every N ticks
+  (async: device→host copy overlaps serving; orbax writes in background);
+  after restart ``restore()`` rebuilds every table + its host bookkeeping.
+* **per-actor lazy resume** — ``VectorStorageBridge.flush(keys)`` write-
+  behind after ticks; on re-activation ``load(keys)`` scatters stored rows
+  back into the table (the virtual-actor guarantee: the next call finds
+  the state, wherever the actor lands).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import TYPE_CHECKING, Iterable
+
+import jax
+import numpy as np
+
+from ..core.ids import GrainId, GrainType
+from .core import GrainStorage
+
+if TYPE_CHECKING:
+    from ..dispatch.engine import VectorRuntime
+
+__all__ = ["VectorCheckpointer", "VectorStorageBridge"]
+
+
+def _table_meta(tbl) -> dict:
+    return {
+        "capacity": tbl.capacity,
+        "dense_n": tbl.dense_n,
+        "dense_per_shard": tbl.dense_per_shard,
+        "dense_active": [int(i) for i in np.flatnonzero(tbl.dense_active)],
+        "key_to_slot": {str(k): list(v) for k, v in tbl.key_to_slot.items()},
+        "free": [list(f) for f in tbl.free],
+    }
+
+
+def _apply_meta(tbl, meta: dict) -> None:
+    # capacity is taken from the checkpoint verbatim (the state arrays are
+    # replaced wholesale right after; grow() would only churn buffers)
+    tbl.capacity = meta["capacity"]
+    tbl.dense_n = meta["dense_n"]
+    tbl.dense_per_shard = meta["dense_per_shard"]
+    tbl.dense_active = np.zeros(tbl.dense_n, dtype=bool)
+    if meta["dense_active"]:
+        tbl.dense_active[np.asarray(meta["dense_active"], int)] = True
+    tbl.key_to_slot = {int(k): tuple(v)
+                       for k, v in meta["key_to_slot"].items()}
+    tbl.free = [list(f) for f in meta["free"]]
+
+
+class VectorCheckpointer:
+    """Orbax-backed snapshot of every ShardedActorTable in a VectorRuntime
+    (state arrays + host bookkeeping), with retention and async writes."""
+
+    def __init__(self, runtime: "VectorRuntime", directory: str,
+                 max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.runtime = runtime
+        self.manager = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep))
+
+    def _state_tree(self) -> dict:
+        return {cls.__name__: dict(tbl.state)
+                for cls, tbl in self.runtime.tables.items()}
+
+    def save(self, step: int) -> None:
+        """Enqueue an async snapshot (returns before the write completes;
+        orbax copies device→host, then writes in a background thread —
+        serving continues)."""
+        ocp = self._ocp
+        meta = {cls.__name__: _table_meta(tbl)
+                for cls, tbl in self.runtime.tables.items()}
+        self.manager.save(step, args=ocp.args.Composite(
+            state=ocp.args.StandardSave(self._state_tree()),
+            meta=ocp.args.JsonSave(meta)))
+
+    def wait(self) -> None:
+        self.manager.wait_until_finished()
+
+    def latest_step(self) -> int | None:
+        return self.manager.latest_step()
+
+    def restore(self, step: int | None = None) -> int:
+        """Rebuild every registered table from the checkpoint. The runtime
+        must have the same grain classes registered (the schema IS the
+        codegen contract; mismatch raises)."""
+        ocp = self._ocp
+        step = self.manager.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError("no checkpoint to restore")
+        by_name = {cls.__name__: tbl
+                   for cls, tbl in self.runtime.tables.items()}
+        # phase 1: bookkeeping only — validates registration before orbax
+        # compares state trees
+        meta = self.manager.restore(step, args=ocp.args.Composite(
+            meta=ocp.args.JsonRestore()))["meta"]
+        missing = set(meta) - set(by_name)
+        if missing:
+            raise KeyError(
+                f"checkpoint has tables {sorted(missing)} not registered "
+                f"on this runtime — register the grain classes first")
+        # template shapes come from the checkpoint's own capacity, so a
+        # runtime built with a different capacity_per_shard still restores
+        template = {}
+        for name in meta:
+            tbl = by_name[name]
+            cap = meta[name]["capacity"]
+            template[name] = {
+                f: jax.ShapeDtypeStruct(
+                    (tbl.n_shards, cap + 1, *shape), dtype)
+                for f, (dtype, shape) in tbl.grain_class.STATE.items()}
+        state = self.manager.restore(step, args=ocp.args.Composite(
+            state=ocp.args.StandardRestore(template)))["state"]
+        for name in meta:
+            tbl = by_name[name]
+            _apply_meta(tbl, meta[name])
+            tbl.restore({k: np.asarray(v) for k, v in state[name].items()})
+        return step
+
+    def close(self) -> None:
+        self.manager.close()
+
+
+class VectorStorageBridge:
+    """Write-behind per-actor persistence for one VectorGrain class: rows
+    flushed to / loaded from an ordinary ``GrainStorage`` provider, with
+    the same etag discipline host grains get from StateStorageBridge."""
+
+    def __init__(self, runtime: "VectorRuntime", grain_class: type,
+                 storage: GrainStorage):
+        self.runtime = runtime
+        self.grain_class = grain_class
+        self.storage = storage
+        self.grain_type = grain_class.__name__
+        self._etags: dict[int, str | None] = {}
+
+    def _grain_id(self, key: int) -> GrainId:
+        return GrainId.for_grain(GrainType.of(self.grain_type), int(key))
+
+    def _locate(self, keys) -> tuple[np.ndarray, np.ndarray]:
+        tbl = self.runtime.table(self.grain_class)
+        shards, slots = [], []
+        for k in keys:
+            k = int(k)
+            if 0 <= k < tbl.dense_n:
+                shards.append(k // tbl.dense_per_shard)
+                slots.append(k % tbl.dense_per_shard)
+            else:
+                loc = tbl.lookup(k)
+                if loc is None:
+                    raise KeyError(f"key {k} has no activation slot")
+                shards.append(loc[0])
+                slots.append(loc[1])
+        return np.asarray(shards, np.int32), np.asarray(slots, np.int32)
+
+    async def flush(self, keys: Iterable[int]) -> int:
+        """Write-behind: persist the current device rows for ``keys``.
+        One batched device→host gather, then per-actor etag'd writes."""
+        keys = list(keys)
+        if not keys:
+            return 0
+        tbl = self.runtime.table(self.grain_class)
+        shards, slots = self._locate(keys)
+        host = {f: np.asarray(a[shards, slots])
+                for f, a in tbl.state.items()}
+
+        async def write_one(i: int, key: int) -> None:
+            state = {f: host[f][i] for f in host}
+            etag = await self.storage.write(
+                self.grain_type, self._grain_id(key), state,
+                self._etags.get(key))
+            self._etags[key] = etag
+
+        await asyncio.gather(*(write_one(i, int(k))
+                               for i, k in enumerate(keys)))
+        return len(keys)
+
+    async def load(self, keys: Iterable[int]) -> list[int]:
+        """Resume: read stored rows and scatter them into the table.
+        Returns the keys that had persisted state (missing keys keep
+        their fresh-init state — the lazy-recreate contract)."""
+        keys = [int(k) for k in keys]
+        if not keys:
+            return []
+        tbl = self.runtime.table(self.grain_class)
+
+        async def read_one(key: int):
+            state, etag = await self.storage.read(
+                self.grain_type, self._grain_id(key))
+            return key, state, etag
+
+        rows = await asyncio.gather(*(read_one(k) for k in keys))
+        found = [(k, s, e) for k, s, e in rows if s is not None]
+        if not found:
+            return []
+        for k, _, e in found:
+            self._etags[k] = e
+        fkeys = [k for k, _, _ in found]
+        # claim slots for hashed keys that have no activation yet
+        for k in fkeys:
+            if not (0 <= k < tbl.dense_n) and tbl.lookup(k) is None:
+                tbl.lookup_or_allocate(k)
+        if tbl.dense_active.size:
+            dense = [k for k in fkeys if 0 <= k < tbl.dense_n]
+            if dense:
+                tbl.dense_active[np.asarray(dense, int)] = True
+        shards, slots = self._locate(fkeys)
+        for f, arr in tbl.state.items():
+            vals = np.stack([np.asarray(s[f]) for _, s, _ in found])
+            tbl.state[f] = tbl._put(arr.at[shards, slots].set(
+                jax.numpy.asarray(vals)))
+        return fkeys
