@@ -405,7 +405,9 @@ class VectorRuntime:
         for fname, (dtype, shape) in m.args_schema.items():
             args_b[fname] = tbl._put(
                 jnp.asarray(plan.pack(np.asarray(args[fname]), dtype, shape)))
-        new_state, results = self._kernel(grain_class, method, plan.B)(
+        kern = self._kernel(grain_class, method, plan.B,
+                            contiguous=self._plan_contiguous(tbl, plan))
+        new_state, results = kern(
             tbl.state, d_slots, d_khash, d_fresh, d_valid, args_b)
         if not m.read_only:
             tbl.state = new_state
@@ -461,7 +463,8 @@ class VectorRuntime:
             packed = np.stack([plan.pack(a[k], dtype, shape)
                                for k in range(K)])
             args_b[fname] = tbl._put_rounds(jnp.asarray(packed))
-        kern = self._scan_kernel(grain_class, method, plan.B, K)
+        kern = self._scan_kernel(grain_class, method, plan.B, K,
+                                 contiguous=self._plan_contiguous(tbl, plan))
         new_state, results = kern(
             tbl.state, d_slots, d_khash, d_fresh, d_valid, args_b)
         if not m.read_only:
@@ -474,14 +477,24 @@ class VectorRuntime:
             lambda a: np.stack([plan.unpack(a[k]) for k in range(K)]),
             results)
 
-    def _scan_kernel(self, cls: type, method: str, B: int, K: int):
+    def _scan_kernel(self, cls: type, method: str, B: int, K: int,
+                     contiguous: bool = False):
         tbl = self.tables[cls]
-        key = ("scan", cls, method, B, K, tbl.capacity, tbl.n_shards)
+        key = ("scan", cls, method, B, K, tbl.capacity, tbl.n_shards,
+               contiguous)
         k = self._kernel_cache.get(key)
         if k is None:
-            k = self._build_kernel(cls, method, scan_rounds=K)
+            k = self._build_kernel(cls, method, scan_rounds=K,
+                                   contiguous=contiguous)
             self._kernel_cache[key] = k
         return k
+
+    def _plan_contiguous(self, tbl, plan: "_DensePlan") -> bool:
+        """Identity plans touch slots [0, counts[s]) per shard in lane
+        order — the gather/scatter degenerates to a contiguous slice of the
+        slot pool (the 1M-actor bulk regime; ~1000x cheaper on TPU than a
+        dynamic 1M-row gather)."""
+        return plan.identity and plan.B <= tbl.capacity
 
     def call_batch_device(self, grain_class: type, method: str,
                           slots_b, khash_b, fresh_b, valid_b, args_b):
@@ -502,16 +515,18 @@ class VectorRuntime:
     # ------------------------------------------------------------------
     # Kernel construction
     # ------------------------------------------------------------------
-    def _kernel(self, cls: type, method: str, B: int):
+    def _kernel(self, cls: type, method: str, B: int,
+                contiguous: bool = False):
         tbl = self.tables[cls]
-        key = (cls, method, B, tbl.capacity, tbl.n_shards)
+        key = (cls, method, B, tbl.capacity, tbl.n_shards, contiguous)
         k = self._kernel_cache.get(key)
         if k is None:
-            k = self._build_kernel(cls, method)
+            k = self._build_kernel(cls, method, contiguous=contiguous)
             self._kernel_cache[key] = k
         return k
 
-    def _build_kernel(self, cls: type, method: str, scan_rounds: int = 0):
+    def _build_kernel(self, cls: type, method: str, scan_rounds: int = 0,
+                      contiguous: bool = False):
         tbl = self.tables[cls]
         m = tbl.methods[method]
         handler = m.fn
@@ -526,8 +541,14 @@ class VectorRuntime:
             slots_l, khash_l = slots[0], khash[0]
             fresh_l, valid_l = fresh[0], valid[0]
             args_l = jax.tree_util.tree_map(lambda a: a[0], args)
+            B = slots_l.shape[0]
 
-            rows = jax.tree_util.tree_map(lambda f: f[slots_l], state_l)
+            if contiguous:
+                # identity plan: lane i == slot i — a static slice replaces
+                # the dynamic gather (and the scatter below)
+                rows = jax.tree_util.tree_map(lambda f: f[:B], state_l)
+            else:
+                rows = jax.tree_util.tree_map(lambda f: f[slots_l], state_l)
             init_rows = jax.vmap(init)(khash_l)
 
             def sel(mask, a, b):
@@ -542,8 +563,12 @@ class VectorRuntime:
             else:
                 write = valid_l
 
-                def scatter(f, nr, r):
-                    return f.at[slots_l].set(sel(write, nr, r))
+                if contiguous:
+                    def scatter(f, nr, r):
+                        return f.at[:B].set(sel(write, nr, r))
+                else:
+                    def scatter(f, nr, r):
+                        return f.at[slots_l].set(sel(write, nr, r))
 
                 new_state_l = jax.tree_util.tree_map(
                     scatter, state_l, new_rows, rows)
@@ -561,17 +586,27 @@ class VectorRuntime:
                 # never re-init
                 st = jax.tree_util.tree_map(lambda a: a[0], state)
                 slots_l, khash_l = slots[0], khash[0]
+                B = slots_l.shape[0]
                 write = fresh[0] & valid[0]
-                rows = jax.tree_util.tree_map(lambda f: f[slots_l], st)
+                if contiguous:
+                    rows = jax.tree_util.tree_map(lambda f: f[:B], st)
+                else:
+                    rows = jax.tree_util.tree_map(lambda f: f[slots_l], st)
                 init_rows = jax.vmap(init)(khash_l)
 
                 def sel(mask, a, b):
                     return jnp.where(
                         mask.reshape(mask.shape + (1,) * (a.ndim - 1)), a, b)
 
+                if contiguous:
+                    def put(f, ir, r):
+                        return f.at[:B].set(sel(write, ir, r))
+                else:
+                    def put(f, ir, r):
+                        return f.at[slots_l].set(sel(write, ir, r))
+
                 new_st = jax.tree_util.tree_map(
-                    lambda f, ir, r: f.at[slots_l].set(sel(write, ir, r)),
-                    st, init_rows, rows)
+                    lambda f, ir, r: put(f, ir, r), st, init_rows, rows)
                 return jax.tree_util.tree_map(lambda a: a[None], new_st)
 
             def scanned(state, slots, khash, fresh, valid, args_rounds):

@@ -41,7 +41,10 @@ class ShardedActorTable:
         self.grain_class = grain_class
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n_shards = self.mesh.devices.size
-        self.capacity = int(capacity_per_shard)
+        # power-of-two capacity: bounds distinct kernel shapes (grow() keeps
+        # this invariant) and lets padded batch buckets (_bucket, also po2)
+        # slice the slot pool contiguously in the dense fast path
+        self.capacity = 1 << (int(capacity_per_shard) - 1).bit_length()
         self.methods = vector_methods(grain_class)
         # On a 1-device mesh, committed NamedSharding buffers pay a large
         # dispatch/layout penalty through the axon tunnel for zero benefit;
