@@ -1,0 +1,187 @@
+"""Chirper fan-out benchmark — follower-graph multicast over the ICI mesh.
+
+BASELINE.md config: "Samples/Chirper — follower-graph fan-out as ICI
+all-to-all multicast" (reference Samples/Chirper: ChirperAccount grains
+push each chirp to all follower accounts' timelines). Vectorized: accounts
+live in a sharded timeline table; one tick takes a batch of chirps,
+expands each to its followers (dense [B, F] follower lists), routes the
+(follower, chirp) messages across shards with the tick exchange
+(all_to_all — parallel.transport), then appends delivered chirps into
+per-follower timeline ring buffers using the sort-based rank kernel
+(ops.route.rank_dense_keys — large key space) for within-follower append
+positions.
+
+Measures delivered follower-timeline writes/sec (the fan-out analog of
+grain msgs/sec).
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+if __package__ in (None, ""):
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from orleans_tpu.ops.route import rank_dense_keys
+from orleans_tpu.parallel import make_mesh
+from orleans_tpu.parallel.mesh import SILO_AXIS
+from orleans_tpu.parallel.transport import build_exchange
+
+
+def build_tick(mesh, n_accounts: int, timeline_len: int,
+               exchange_capacity: int):
+    """Compile the chirp-fan-out tick.
+
+    Tables (sharded over the silo axis): timelines [n, A/n, T] int32,
+    tl_pos [n, A/n] int32 (ring cursors), followers [n, A/n, F] int32,
+    fcount [n, A/n] int32. Chirp batch: chirpers/chirp_ids/chirp_valid
+    [n, B] (local account index per shard).
+    """
+    n = mesh.devices.size
+    per_shard = n_accounts // n
+    assert n_accounts % n == 0
+    exchange = build_exchange(mesh, capacity=exchange_capacity)
+    spec = P(SILO_AXIS)
+
+    def expand_local(followers, fcount, chirpers, chirp_ids, chirp_valid):
+        foll, fc = followers[0], fcount[0]
+        accounts, cids, cvalid = chirpers[0], chirp_ids[0], chirp_valid[0]
+        B = accounts.shape[0]
+        targets = foll[accounts]                              # [B, F]
+        lane = jax.lax.broadcasted_iota(jnp.int32, targets.shape, 1)
+        t_valid = (lane < fc[accounts][:, None]) & cvalid[:, None]
+        flat_t = targets.reshape(-1)
+        flat_v = t_valid.reshape(-1)
+        flat_c = jnp.broadcast_to(cids[:, None], targets.shape).reshape(-1)
+        dest = flat_t // per_shard
+        return flat_t[None], flat_v[None], flat_c[None], dest[None]
+
+    def deliver_local(recv_target, recv_chirp, recv_valid, timelines,
+                      tl_pos):
+        tls, pos = timelines[0], tl_pos[0]
+        tgt, cid, ok = recv_target[0], recv_chirp[0], recv_valid[0]
+        local_f = jnp.minimum(tgt % per_shard, per_shard - 1)
+        f_or_sink = jnp.where(ok, local_f, per_shard)
+        # within-follower append order: conflict-free ring append
+        rank = rank_dense_keys(f_or_sink)
+        write_pos = (pos[local_f] + rank) % timeline_len
+        flat = jnp.where(ok, local_f * timeline_len + write_pos,
+                         per_shard * timeline_len)
+        buf = jnp.concatenate(
+            [tls.reshape(-1), jnp.zeros((1,), tls.dtype)])
+        new_tls = buf.at[flat].set(
+            jnp.where(ok, cid, 0))[:-1].reshape(per_shard, timeline_len)
+        counts = jnp.zeros((per_shard + 1,), jnp.int32).at[f_or_sink].add(
+            jnp.where(ok, 1, 0))[:per_shard]
+        new_pos = (pos + counts) % timeline_len
+        delivered = jnp.sum(jnp.where(ok, 1, 0))
+        return new_tls[None], new_pos[None], delivered[None]
+
+    if n > 1:
+        expand = jax.shard_map(expand_local, mesh=mesh,
+                               in_specs=(spec,) * 5, out_specs=(spec,) * 4,
+                               check_vma=False)
+        deliver = jax.shard_map(deliver_local, mesh=mesh,
+                                in_specs=(spec,) * 5,
+                                out_specs=(spec,) * 3, check_vma=False)
+    else:
+        expand, deliver = expand_local, deliver_local
+
+    def tick(timelines, tl_pos, followers, fcount, chirpers, chirp_ids,
+             chirp_valid):
+        flat_t, flat_v, flat_c, dest = expand(
+            followers, fcount, chirpers, chirp_ids, chirp_valid)
+        recv, recv_valid, drops = exchange(
+            dest, flat_v, {"target": flat_t, "chirp": flat_c})
+        new_tls, new_pos, delivered = deliver(
+            recv["target"], recv["chirp"], recv_valid, timelines, tl_pos)
+        return new_tls, new_pos, delivered, drops
+
+    return jax.jit(tick, donate_argnums=(0, 1))
+
+
+def run(n_accounts: int = 65536, followers_per: int = 16,
+        chirps_per_tick: int = 16384, timeline_len: int = 32,
+        seconds: float = 8.0, n_devices: int | None = None) -> dict:
+    mesh = make_mesh(n_devices) if n_devices else make_mesh()
+    n = mesh.devices.size
+    per_shard = n_accounts // n
+    rng = np.random.default_rng(7)
+
+    followers = rng.integers(0, n_accounts,
+                             (n, per_shard, followers_per)).astype(np.int32)
+    fcount = np.full((n, per_shard), followers_per, np.int32)
+    timelines = jnp.zeros((n, per_shard, timeline_len), jnp.int32)
+    tl_pos = jnp.zeros((n, per_shard), jnp.int32)
+
+    # worst-case lanes one shard can send to one destination: all its
+    # expanded messages (uniform graphs stay far below this)
+    per_tick = chirps_per_tick // n
+    tick = build_tick(mesh, n_accounts, timeline_len,
+                      exchange_capacity=per_tick * followers_per)
+
+    chirpers = rng.integers(0, per_shard, (n, per_tick)).astype(np.int32)
+    chirp_ids = rng.integers(1, 1 << 30, (n, per_tick)).astype(np.int32)
+    chirp_valid = np.ones((n, per_tick), bool)
+
+    d_foll = jnp.asarray(followers)
+    d_fc = jnp.asarray(fcount)
+    d_ch = jnp.asarray(chirpers)
+    d_ci = jnp.asarray(chirp_ids)
+    d_cv = jnp.asarray(chirp_valid)
+
+    timelines, tl_pos, delivered, drops = tick(
+        timelines, tl_pos, d_foll, d_fc, d_ch, d_ci, d_cv)
+    jax.block_until_ready(tl_pos)
+    total_msgs = n * per_tick * followers_per
+    assert int(np.asarray(delivered).sum()) + \
+        int(np.asarray(drops).sum()) == total_msgs
+
+    ticks = 0
+    total_delivered = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds:
+        timelines, tl_pos, delivered, drops = tick(
+            timelines, tl_pos, d_foll, d_fc, d_ch, d_ci, d_cv)
+        jax.block_until_ready(tl_pos)
+        total_delivered += int(np.asarray(delivered).sum())
+        ticks += 1
+    elapsed = time.perf_counter() - t0
+
+    return {
+        "metric": "chirper_timeline_deliveries_per_sec",
+        "value": round(total_delivered / elapsed, 1),
+        "unit": "deliveries/sec",
+        "vs_baseline": None,
+        "extra": {
+            "n_accounts": n_accounts,
+            "followers_per": followers_per,
+            "chirps_per_tick": n * per_tick,
+            "ticks": ticks,
+            "chirps_per_sec": round(ticks * n * per_tick / elapsed, 1),
+            "devices": n,
+        },
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--accounts", type=int, default=65536)
+    ap.add_argument("--followers", type=int, default=16)
+    ap.add_argument("--chirps", type=int, default=16384)
+    ap.add_argument("--seconds", type=float, default=8.0)
+    a = ap.parse_args()
+    print(json.dumps(run(a.accounts, a.followers, a.chirps,
+                         seconds=a.seconds)))
+
+
+if __name__ == "__main__":
+    main()

@@ -13,7 +13,13 @@ if __package__ in (None, ""):
     import sys
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-from benchmarks import mapreduce, ping, serialization, transactions
+from benchmarks import (
+    chirper_fanout,
+    mapreduce,
+    ping,
+    serialization,
+    transactions,
+)
 
 
 def main() -> None:
@@ -24,6 +30,7 @@ def main() -> None:
     for r in serialization.run():
         print(json.dumps(r))
     print(json.dumps(asyncio.run(transactions.run(seconds=3.0))))
+    print(json.dumps(chirper_fanout.run(seconds=5.0)))
 
 
 if __name__ == "__main__":

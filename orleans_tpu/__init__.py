@@ -9,3 +9,14 @@ the control plane (membership, placement, storage, client gateway).
 """
 
 __version__ = "0.1.0"
+
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+    # Honor an explicit CPU request: in this image the axon TPU plugin
+    # registers regardless of JAX_PLATFORMS and would grab the tunnel; the
+    # config update reliably pins CPU (tests/conftest.py and
+    # __graft_entry__.py apply the same pin).
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")

@@ -12,7 +12,7 @@ emits.
 """
 
 from .hash_probe import DeviceDirectory, build_directory_arrays, device_lookup
-from .route import pack_by_dest, rank_by_dest
+from .route import pack_by_dest, rank_by_dest, rank_dense_keys
 from .segment_reduce import (
     segment_sum,
     segment_sum_onehot,
@@ -24,6 +24,7 @@ __all__ = [
     "segment_sum_onehot",
     "segment_sum_pallas",
     "rank_by_dest",
+    "rank_dense_keys",
     "pack_by_dest",
     "device_lookup",
     "build_directory_arrays",

@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-__all__ = ["rank_by_dest", "pack_by_dest"]
+__all__ = ["rank_by_dest", "rank_dense_keys", "pack_by_dest"]
 
 
 def _prefix_kernel(ids_ref, out_ref, *, block: int, n_dest: int):
@@ -84,6 +84,27 @@ def rank_by_dest(dest: jax.Array, n_dest: int, *, block: int = 256,
     return rank.astype(jnp.int32)
 
 
+def rank_dense_keys(keys: jax.Array) -> jax.Array:
+    """rank[i] = position of element i within its key group — the same
+    prefix count as :func:`rank_by_dest`, for LARGE key spaces.
+
+    Regime split: the MXU prefix-count builds an O(B x S) table — ideal
+    when S is the shard count (routing), ruinous when S is an actor space
+    (fan-in append to 64k timelines). Here the rank comes from one stable
+    argsort + a cumulative max (O(B log^2 B) sort beats an O(B*S) table
+    once S >> log^2 B). keys: [B] int32 (any values). Returns [B] int32.
+    """
+    B = keys.shape[0]
+    order = jnp.argsort(keys, stable=True)
+    ks = keys[order]
+    idx = jnp.arange(B, dtype=jnp.int32)
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), bool), ks[1:] != ks[:-1]])
+    group_start = jax.lax.cummax(jnp.where(is_start, idx, 0))
+    rank_sorted = idx - group_start
+    return jnp.zeros((B,), jnp.int32).at[order].set(rank_sorted)
+
+
 def pack_by_dest(dest: jax.Array, valid: jax.Array, payload: dict,
                  n_dest: int, capacity: int, **rank_kw):
     """Sort-free outbox pack (drop-in for transport._pack_outbox semantics).
@@ -96,7 +117,12 @@ def pack_by_dest(dest: jax.Array, valid: jax.Array, payload: dict,
     in_range = (dest >= 0) & (dest < n_dest)
     ok = valid & in_range
     d = jnp.where(ok, dest, n_dest).astype(jnp.int32)
-    rank = rank_by_dest(d, n_dest + 1, **rank_kw)
+    if dest.shape[0] > 32768 and not rank_kw:
+        # the MXU prefix count is O(B^2); past ~32k lanes the sort-based
+        # rank's O(B log^2 B) wins even on TPU
+        rank = rank_dense_keys(d)
+    else:
+        rank = rank_by_dest(d, n_dest + 1, **rank_kw)
     keep = ok & (rank < capacity)
     drops = jnp.sum(ok & ~keep) + jnp.sum(valid & ~in_range)
     sink = n_dest * capacity

@@ -3,7 +3,8 @@ its harnesses inside the test tree too — test/Benchmarks builds against
 TestCluster). Correctness assertions inside each harness (echo values,
 word-count table, balance conservation) are the point; speed is not."""
 
-from benchmarks import mapreduce, ping, serialization, transactions
+from benchmarks import chirper_fanout, mapreduce, ping, serialization, \
+    transactions
 
 
 def _check(r: dict) -> None:
@@ -32,3 +33,12 @@ async def test_transactions_harness():
     r = await transactions.run(n_accounts=8, concurrency=3, seconds=0.3)
     _check(r)
     assert r["extra"]["committed"] > 0
+
+
+def test_chirper_fanout_harness():
+    # 8-shard CPU mesh: exercises expand → all_to_all → ranked ring append
+    r = chirper_fanout.run(n_accounts=1024, followers_per=4,
+                           chirps_per_tick=64, timeline_len=8,
+                           seconds=0.3, n_devices=8)
+    _check(r)
+    assert r["extra"]["devices"] == 8

@@ -12,6 +12,7 @@ from orleans_tpu.ops import (
     device_lookup,
     pack_by_dest,
     rank_by_dest,
+    rank_dense_keys,
     segment_sum,
     segment_sum_onehot,
     segment_sum_pallas,
@@ -92,6 +93,23 @@ class TestRankByDest:
         got = rank_by_dest(jnp.asarray(d), S, use_pallas=True, block=128,
                            interpret=True)
         np.testing.assert_array_equal(got, self._np_rank(d))
+
+
+class TestRankDenseKeys:
+    def test_matches_rank_by_dest_semantics(self):
+        rng = np.random.default_rng(8)
+        keys = rng.integers(0, 50_000, size=4096)  # large key space
+        got = np.asarray(rank_dense_keys(jnp.asarray(keys)))
+        seen: dict[int, int] = {}
+        for i, k in enumerate(keys):
+            assert got[i] == seen.get(int(k), 0)
+            seen[int(k)] = seen.get(int(k), 0) + 1
+
+    def test_all_same_and_all_distinct(self):
+        same = rank_dense_keys(jnp.zeros(16, jnp.int32))
+        np.testing.assert_array_equal(same, np.arange(16))
+        distinct = rank_dense_keys(jnp.arange(16, dtype=jnp.int32))
+        np.testing.assert_array_equal(distinct, np.zeros(16))
 
 
 class TestPackByDest:
