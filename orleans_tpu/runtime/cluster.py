@@ -136,6 +136,8 @@ class ClusterClient(RuntimeClient):
         self.grain_factory = GrainFactory(self)
         self._gateway_rr = 0
         self.connected = False
+        from .observers import ObserverHost
+        self._observer_host = ObserverHost(lambda: self._address)
 
     # -- RuntimeClient surface --------------------------------------------
     @property
@@ -161,7 +163,18 @@ class ClusterClient(RuntimeClient):
         OutsideRuntimeClient.RunClientMessagePump:235)."""
         if msg.direction == Direction.RESPONSE:
             self.receive_response(msg)
-        # grain→client observer calls land here too once observers exist
+        elif self._observer_host.dispatch(msg):
+            pass  # grain→client observer notification
+        else:
+            log.debug("client dropping unexpected message %s",
+                      msg.method_name)
+
+    # -- observers (CreateObjectReference / DeleteObjectReference) ---------
+    def create_observer(self, obj):
+        return self._observer_host.create_observer(obj)
+
+    def delete_observer(self, ref) -> bool:
+        return self._observer_host.delete_observer(ref)
 
     # -- lifecycle ---------------------------------------------------------
     async def connect(self) -> "ClusterClient":

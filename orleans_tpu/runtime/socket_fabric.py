@@ -534,6 +534,8 @@ class GatewayClient(RuntimeClient):
         self.connected = False
         self._reconnect_period = 0.5
         self._reconnector: asyncio.Task | None = None
+        from .observers import ObserverHost
+        self._observer_host = ObserverHost(lambda: self.silo_address)
 
     # -- RuntimeClient surface --------------------------------------------
     @property
@@ -559,7 +561,22 @@ class GatewayClient(RuntimeClient):
     def deliver(self, msg: Message) -> None:
         if msg.direction == Direction.RESPONSE:
             self.receive_response(msg)
-        # grain→client observer pushes would land here
+        elif self._observer_host.dispatch(msg):
+            pass  # grain→client observer notification
+        else:
+            log.debug("gateway client dropping unexpected message %s",
+                      msg.method_name)
+
+    # -- observers (CreateObjectReference / DeleteObjectReference) ---------
+    def create_observer(self, obj):
+        """Observer routes pin to the pseudo address of the connection the
+        ref was minted on; if that gateway drops, re-create the observer
+        (the reference refreshes observer routes the same way —
+        ClientObserverRegistrar re-registration)."""
+        return self._observer_host.create_observer(obj)
+
+    def delete_observer(self, ref) -> bool:
+        return self._observer_host.delete_observer(ref)
 
     # -- lifecycle ---------------------------------------------------------
     async def connect(self) -> "GatewayClient":

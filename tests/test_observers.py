@@ -1,0 +1,125 @@
+"""Client observers (grain→client push): the reference's IGrainObserver /
+ClientObserverRegistrar / Gateway.TryDeliverToProxy tier, over both the
+in-proc fabric and real TCP gateways."""
+
+import asyncio
+
+import pytest
+
+from orleans_tpu.membership import FileMembershipTable, join_cluster
+from orleans_tpu.runtime import (
+    ClusterClient,
+    GatewayClient,
+    Grain,
+    ObserverRef,
+    SiloBuilder,
+    SocketFabric,
+)
+
+
+class ChatGrain(Grain):
+    """Publisher grain holding observer subscriptions (the reference's
+    canonical observer sample shape)."""
+
+    def __init__(self):
+        self.subscribers: list[ObserverRef] = []
+
+    async def subscribe(self, ref: ObserverRef) -> int:
+        self.subscribers.append(ref)
+        return len(self.subscribers)
+
+    async def publish(self, text: str) -> int:
+        for ref in self.subscribers:
+            ref.on_message(text)  # one-way push
+        return len(self.subscribers)
+
+
+class Listener:
+    def __init__(self):
+        self.received: list[str] = []
+        self.event = asyncio.Event()
+
+    async def on_message(self, text: str) -> None:
+        self.received.append(text)
+        self.event.set()
+
+
+async def _wait(event: asyncio.Event, timeout: float = 5.0) -> None:
+    await asyncio.wait_for(event.wait(), timeout)
+
+
+async def test_observer_push_inproc():
+    silo = SiloBuilder().with_name("obs").add_grains(ChatGrain).build()
+    await silo.start()
+    client = await ClusterClient(silo.fabric).connect()
+    try:
+        listener = Listener()
+        ref = client.create_observer(listener)
+        chat = client.get_grain(ChatGrain, 0)
+        assert await chat.subscribe(ref) == 1
+        await chat.publish("hello")
+        await _wait(listener.event)
+        assert listener.received == ["hello"]
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_observer_delete_stops_delivery():
+    silo = SiloBuilder().with_name("obs2").add_grains(ChatGrain).build()
+    await silo.start()
+    client = await ClusterClient(silo.fabric).connect()
+    try:
+        a, b = Listener(), Listener()
+        ra, rb = client.create_observer(a), client.create_observer(b)
+        chat = client.get_grain(ChatGrain, 1)
+        await chat.subscribe(ra)
+        await chat.subscribe(rb)
+        assert client.delete_observer(ra)
+        await chat.publish("only-b")
+        await _wait(b.event)
+        await asyncio.sleep(0.05)
+        assert a.received == [] and b.received == ["only-b"]
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_observer_ref_rejects_unknown_method():
+    silo = SiloBuilder().with_name("obs3").add_grains(ChatGrain).build()
+    await silo.start()
+    client = await ClusterClient(silo.fabric).connect()
+    try:
+        ref = client.create_observer(Listener())
+        with pytest.raises(AttributeError, match="no method"):
+            ref.no_such_method
+        with pytest.raises(RuntimeError, match="grain turn"):
+            ref.on_message("outside-turn")
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_observer_push_over_tcp(tmp_path):
+    table = FileMembershipTable(str(tmp_path / "mbr.json"))
+    fabric = SocketFabric()
+    silo = (SiloBuilder().with_name("obs-tcp").with_fabric(fabric)
+            .add_grains(ChatGrain)
+            .with_config(response_timeout=5.0).build())
+    join_cluster(silo, table)
+    await silo.start()
+    client = None
+    try:
+        gw = f"127.0.0.1:{silo.silo_address.port}"
+        client = await GatewayClient([gw]).connect()
+        listener = Listener()
+        ref = client.create_observer(listener)
+        chat = client.get_grain(ChatGrain, 0)
+        await chat.subscribe(ref)
+        await chat.publish("over-the-wire")
+        await _wait(listener.event)
+        assert listener.received == ["over-the-wire"]
+    finally:
+        if client is not None:
+            await client.close_async()
+        await silo.stop()
