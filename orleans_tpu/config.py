@@ -143,6 +143,8 @@ class DispatchOptions:
 
 # flat SiloConfig field ← (options group, group field)
 _FLAT_MAP = {
+    "cluster_id": (ClusterOptions, "cluster_id"),
+    "service_id": (ClusterOptions, "service_id"),
     "response_timeout": (MessagingOptions, "response_timeout"),
     "max_enqueued_requests": (MessagingOptions, "max_enqueued_requests"),
     "turn_warning_length": (SchedulingOptions, "turn_warning_length"),
@@ -173,14 +175,7 @@ def validate_options(*groups) -> None:
 def flatten(*groups, name: str = "silo") -> SiloConfig:
     """Validate + flatten typed groups into the runtime's ``SiloConfig``.
     Unspecified groups keep their defaults."""
-    validate_options(*groups)
-    by_type = {type(g): g for g in groups}
-    cfg = SiloConfig(name=name)
-    for flat_field, (group_cls, group_field) in _FLAT_MAP.items():
-        g = by_type.get(group_cls)
-        if g is not None:
-            setattr(cfg, flat_field, getattr(g, group_field))
-    return cfg
+    return apply_options(SiloConfig(name=name), *groups)
 
 
 def log_options(*groups, logger: logging.Logger | None = None) -> None:
@@ -195,8 +190,18 @@ def log_options(*groups, logger: logging.Logger | None = None) -> None:
 
 def apply_options(cfg: SiloConfig, *groups) -> SiloConfig:
     """Validate the groups and overlay their values on a flat config
-    (consumed by ``SiloBuilder.with_options``)."""
+    (consumed by ``SiloBuilder.with_options``). Groups the silo config
+    does not consume are rejected, never silently dropped."""
     validate_options(*groups)
+    silo_groups = {cls for cls, _ in _FLAT_MAP.values()}
+    for g in groups:
+        if type(g) not in silo_groups:
+            hint = (" — DispatchOptions configures the device tier; pass "
+                    "it to VectorRuntime(options=...)"
+                    if isinstance(g, DispatchOptions) else "")
+            raise ConfigurationError(
+                f"{type(g).__name__} is not consumed by the silo "
+                f"config{hint}")
     by_type = {type(g): g for g in groups}
     for flat_field, (group_cls, group_field) in _FLAT_MAP.items():
         g = by_type.get(group_cls)

@@ -38,10 +38,12 @@ OBSERVER_TYPE = GrainType.of("ClientObserver$")
 
 
 def _public_async_methods(obj: Any) -> tuple[str, ...]:
+    # dir(obj) covers class methods AND callables assigned as instance
+    # attributes (self.on_event = cb) — both are dispatchable
     return tuple(sorted(
-        name for name in dir(type(obj))
+        name for name in dir(obj)
         if not name.startswith("_")
-        and callable(getattr(type(obj), name, None))))
+        and callable(getattr(obj, name, None))))
 
 
 @dataclass(frozen=True)
@@ -105,10 +107,14 @@ class ObserverHost:
         addr = self._address_of()
         if addr is None:
             raise RuntimeError("client is not connected")
+        methods = _public_async_methods(obj)
+        if not methods:
+            raise ValueError(
+                f"{type(obj).__name__} exposes no public callables — "
+                f"nothing for a grain to notify")
         oid = next(self._ids)
         self._observers[oid] = obj
-        return ObserverRef(addr, oid, type(obj).__name__,
-                           _public_async_methods(obj))
+        return ObserverRef(addr, oid, type(obj).__name__, methods)
 
     def delete_observer(self, ref: ObserverRef) -> bool:
         """DeleteObjectReference."""

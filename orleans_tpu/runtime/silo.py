@@ -54,6 +54,8 @@ class SiloConfig:
     GrainCollectionOptions, SiloMessagingOptions defaults)."""
 
     name: str = "silo"
+    cluster_id: str = "default"
+    service_id: str = "default"
     response_timeout: float = 30.0
     collection_age: float = 2 * 3600.0
     collection_quantum: float = 60.0

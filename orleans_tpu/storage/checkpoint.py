@@ -184,9 +184,15 @@ class VectorStorageBridge:
 
         async def write_one(i: int, key: int) -> None:
             state = {f: host[f][i] for f in host}
+            etag = self._etags.get(key)
+            if etag is None:
+                # adopt the stored etag (a fresh bridge after a checkpoint
+                # restore has no etag memory but IS the legitimate writer —
+                # the device row is the truth being flushed)
+                _, etag = await self.storage.read(
+                    self.grain_type, self._grain_id(key))
             etag = await self.storage.write(
-                self.grain_type, self._grain_id(key), state,
-                self._etags.get(key))
+                self.grain_type, self._grain_id(key), state, etag)
             self._etags[key] = etag
 
         await asyncio.gather(*(write_one(i, int(k))

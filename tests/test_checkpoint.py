@@ -134,6 +134,28 @@ class TestVectorStorageBridge:
         out = _bump_all(rt2, 16, 0.0)
         assert (np.asarray(out) == 2).all()
 
+    async def test_flush_after_checkpoint_restore_adopts_etags(self, tmp_path):
+        """The two recovery paths compose: write-behind flush, whole-silo
+        checkpoint restore, then flush again from the fresh bridge — the
+        bridge adopts stored etags instead of failing CAS."""
+        storage = MemoryStorage()
+        rt = _runtime(8)
+        _bump_all(rt, 8, 1.0)
+        await VectorStorageBridge(rt, CounterGrain, storage).flush(range(8))
+        ckpt = VectorCheckpointer(rt, str(tmp_path))
+        ckpt.save(1)
+        ckpt.wait()
+
+        rt2 = _runtime(8)
+        VectorCheckpointer(rt2, str(tmp_path)).restore()
+        _bump_all(rt2, 8, 2.0)  # newer device state than storage
+        bridge2 = VectorStorageBridge(rt2, CounterGrain, storage)
+        assert await bridge2.flush(range(8)) == 8  # no InconsistentState
+        state, _ = await storage.read(
+            "CounterGrain", bridge2._grain_id(3))
+        assert int(state["count"]) == 2 and float(state["last"]) == 2.0
+        ckpt.close()
+
     async def test_load_missing_keys_stay_fresh(self):
         storage = MemoryStorage()
         rt = _runtime(8)

@@ -81,6 +81,25 @@ class TestBuilderIntegration:
         with pytest.raises(ConfigurationError):
             SiloBuilder().with_options(MembershipOptions(num_probed=0))
 
+    def test_cluster_identity_flows_to_config(self):
+        b = SiloBuilder().with_options(
+            ClusterOptions(cluster_id="prod", service_id="svc1"))
+        assert b.config.cluster_id == "prod"
+        assert b.config.service_id == "svc1"
+
+    def test_unconsumed_group_rejected_not_dropped(self):
+        from orleans_tpu.config import DispatchOptions
+        with pytest.raises(ConfigurationError, match="VectorRuntime"):
+            SiloBuilder().with_options(DispatchOptions(capacity_per_shard=4))
+
+    def test_dispatch_options_consumed_by_vector_runtime(self):
+        from orleans_tpu.config import DispatchOptions
+        from orleans_tpu.dispatch import VectorRuntime
+        from orleans_tpu.parallel import make_mesh
+        rt = VectorRuntime(mesh=make_mesh(1),
+                           options=DispatchOptions(capacity_per_shard=64))
+        assert rt.capacity_per_shard == 64
+
 
 def test_log_options_dumps_every_field(caplog):
     with caplog.at_level(logging.INFO, logger="orleans.options"):
