@@ -54,13 +54,20 @@ class ClusterOptions:
 
 @dataclass
 class MessagingOptions:
-    """MessagingOptions / SiloMessagingOptions: timeouts, queue limits."""
+    """MessagingOptions / SiloMessagingOptions: timeouts, queue limits,
+    stuck-turn age limit (MaxRequestProcessingTime)."""
 
     response_timeout: float = 30.0
     max_enqueued_requests: int = 5000
+    max_request_processing_time: float = 60.0
 
     def validate(self) -> None:
-        _positive(self, "response_timeout", "max_enqueued_requests")
+        _positive(self, "response_timeout", "max_enqueued_requests",
+                  "max_request_processing_time")
+        if self.max_request_processing_time < self.response_timeout:
+            raise ConfigurationError(
+                "max_request_processing_time must be >= response_timeout "
+                "(a turn younger than the caller's timeout is not stuck)")
 
 
 @dataclass
@@ -147,6 +154,8 @@ _FLAT_MAP = {
     "service_id": (ClusterOptions, "service_id"),
     "response_timeout": (MessagingOptions, "response_timeout"),
     "max_enqueued_requests": (MessagingOptions, "max_enqueued_requests"),
+    "max_request_processing_time": (MessagingOptions,
+                                    "max_request_processing_time"),
     "turn_warning_length": (SchedulingOptions, "turn_warning_length"),
     "detect_deadlocks": (SchedulingOptions, "detect_deadlocks"),
     "collection_age": (GrainCollectionOptions, "collection_age"),

@@ -1,3 +1,5 @@
-"""Observability: statistics, management surface (reference L13)."""
+"""Observability: statistics, device profiling, management surface
+(reference L13)."""
 
+from .profiling import Profiler, StepTimer, annotate, traced  # noqa: F401
 from .stats import Histogram, StatsRegistry  # noqa: F401

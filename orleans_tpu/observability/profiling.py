@@ -1,0 +1,108 @@
+"""Device profiling: jax.profiler traces wired into the stats registry.
+
+The reference's tracing story is ActivityId correlation + hot-path counters
+dumped periodically (SURVEY §5 "Tracing / profiling"); its TPU equivalent
+is ``jax.profiler`` traces (XLA op timelines viewable in TensorBoard/
+Perfetto) plus named annotations so dispatch ticks show up as spans. The
+silo keeps its counters (observability.stats); this module adds the
+device-side lens:
+
+* ``Profiler.start(log_dir)`` / ``stop()`` — capture an XLA trace of
+  everything the runtime launches in between;
+* ``annotate(name)`` / ``@traced(name)`` — named spans (TraceAnnotation)
+  around host-side sections, e.g. one per dispatch tick, so the timeline
+  correlates ticks with kernels;
+* ``StepTimer`` — per-tick wall-clock into a stats histogram (the
+  TurnWarningLengthThreshold analog for the device tier: slow ticks are
+  counted and logged).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from typing import TYPE_CHECKING, Iterator
+
+import jax
+
+if TYPE_CHECKING:
+    from .stats import StatsRegistry
+
+log = logging.getLogger("orleans.profiling")
+
+__all__ = ["Profiler", "annotate", "traced", "StepTimer"]
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named span on the profiler timeline (no-op cost when no trace is
+    active)."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def traced(name: str):
+    """Decorator form of :func:`annotate`."""
+    def wrap(fn):
+        def inner(*args, **kwargs):
+            with jax.profiler.TraceAnnotation(name):
+                return fn(*args, **kwargs)
+        inner.__name__ = getattr(fn, "__name__", name)
+        return inner
+    return wrap
+
+
+class Profiler:
+    """Start/stop XLA trace capture (jax.profiler.start_trace). One active
+    capture per process; ``stop()`` is idempotent."""
+
+    def __init__(self) -> None:
+        self.active_dir: str | None = None
+
+    def start(self, log_dir: str) -> None:
+        if self.active_dir is not None:
+            raise RuntimeError(f"trace already active → {self.active_dir}")
+        jax.profiler.start_trace(log_dir)
+        self.active_dir = log_dir
+        log.info("device trace capturing → %s", log_dir)
+
+    def stop(self) -> str | None:
+        if self.active_dir is None:
+            return None
+        jax.profiler.stop_trace()
+        out, self.active_dir = self.active_dir, None
+        log.info("device trace written → %s", out)
+        return out
+
+    @contextlib.contextmanager
+    def capture(self, log_dir: str) -> Iterator[None]:
+        self.start(log_dir)
+        try:
+            yield
+        finally:
+            self.stop()
+
+
+class StepTimer:
+    """Wall-clock per named step into a stats histogram, warning on slow
+    steps (the device-tier TurnWarningLengthThreshold,
+    OrleansTaskScheduler.cs:26)."""
+
+    def __init__(self, stats: "StatsRegistry", name: str,
+                 warn_threshold: float = 0.2):
+        self.stats = stats
+        self.name = name
+        self.warn_threshold = warn_threshold
+
+    @contextlib.contextmanager
+    def step(self) -> Iterator[None]:
+        t0 = time.perf_counter()
+        with jax.profiler.TraceAnnotation(self.name):
+            yield
+        dt = time.perf_counter() - t0
+        self.stats.observe(f"{self.name}.seconds", dt)
+        if dt > self.warn_threshold:
+            self.stats.increment(f"{self.name}.slow")
+            log.warning("%s took %.3fs (threshold %.3fs)", self.name, dt,
+                        self.warn_threshold)

@@ -100,6 +100,7 @@ class ActivationData:
 
         # Turn gate state (ActivationData running/waiting)
         self.running: list[Message] = []          # currently-executing requests
+        self.running_since: dict[int, float] = {}  # msg.id → turn start
         self.waiting: collections.deque[Message] = collections.deque()
         self.max_enqueued = max_enqueued
 
@@ -156,6 +157,7 @@ class ActivationData:
     # -- running-state bookkeeping (RecordRunning:475) -------------------
     def record_running(self, msg: Message) -> None:
         self.running.append(msg)
+        self.running_since[msg.id] = time.monotonic()
         self.last_busy = time.monotonic()
 
     def reset_running(self, msg: Message) -> None:
@@ -163,7 +165,15 @@ class ActivationData:
             self.running.remove(msg)
         except ValueError:
             pass
+        self.running_since.pop(msg.id, None)
         self.last_busy = time.monotonic()
+
+    def oldest_running_age(self) -> float:
+        """Age of the longest-running turn (stuck-activation probe,
+        ActivationData.cs:583-593)."""
+        if not self.running_since:
+            return 0.0
+        return time.monotonic() - min(self.running_since.values())
 
     @property
     def is_inactive(self) -> bool:

@@ -158,26 +158,32 @@ class Catalog:
     # ------------------------------------------------------------------
     # Deactivation (Catalog.cs:780-917)
     # ------------------------------------------------------------------
-    def schedule_deactivation(self, act: ActivationData) -> None:
-        t = asyncio.get_running_loop().create_task(self._deactivate(act))
+    def schedule_deactivation(self, act: ActivationData,
+                              stuck: bool = False) -> None:
+        t = asyncio.get_running_loop().create_task(
+            self._deactivate(act, stuck=stuck))
         self.deactivation_tasks.add(t)
         t.add_done_callback(self.deactivation_tasks.discard)
 
-    async def _deactivate(self, act: ActivationData) -> None:
+    async def _deactivate(self, act: ActivationData,
+                          stuck: bool = False) -> None:
         if act.state in (ActivationState.DEACTIVATING, ActivationState.INVALID):
             return
         act.state = ActivationState.DEACTIVATING
         act.stop_timers()
-        # wait for running turns to drain (bounded)
-        deadline = time.monotonic() + self.silo.config.deactivation_timeout
-        while act.running and time.monotonic() < deadline:
-            await asyncio.sleep(0.005)
-        try:
-            hook = getattr(act.grain_instance, "on_deactivate", None)
-            if hook is not None:
-                await hook()
-        except Exception:  # noqa: BLE001
-            log.exception("on_deactivate failed for %s", act.grain_id)
+        if not stuck:
+            # wait for running turns to drain (bounded)
+            deadline = time.monotonic() + self.silo.config.deactivation_timeout
+            while act.running and time.monotonic() < deadline:
+                await asyncio.sleep(0.005)
+        if not stuck:
+            # a stuck instance's hook would hang too — skip it
+            try:
+                hook = getattr(act.grain_instance, "on_deactivate", None)
+                if hook is not None:
+                    await hook()
+            except Exception:  # noqa: BLE001
+                log.exception("on_deactivate failed for %s", act.grain_id)
         if not act.is_stateless_worker and not act.grain_id.is_system_target():
             try:
                 await self.silo.locator.unregister(act.address)
@@ -226,10 +232,27 @@ class Catalog:
         while True:
             await asyncio.sleep(self.collection_quantum * (0.9 + 0.2 * random.random()))
             now = time.monotonic()
+            stuck_limit = self.silo.config.max_request_processing_time
             for act in list(self.by_activation.values()):
                 if act.grain_id.is_system_target():
                     continue  # system targets live as long as the silo
-                if act.state != ActivationState.VALID or not act.is_inactive:
+                if act.state != ActivationState.VALID:
+                    continue
+                if not act.is_inactive:
+                    # stuck-activation detection (DeactivateStuckActivation,
+                    # ActivationData.cs:583-593, Catalog.cs:787): a turn
+                    # exceeding the request-age limit will never finish —
+                    # abandon the activation so the next call rebuilds it
+                    # elsewhere (the hung coroutine is orphaned; its late
+                    # response, if any, is dropped by the callback registry)
+                    if act.oldest_running_age() > stuck_limit:
+                        log.error(
+                            "stuck activation %s: turn running %.1fs "
+                            "(limit %.1fs) — deactivating", act.grain_id,
+                            act.oldest_running_age(), stuck_limit)
+                        self.silo.stats.increment(
+                            "catalog.activations.stuck")
+                        self.schedule_deactivation(act, stuck=True)
                     continue
                 if now < act.keep_alive_until:
                     continue

@@ -57,6 +57,9 @@ class SiloConfig:
     cluster_id: str = "default"
     service_id: str = "default"
     response_timeout: float = 30.0
+    # a turn older than this is "stuck": the activation is abandoned and
+    # rebuilt (SiloMessagingOptions.MaxRequestProcessingTime)
+    max_request_processing_time: float = 60.0
     collection_age: float = 2 * 3600.0
     collection_quantum: float = 60.0
     max_enqueued_requests: int = 5000

@@ -1,0 +1,46 @@
+"""Device profiling hooks (jax.profiler trace capture + annotations +
+slow-step accounting — SURVEY §5 tracing TPU equivalent)."""
+
+import os
+
+import pytest
+
+import jax.numpy as jnp
+
+from orleans_tpu.observability import Profiler, StatsRegistry, StepTimer, \
+    annotate, traced
+
+
+def test_trace_capture_writes_files(tmp_path):
+    p = Profiler()
+    with p.capture(str(tmp_path)):
+        with annotate("test-span"):
+            jnp.arange(128).sum().block_until_ready()
+    assert p.active_dir is None
+    dumped = [f for _, _, fs in os.walk(tmp_path) for f in fs]
+    assert dumped, "no trace files written"
+
+
+def test_double_start_rejected(tmp_path):
+    p = Profiler()
+    p.start(str(tmp_path))
+    try:
+        with pytest.raises(RuntimeError, match="already active"):
+            p.start(str(tmp_path))
+    finally:
+        p.stop()
+    assert p.stop() is None  # idempotent
+
+
+def test_traced_decorator_and_step_timer():
+    stats = StatsRegistry()
+    timer = StepTimer(stats, "tick", warn_threshold=0.0)  # always slow
+
+    @traced("work")
+    def work(x):
+        return x + 1
+
+    with timer.step():
+        assert work(1) == 2
+    assert stats.get("tick.slow") == 1
+    assert sum(stats.histogram("tick.seconds").counts) >= 1

@@ -1,0 +1,69 @@
+"""Stuck-activation detection (SURVEY §5: request-age limit →
+DeactivateStuckActivation, ActivationData.cs:583-593, Catalog.cs:787):
+a turn that never completes gets its activation abandoned and rebuilt,
+preserving the virtual-actor guarantee for subsequent callers."""
+
+import asyncio
+
+from orleans_tpu.runtime import ClusterClient, Grain, SiloBuilder
+
+
+class HangGrain(Grain):
+    """First call hangs forever; later calls answer (same key → proves the
+    activation was rebuilt, since the hung instance can never reply)."""
+
+    def __init__(self):
+        self.instance_calls = 0
+
+    async def hang(self) -> None:
+        await asyncio.Event().wait()  # never set
+
+    async def poke(self) -> int:
+        self.instance_calls += 1
+        return self.instance_calls
+
+
+async def test_stuck_turn_abandons_activation():
+    silo = (SiloBuilder().with_name("stuck")
+            .add_grains(HangGrain)
+            .with_config(collection_quantum=0.1,
+                         max_request_processing_time=0.3,
+                         response_timeout=5.0,
+                         deactivation_timeout=0.2)
+            .build())
+    await silo.start()
+    client = await ClusterClient(silo.fabric).connect()
+    try:
+        g = client.get_grain(HangGrain, 7)
+        hang_future = asyncio.ensure_future(g.hang())
+        await asyncio.sleep(0.05)
+        assert silo.catalog.activation_count() == 1
+
+        # non-reentrant grain: poke() queues behind the hung turn until the
+        # collector declares the activation stuck and rebuilds it
+        result = await asyncio.wait_for(g.poke(), timeout=5.0)
+        assert result == 1  # fresh instance — counter restarted
+        assert silo.stats.get("catalog.activations.stuck") >= 1
+        hang_future.cancel()
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_healthy_long_turn_not_flagged():
+    silo = (SiloBuilder().with_name("ok")
+            .add_grains(HangGrain)
+            .with_config(collection_quantum=0.05,
+                         max_request_processing_time=10.0)
+            .build())
+    await silo.start()
+    client = await ClusterClient(silo.fabric).connect()
+    try:
+        g = client.get_grain(HangGrain, 1)
+        assert await g.poke() == 1
+        await asyncio.sleep(0.2)  # several collector passes
+        assert await g.poke() == 2  # same instance — not collected as stuck
+        assert silo.stats.get("catalog.activations.stuck") == 0
+    finally:
+        await client.close_async()
+        await silo.stop()
