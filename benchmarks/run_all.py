@@ -15,6 +15,7 @@ if __package__ in (None, ""):
 
 from benchmarks import (
     chirper_fanout,
+    gpstracker_stream,
     mapreduce,
     ping,
     serialization,
@@ -31,6 +32,8 @@ def main() -> None:
         print(json.dumps(r))
     print(json.dumps(asyncio.run(transactions.run(seconds=3.0))))
     print(json.dumps(chirper_fanout.run(seconds=5.0)))
+    for r in asyncio.run(gpstracker_stream.run(seconds=2.0)):
+        print(json.dumps(r))
 
 
 if __name__ == "__main__":

@@ -3,8 +3,8 @@ its harnesses inside the test tree too — test/Benchmarks builds against
 TestCluster). Correctness assertions inside each harness (echo values,
 word-count table, balance conservation) are the point; speed is not."""
 
-from benchmarks import chirper_fanout, mapreduce, ping, serialization, \
-    transactions
+from benchmarks import chirper_fanout, gpstracker_stream, mapreduce, ping, \
+    serialization, transactions
 
 
 def _check(r: dict) -> None:
@@ -33,6 +33,13 @@ async def test_transactions_harness():
     r = await transactions.run(n_accounts=8, concurrency=3, seconds=0.3)
     _check(r)
     assert r["extra"]["committed"] > 0
+
+
+async def test_gpstracker_harness():
+    for r in await gpstracker_stream.run(n_devices=4, batch=8, seconds=0.3,
+                                         vec_devices=256, vec_rounds=2,
+                                         vec_iters=2):
+        _check(r)
 
 
 def test_chirper_fanout_harness():
