@@ -24,8 +24,8 @@ log = logging.getLogger("orleans.options")
 __all__ = [
     "ClusterOptions", "MessagingOptions", "SchedulingOptions",
     "GrainCollectionOptions", "MembershipOptions", "DirectoryOptions",
-    "DispatchOptions", "flatten", "apply_options", "validate_options",
-    "log_options",
+    "LoadSheddingOptions", "DispatchOptions", "flatten", "apply_options",
+    "validate_options", "log_options",
 ]
 
 
@@ -126,6 +126,19 @@ class MembershipOptions:
 
 
 @dataclass
+class LoadSheddingOptions:
+    """LoadSheddingOptions: gateway ingress shed under overload. The
+    reference sheds on CPU%; the host-tier analog sheds on application
+    inbound queue depth."""
+
+    enabled: bool = False
+    limit: int = 10_000
+
+    def validate(self) -> None:
+        _positive(self, "limit")
+
+
+@dataclass
 class DirectoryOptions:
     """Grain-directory caching (GrainDirectoryOptions: CachingStrategy,
     CacheSize)."""
@@ -171,6 +184,8 @@ _FLAT_MAP = {
     "membership_refresh_period": (MembershipOptions, "refresh_period"),
     "membership_vote_expiration": (MembershipOptions, "vote_expiration"),
     "directory_cache_size": (DirectoryOptions, "cache_size"),
+    "load_shedding_enabled": (LoadSheddingOptions, "enabled"),
+    "load_shedding_limit": (LoadSheddingOptions, "limit"),
 }
 
 

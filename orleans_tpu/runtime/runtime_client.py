@@ -9,6 +9,7 @@ Re-design of /root/reference/src/Orleans.Runtime/Core/InsideRuntimeClient.cs:28
 from __future__ import annotations
 
 import asyncio
+import random
 import logging
 import time
 from typing import TYPE_CHECKING, Any
@@ -140,23 +141,36 @@ class RuntimeClient:
                 RejectionError(str(msg.body))
             cb.future.set_exception(exc)
         else:  # rejection — transparently resend transient rejections
+            # GATEWAY_TOO_BUSY is retryable: the resend re-picks a gateway
+            # (the reference's client reroutes around overloaded gateways)
             if (msg.rejection_type is not None
                     and cb.message.resend_count < MAX_RESEND_COUNT
-                    and msg.rejection_type.name in ("TRANSIENT", "CACHE_INVALIDATION")):
+                    and msg.rejection_type.name in (
+                        "TRANSIENT", "CACHE_INVALIDATION",
+                        "GATEWAY_TOO_BUSY")):
                 cb.message.resend_count += 1
                 cb.message.target_silo = None  # re-address from scratch
                 cb.message.target_activation = None
                 self.callbacks[msg.id] = cb
                 # back off before re-addressing: transient rejections during
                 # silo death need the directory/membership view a moment to
-                # converge before the retry can land elsewhere
-                delay = 0.05 * (2 ** cb.message.resend_count)
+                # converge before the retry can land elsewhere. Jittered —
+                # a shed burst retried on a synchronized schedule arrives as
+                # the same burst and sheds again (thundering herd).
+                delay = 0.05 * (2 ** cb.message.resend_count) * \
+                    (0.5 + random.random())
 
                 def _resend(mid=msg.id, m=cb.message):
                     if mid in self.callbacks:
                         self.transmit(m)
 
                 asyncio.get_running_loop().call_later(delay, _resend)
+                return
+            if msg.rejection_type is not None and \
+                    msg.rejection_type.name == "GATEWAY_TOO_BUSY":
+                from ..core.errors import GatewayTooBusyError
+                cb.future.set_exception(GatewayTooBusyError(
+                    msg.rejection_info or "gateway overloaded"))
                 return
             cb.future.set_exception(RejectionError(msg.rejection_info or "rejected"))
 

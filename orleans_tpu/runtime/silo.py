@@ -60,6 +60,12 @@ class SiloConfig:
     # a turn older than this is "stuck": the activation is abandoned and
     # rebuilt (SiloMessagingOptions.MaxRequestProcessingTime)
     max_request_processing_time: float = 60.0
+    # gateway load shedding (LoadSheddingOptions): when enabled, client
+    # ingress is rejected GATEWAY_TOO_BUSY once the application inbound
+    # queue backs up past the limit (the queue-depth analog of the
+    # reference's CPU-threshold shed)
+    load_shedding_enabled: bool = False
+    load_shedding_limit: int = 10_000
     collection_age: float = 2 * 3600.0
     collection_quantum: float = 60.0
     max_enqueued_requests: int = 5000
@@ -130,6 +136,26 @@ class MessageCenter:
     def deliver(self, msg: Message) -> None:
         """Called by the fabric when a message arrives for this silo."""
         if not self.running:
+            return
+        cfg = self.silo.config
+        if (cfg.load_shedding_enabled
+                and msg.category == Category.APPLICATION
+                and msg.direction == Direction.REQUEST
+                and (msg.target_silo is None
+                     or msg.target_silo != self.silo.silo_address)
+                and self.inbound[Category.APPLICATION].qsize()
+                >= cfg.load_shedding_limit):
+            # gateway ingress under overload: shed before queueing
+            # (Gateway load shedding, LoadSheddingOptions; rejection type
+            # Message.cs:87-93 GatewayTooBusy). Silo-to-silo traffic is
+            # never shed — only client ingress.
+            self.silo.stats.increment("messaging.gateway.shed")
+            if msg.sending_silo is not None:
+                from ..core.message import RejectionType, make_rejection
+                rej = make_rejection(msg, RejectionType.GATEWAY_TOO_BUSY,
+                                     "gateway overloaded; retry")
+                rej.target_silo = msg.sending_silo
+                self.silo.fabric.deliver(rej)
             return
         self.inbound[msg.category].put_nowait(msg)
 
