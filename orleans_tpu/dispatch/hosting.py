@@ -1,0 +1,51 @@
+"""Host the device tier inside a silo (the two-tier catalog of SURVEY §7
+hard parts #1, and the north-star interception: the silo's message loop
+hands vector-interface requests to the batched kernel engine instead of
+per-activation turns).
+
+``add_vector_grains(builder, PlayerGrain, ...)`` installs a VectorRuntime
+on the silo and registers each class's interface; after that, ordinary
+clients call device-tier actors exactly like host grains —
+
+    client.get_grain(PlayerGrain, 42).heartbeat(pos=...)
+
+— and concurrent calls from any number of clients coalesce into per-tick
+kernels. Gateway affinity (target-grain-hash routing in the client message
+centers) keeps one key's calls on one silo, so per-silo tables act as the
+cluster's key partition without a directory entry per actor.
+"""
+
+from __future__ import annotations
+
+from .engine import VectorRuntime
+from .vector_grain import VectorGrain
+
+__all__ = ["add_vector_grains"]
+
+
+def add_vector_grains(builder, *grain_classes: type[VectorGrain],
+                      mesh=None, capacity_per_shard: int = 1024,
+                      dense: dict[type, int] | None = None,
+                      options=None):
+    """Register device-tier grain classes on a SiloBuilder.
+
+    ``dense``: optional {class: n} pre-provisioning keys 0..n-1 with the
+    zero-shuffle dense mapping (the bulk regime). ``options``: a
+    config.DispatchOptions group (overrides capacity_per_shard).
+    """
+    for cls in grain_classes:
+        if not issubclass(cls, VectorGrain):
+            raise TypeError(f"{cls.__name__} is not a VectorGrain")
+
+    def install(silo) -> None:
+        if silo.vector is None:
+            silo.vector = VectorRuntime(
+                mesh=mesh, capacity_per_shard=capacity_per_shard,
+                options=options)
+        silo.vector.register(*grain_classes)
+        for cls in grain_classes:
+            silo.vector_interfaces[cls.__name__] = cls
+        for cls, n in (dense or {}).items():
+            silo.vector.table(cls).ensure_dense(n)
+
+    return builder.configure(install)

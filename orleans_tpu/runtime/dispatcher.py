@@ -53,6 +53,14 @@ class Dispatcher:
         if msg.direction == Direction.RESPONSE:
             self.silo.runtime_client.receive_response(msg)
             return
+        vcls = self.silo.vector_interfaces.get(msg.interface_name)
+        if vcls is not None:
+            # device-tier interface: the north-star interception — instead
+            # of a per-message activation turn, the call joins the vector
+            # runtime's current tick and runs inside a batched kernel
+            # (concurrent requests to one class coalesce automatically)
+            self._handle_vector_request(vcls, msg)
+            return
         try:
             activation = self.silo.catalog.get_or_create_activation(msg)
         except NonExistentActivationError as e:
@@ -74,6 +82,45 @@ class Dispatcher:
             self._reject_or_forward(msg, "activation deactivating")
             return
         self.receive_request(activation, msg)
+
+    def _handle_vector_request(self, vcls: type, msg: Message) -> None:
+        """Bridge a host-tier message onto the device tier (the
+        Orleans.Runtime.TpuDispatch provider of the north-star design):
+        key → slot, kwargs → batch lane, future resolves after the tick
+        that ran the kernel."""
+        rt = self.silo.vector
+        if msg.is_expired:
+            log.warning("dropping expired vector request %s", msg.method_name)
+            return
+        try:
+            args, kwargs = msg.body if msg.body is not None else ((), {})
+            if args:
+                raise TypeError(
+                    f"vector grain methods take keyword arguments only "
+                    f"(schema-bound); got {len(args)} positional")
+            key = msg.target_grain.key
+            if isinstance(key, int) and 0 <= key < 2**62:
+                key_hash = key
+            else:
+                key_hash = msg.target_grain.uniform_hash
+            fut = rt.call(vcls, key_hash, msg.method_name, **kwargs)
+        except Exception as e:  # noqa: BLE001 — schema/arg errors → caller
+            if msg.direction != Direction.ONE_WAY:
+                self.send_response(msg, make_error_response(msg, e))
+            return
+        if msg.direction == Direction.ONE_WAY:
+            return
+
+        def done(f: "asyncio.Future") -> None:
+            if f.cancelled():
+                return
+            exc = f.exception()
+            if exc is not None:
+                self.send_response(msg, make_error_response(msg, exc))
+            else:
+                self.send_response(msg, make_response(msg, f.result()))
+
+        fut.add_done_callback(done)
 
     def receive_request(self, activation: ActivationData, msg: Message) -> None:
         """ReceiveRequest:262 — gate, then run or enqueue."""

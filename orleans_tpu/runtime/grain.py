@@ -209,7 +209,14 @@ class StatefulGrain(Grain):
 
 def remote_methods(cls: type) -> dict[str, Callable]:
     """Public async methods of a grain class = its remote interface
-    (the codegen GrainInterfaceMap analog)."""
+    (the codegen GrainInterfaceMap analog). Device-tier grain classes
+    (dispatch.VectorGrain) expose their @actor_method handlers instead —
+    the same GrainRef proxies both tiers."""
+    from ..dispatch.vector_grain import ActorMethod, VectorGrain
+
+    if isinstance(cls, type) and issubclass(cls, VectorGrain):
+        return {name: m.fn for name in dir(cls)
+                if isinstance((m := getattr(cls, name)), ActorMethod)}
     out = {}
     for name, fn in inspect.getmembers(cls, inspect.isfunction):
         if name.startswith("_"):

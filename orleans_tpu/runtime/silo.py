@@ -239,6 +239,11 @@ class Silo:
         self.membership: Any = None       # installed by cluster join (L6)
         self.reminders: Any = None        # installed by reminder service (L11)
         self.transactions: Any = None     # installed by add_transactions (L11)
+        # device tier (installed by dispatch.add_vector_grains): interface
+        # name → VectorGrain class; matching requests bypass the catalog and
+        # join the vector runtime's tick (Dispatcher._handle_vector_request)
+        self.vector: Any = None
+        self.vector_interfaces: dict[str, type] = {}
         self.stream_providers: dict[str, Any] = {}
         self.status = "Created"
         self._lifecycle: list[tuple[int, Callable, Callable]] = []
