@@ -177,6 +177,10 @@ class VectorRuntime:
         self._flush_waiters: list[asyncio.Future] = []
         self.ticks = 0
         self.messages_processed = 0
+        # write-behind dirty tracking (off by default: marking 1M keys per
+        # bulk tick is pure overhead unless a storage bridge consumes it)
+        self.track_dirty = False
+        self._dirty: dict[type, list[np.ndarray]] = {}
 
     # ------------------------------------------------------------------
     def register(self, *grain_classes: type[VectorGrain],
@@ -233,8 +237,26 @@ class VectorRuntime:
         fut = loop.create_future()
         self.pending.setdefault((grain_class, method), []).append(
             _Pending(key_hash, shard, slot, fresh, args, fut))
+        if not m.read_only:
+            self._mark_dirty(grain_class, key_hash)
         self._schedule_tick(loop)
         return fut
+
+    # -- write-behind dirty tracking (consumed by storage.checkpoint) ----
+    def enable_dirty_tracking(self) -> None:
+        self.track_dirty = True
+
+    def _mark_dirty(self, cls: type, keys) -> None:
+        if self.track_dirty:
+            self._dirty.setdefault(cls, []).append(
+                np.atleast_1d(np.asarray(keys)))
+
+    def drain_dirty(self, cls: type) -> np.ndarray:
+        """Keys written since the last drain (deduplicated)."""
+        batches = self._dirty.pop(cls, None)
+        if not batches:
+            return np.zeros(0, dtype=np.int64)
+        return np.unique(np.concatenate(batches))
 
     def _schedule_tick(self, loop) -> None:
         if not self._tick_scheduled:
@@ -415,6 +437,7 @@ class VectorRuntime:
             tbl.state, d_slots, d_khash, d_fresh, d_valid, args_b)
         if not m.read_only:
             tbl.state = new_state
+            self._mark_dirty(grain_class, plan.keys)
         self.ticks += 1
         self.messages_processed += M
         if device_results:
@@ -473,6 +496,7 @@ class VectorRuntime:
             tbl.state, d_slots, d_khash, d_fresh, d_valid, args_b)
         if not m.read_only:
             tbl.state = new_state
+            self._mark_dirty(grain_class, plan.keys)
         self.ticks += K
         self.messages_processed += K * M
         if device_results:

@@ -26,18 +26,28 @@ __all__ = ["add_vector_grains"]
 def add_vector_grains(builder, *grain_classes: type[VectorGrain],
                       mesh=None, capacity_per_shard: int = 1024,
                       dense: dict[type, int] | None = None,
-                      options=None):
+                      options=None, storage=None,
+                      flush_period: float = 1.0):
     """Register device-tier grain classes on a SiloBuilder.
 
     ``dense``: optional {class: n} pre-provisioning keys 0..n-1 with the
     zero-shuffle dense mapping (the bulk regime). ``options``: a
     config.DispatchOptions group (overrides capacity_per_shard).
+
+    ``storage``: a GrainStorage provider enabling write-behind persistence
+    (the TpuGrainStorage of the north-star design): keys written by ticks
+    are tracked and their device rows flushed every ``flush_period``
+    seconds via storage.checkpoint.VectorStorageBridge, with a final flush
+    at silo stop. Resume stays per-actor-lazy: ``silo.vector_bridges[cls]
+    .load(keys)`` rehydrates rows (the virtual-actor rebuild contract).
     """
     for cls in grain_classes:
         if not issubclass(cls, VectorGrain):
             raise TypeError(f"{cls.__name__} is not a VectorGrain")
 
     def install(silo) -> None:
+        import asyncio
+
         if silo.vector is None:
             silo.vector = VectorRuntime(
                 mesh=mesh, capacity_per_shard=capacity_per_shard,
@@ -47,5 +57,51 @@ def add_vector_grains(builder, *grain_classes: type[VectorGrain],
             silo.vector_interfaces[cls.__name__] = cls
         for cls, n in (dense or {}).items():
             silo.vector.table(cls).ensure_dense(n)
+        if storage is None:
+            return
+
+        from ..storage.checkpoint import VectorStorageBridge
+
+        silo.vector.enable_dirty_tracking()
+        if not hasattr(silo, "vector_bridges"):
+            silo.vector_bridges = {}
+        for cls in grain_classes:
+            silo.vector_bridges[cls] = VectorStorageBridge(
+                silo.vector, cls, storage)
+        state = {"task": None}
+
+        async def flush_all() -> int:
+            n = 0
+            for cls in grain_classes:
+                keys = silo.vector.drain_dirty(cls)
+                if len(keys):
+                    n += await silo.vector_bridges[cls].flush(keys)
+            if n:
+                silo.stats.increment("vector.storage.flushed", n)
+            return n
+
+        async def flusher() -> None:
+            while True:
+                await asyncio.sleep(flush_period)
+                try:
+                    await flush_all()
+                except Exception:  # noqa: BLE001 — keep flushing next period
+                    import logging
+                    logging.getLogger("orleans.vector").exception(
+                        "write-behind flush failed")
+
+        def start() -> None:
+            state["task"] = asyncio.get_running_loop().create_task(flusher())
+
+        async def stop() -> None:
+            if state["task"] is not None:
+                state["task"].cancel()
+                state["task"] = None
+            await flush_all()  # final write-behind drain
+
+        from ..runtime.silo import ServiceLifecycleStage
+
+        silo.subscribe_lifecycle(
+            ServiceLifecycleStage.APPLICATION_SERVICES, start, stop)
 
     return builder.configure(install)
