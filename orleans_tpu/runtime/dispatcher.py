@@ -92,6 +92,17 @@ class Dispatcher:
         if msg.is_expired:
             log.warning("dropping expired vector request %s", msg.method_name)
             return
+        # single-owner routing: device-tier state for a key lives in ONE
+        # silo's table (the single-activation constraint); ring ownership
+        # decides which, exactly like directory partitioning. Forward-count
+        # bound prevents ping-pong during membership transitions.
+        owner = self.silo.locator.ring.owner(msg.target_grain.uniform_hash)
+        if owner is not None and owner != self.silo.silo_address and \
+                msg.forward_count < MAX_FORWARD_COUNT:
+            msg.forward_count += 1
+            msg.target_silo = owner
+            self.transmit(msg)
+            return
         try:
             args, kwargs = msg.body if msg.body is not None else ((), {})
             if args:

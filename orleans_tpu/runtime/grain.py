@@ -133,6 +133,13 @@ class Grain:
         """Called before the activation is destroyed."""
 
     # -- runtime services --------------------------------------------------
+    @property
+    def runtime(self):
+        """The hosting silo facade (``IGrainRuntime`` — Grain.cs's Runtime):
+        grants grains access to silo services, e.g. ``self.runtime.vector``
+        for the device tier."""
+        return self._activation.runtime
+
     def get_grain(self, grain_class: type, key: Any,
                   key_ext: str | None = None) -> "GrainRef":
         """``GrainFactory.GetGrain`` from inside a grain (Grain.cs:86-111)."""

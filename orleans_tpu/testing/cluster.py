@@ -110,6 +110,16 @@ class TestClusterBuilder:
         self._silo_configurators.append(add_transactions)
         return self
 
+    def with_vector_grains(self, *grain_classes: type,
+                           **kw) -> "TestClusterBuilder":
+        """Device-tier grains on every silo (dispatch.add_vector_grains):
+        each test silo gets its own VectorRuntime on the CPU mesh; gateway
+        affinity keeps one key's calls on one silo."""
+        from ..dispatch import add_vector_grains
+        self._silo_configurators.append(
+            lambda b: add_vector_grains(b, *grain_classes, **kw))
+        return self
+
     def configure_silo(self, fn: Callable[[SiloBuilder], Any]
                        ) -> "TestClusterBuilder":
         self._silo_configurators.append(fn)

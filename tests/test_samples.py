@@ -71,6 +71,14 @@ async def test_gpstracker_stream_push():
         assert (await cluster.grain(DeviceGrain, 3).last_position())["seq"] == 2
 
 
+async def test_presence_tpu_two_tier_sample():
+    """samples/presence_tpu.py end to end with a small population."""
+    import presence_tpu as pt
+
+    pt.N_PLAYERS, pt.N_GAMES = 512, 8
+    await pt.main()
+
+
 async def test_chirper_fan_out_and_graph_updates():
     cluster = TestClusterBuilder(3).add_grains(ChirperAccount).build()
     async with cluster:

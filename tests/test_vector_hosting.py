@@ -142,6 +142,30 @@ async def test_write_behind_persistence_and_resume():
         await silo2.stop()
 
 
+async def test_multi_silo_single_owner_routing():
+    """Device-tier keys have ONE owning silo (ring ownership), regardless
+    of which gateway/silo first receives the call — the single-activation
+    constraint for vector state."""
+    from orleans_tpu.testing import TestClusterBuilder
+
+    cluster = (TestClusterBuilder(3)
+               .add_grains(HostGrain)
+               .with_vector_grains(CounterVec, mesh=make_mesh(2),
+                                   capacity_per_shard=16)
+               .build())
+    async with cluster:
+        # calls from different host grains (placed on different silos)
+        # must all hit the same owning table for key 11
+        for i in range(6):
+            got = await cluster.grain(HostGrain, i).poke_vector(11, float(i))
+            assert got == i + 1  # strictly increasing → one table, one row
+        owners = [s for s in cluster.silos
+                  if s.vector.table(CounterVec).lookup(11) is not None
+                  or (0 <= 11 < s.vector.table(CounterVec).dense_n
+                      and s.vector.table(CounterVec).dense_active[11])]
+        assert len(owners) == 1
+
+
 async def test_non_vector_grains_unaffected():
     silo = _build()
     await silo.start()
