@@ -62,12 +62,12 @@ class MessagingOptions:
     max_request_processing_time: float = 60.0
 
     def validate(self) -> None:
+        # no cross-field rule tying max_request_processing_time to
+        # response_timeout: a stuck limit shorter than the caller timeout
+        # is a legitimate fast-abandon configuration (the activation is
+        # rebuilt while queued callers still wait within their timeout)
         _positive(self, "response_timeout", "max_enqueued_requests",
                   "max_request_processing_time")
-        if self.max_request_processing_time < self.response_timeout:
-            raise ConfigurationError(
-                "max_request_processing_time must be >= response_timeout "
-                "(a turn younger than the caller's timeout is not stuck)")
 
 
 @dataclass

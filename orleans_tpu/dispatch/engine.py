@@ -203,15 +203,21 @@ class VectorRuntime:
                 f"{cls.__name__} has no @actor_method {name!r}")
         return m
 
-    def actor(self, grain_class: type, key: int | str) -> VectorActorRef:
-        """Reference to one device-tier activation. Small non-negative int
-        keys map directly (enabling the dense regime); other keys hash."""
+    @staticmethod
+    def key_hash_for(key, uniform_hash: int) -> int:
+        """The one key→hash rule for both entry points (in-process
+        VectorActorRefs and the dispatcher's client bridge): small
+        non-negative int keys map directly (enabling the dense regime);
+        everything else uses the GrainId uniform hash."""
         if isinstance(key, int) and 0 <= key < 2**62:
-            kh = key
-        else:
-            from ..core.ids import GrainType
-            kh = GrainId.for_grain(
-                GrainType.of(grain_class.__name__), key).uniform_hash
+            return key
+        return uniform_hash
+
+    def actor(self, grain_class: type, key: int | str) -> VectorActorRef:
+        """Reference to one device-tier activation."""
+        from ..core.ids import GrainType
+        gid = GrainId.for_grain(GrainType.of(grain_class.__name__), key)
+        kh = self.key_hash_for(key, gid.uniform_hash)
         return VectorActorRef(self, grain_class, key, kh)
 
     # ------------------------------------------------------------------
@@ -237,8 +243,6 @@ class VectorRuntime:
         fut = loop.create_future()
         self.pending.setdefault((grain_class, method), []).append(
             _Pending(key_hash, shard, slot, fresh, args, fut))
-        if not m.read_only:
-            self._mark_dirty(grain_class, key_hash)
         self._schedule_tick(loop)
         return fut
 
@@ -345,6 +349,12 @@ class VectorRuntime:
             raise
         if not m.read_only:
             tbl.state = new_state
+            # dirty marks happen at state-apply time, not enqueue time: a
+            # write-behind flush between enqueue and tick would otherwise
+            # drain the key and persist the pre-write row forever
+            self._mark_dirty(cls, np.fromiter(
+                (p.key_hash for p in ready), dtype=np.int64,
+                count=len(ready)))
         # resolve futures from the result batch
         host = jax.tree_util.tree_map(np.asarray, results)
         for s, ps in enumerate(per_shard):

@@ -74,8 +74,16 @@ def add_vector_grains(builder, *grain_classes: type[VectorGrain],
             n = 0
             for cls in grain_classes:
                 keys = silo.vector.drain_dirty(cls)
-                if len(keys):
+                if not len(keys):
+                    continue
+                try:
                     n += await silo.vector_bridges[cls].flush(keys)
+                except BaseException:
+                    # failed or cancelled mid-flush: the keys are already
+                    # drained — re-mark them so the next period (or the
+                    # final stop() drain) retries instead of losing them
+                    silo.vector._mark_dirty(cls, keys)
+                    raise
             if n:
                 silo.stats.increment("vector.storage.flushed", n)
             return n

@@ -98,11 +98,15 @@ class StepTimer:
     @contextlib.contextmanager
     def step(self) -> Iterator[None]:
         t0 = time.perf_counter()
-        with jax.profiler.TraceAnnotation(self.name):
-            yield
-        dt = time.perf_counter() - t0
-        self.stats.observe(f"{self.name}.seconds", dt)
-        if dt > self.warn_threshold:
-            self.stats.increment(f"{self.name}.slow")
-            log.warning("%s took %.3fs (threshold %.3fs)", self.name, dt,
-                        self.warn_threshold)
+        try:
+            with jax.profiler.TraceAnnotation(self.name):
+                yield
+        finally:
+            # record failed steps too — crashed/timed-out ticks are the
+            # most important ones in the latency telemetry
+            dt = time.perf_counter() - t0
+            self.stats.observe(f"{self.name}.seconds", dt)
+            if dt > self.warn_threshold:
+                self.stats.increment(f"{self.name}.slow")
+                log.warning("%s took %.3fs (threshold %.3fs)", self.name,
+                            dt, self.warn_threshold)

@@ -171,13 +171,11 @@ class Catalog:
             return
         act.state = ActivationState.DEACTIVATING
         act.stop_timers()
-        if not stuck:
+        if not stuck:  # stuck: no drain wait and no hook — both would hang
             # wait for running turns to drain (bounded)
             deadline = time.monotonic() + self.silo.config.deactivation_timeout
             while act.running and time.monotonic() < deadline:
                 await asyncio.sleep(0.005)
-        if not stuck:
-            # a stuck instance's hook would hang too — skip it
             try:
                 hook = getattr(act.grain_instance, "on_deactivate", None)
                 if hook is not None:

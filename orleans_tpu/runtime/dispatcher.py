@@ -97,8 +97,15 @@ class Dispatcher:
         # decides which, exactly like directory partitioning. Forward-count
         # bound prevents ping-pong during membership transitions.
         owner = self.silo.locator.ring.owner(msg.target_grain.uniform_hash)
-        if owner is not None and owner != self.silo.silo_address and \
-                msg.forward_count < MAX_FORWARD_COUNT:
+        if owner is not None and owner != self.silo.silo_address:
+            if msg.forward_count >= MAX_FORWARD_COUNT:
+                # never execute on a non-owner: that would mint a second
+                # divergent copy of the key's device state. Reject so the
+                # caller retries against a converged membership view.
+                self._reject(msg, RejectionType.TRANSIENT,
+                             f"vector owner unresolved after "
+                             f"{msg.forward_count} forwards")
+                return
             msg.forward_count += 1
             msg.target_silo = owner
             self.transmit(msg)
@@ -109,11 +116,8 @@ class Dispatcher:
                 raise TypeError(
                     f"vector grain methods take keyword arguments only "
                     f"(schema-bound); got {len(args)} positional")
-            key = msg.target_grain.key
-            if isinstance(key, int) and 0 <= key < 2**62:
-                key_hash = key
-            else:
-                key_hash = msg.target_grain.uniform_hash
+            key_hash = rt.key_hash_for(msg.target_grain.key,
+                                       msg.target_grain.uniform_hash)
             fut = rt.call(vcls, key_hash, msg.method_name, **kwargs)
         except Exception as e:  # noqa: BLE001 — schema/arg errors → caller
             if msg.direction != Direction.ONE_WAY:
