@@ -1,4 +1,9 @@
 """Distributed grain directory + consistent rings (reference L5)."""
 
 from .locator import DistributedLocator  # noqa: F401
-from .ring import ConsistentRing, RingRange, VirtualBucketRing  # noqa: F401
+from .ring import (  # noqa: F401
+    ConsistentRing,
+    EquallyDividedRing,
+    RingRange,
+    VirtualBucketRing,
+)

@@ -16,7 +16,8 @@ from typing import Callable, Iterable
 
 from ..core.ids import SiloAddress, stable_hash64
 
-__all__ = ["ConsistentRing", "VirtualBucketRing", "RingRange"]
+__all__ = ["ConsistentRing", "VirtualBucketRing", "EquallyDividedRing",
+           "RingRange"]
 
 HASH_SPACE = 1 << 63
 
@@ -140,3 +141,50 @@ class VirtualBucketRing:
 
     def owns(self, silo: SiloAddress, key_hash: int) -> bool:
         return self.owner(key_hash) == silo
+
+
+class EquallyDividedRing:
+    """Exact 1/N split of the hash space over the sorted alive set
+    (EquallyDividedRangeRingProvider.cs:10): deterministic equal ranges —
+    used by grain services that want uniform load rather than
+    hash-positioned arcs. Ranges are derived, not point-based: silo i of N
+    (sorted by address) owns [i*SPACE/N, (i+1)*SPACE/N)."""
+
+    def __init__(self, silos: Iterable[SiloAddress] = ()):
+        self._silos: list[SiloAddress] = []
+        self.update(silos)
+
+    def update(self, silos: Iterable[SiloAddress]) -> None:
+        self._silos = sorted(set(silos),
+                             key=lambda s: (s.endpoint, s.generation))
+
+    @property
+    def silos(self) -> list[SiloAddress]:
+        return list(self._silos)
+
+    def _bounds(self, i: int) -> tuple[int, int]:
+        n = len(self._silos)
+        return (HASH_SPACE * i) // n, (HASH_SPACE * (i + 1)) // n
+
+    def owner(self, key_hash: int) -> SiloAddress | None:
+        n = len(self._silos)
+        if not n:
+            return None
+        k = key_hash % HASH_SPACE
+        # invert the exact integer split: candidate index then adjust
+        i = min((k * n) // HASH_SPACE, n - 1)
+        lo, hi = self._bounds(i)
+        if k < lo:
+            i -= 1
+        elif k >= hi:
+            i += 1
+        return self._silos[i]
+
+    def my_range(self, silo: SiloAddress) -> RingRange | None:
+        try:
+            i = self._silos.index(silo)
+        except ValueError:
+            return None
+        lo, hi = self._bounds(i)
+        # RingRange is (begin, end]: shift the half-open [lo, hi) by -1
+        return RingRange((lo - 1) % HASH_SPACE, (hi - 1) % HASH_SPACE)

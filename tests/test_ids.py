@@ -116,3 +116,47 @@ def test_uuid_int_key_supported():
     g = GrainId.for_grain(t, big)
     assert g.uniform_hash >= 0
     assert stable_hash64(big) == stable_hash64(big)
+
+
+class TestEquallyDividedRing:
+    """EquallyDividedRangeRingProvider.cs:10 — exact 1/N hash-space split."""
+
+    def _silos(self, n):
+        from orleans_tpu.core.ids import SiloAddress
+        return [SiloAddress(f"h{i}", 1000 + i, i) for i in range(n)]
+
+    def test_every_point_has_exactly_one_owner(self):
+        from orleans_tpu.directory.ring import HASH_SPACE, EquallyDividedRing
+        silos = self._silos(3)
+        ring = EquallyDividedRing(silos)
+        for k in (0, 1, HASH_SPACE // 3, HASH_SPACE // 2, HASH_SPACE - 1):
+            owner = ring.owner(k)
+            assert owner in silos
+            assert ring.my_range(owner).contains(k), k
+
+    def test_ranges_partition_the_space_equally(self):
+        from orleans_tpu.directory.ring import HASH_SPACE, EquallyDividedRing
+        silos = self._silos(4)
+        ring = EquallyDividedRing(silos)
+        sizes = [ring.my_range(s).size for s in silos]
+        assert sum(sizes) == HASH_SPACE
+        assert max(sizes) - min(sizes) <= 1  # exact equal division
+
+    def test_membership_change_rebalances(self):
+        from orleans_tpu.directory.ring import EquallyDividedRing
+        silos = self._silos(2)
+        ring = EquallyDividedRing(silos)
+        before = ring.owner(12345)
+        ring.update(self._silos(5))
+        assert len(ring.silos) == 5
+        assert ring.owner(12345) is not None
+        assert ring.my_range(before) is not None  # still a member
+
+    def test_empty_and_single(self):
+        from orleans_tpu.directory.ring import EquallyDividedRing
+        ring = EquallyDividedRing()
+        assert ring.owner(7) is None
+        one = self._silos(1)
+        ring.update(one)
+        assert ring.owner(7) == one[0]
+        assert ring.my_range(one[0]).size > 0
