@@ -266,6 +266,12 @@ class Silo:
     # ------------------------------------------------------------------
     async def start(self) -> None:
         """Staged startup (Silo.StartAsync:267; stages :377-564)."""
+        from dataclasses import fields as _fields
+
+        # options dump at boot (Runtime/OptionsLogger/)
+        for f in _fields(self.config):
+            log.info("SiloConfig.%s = %r", f.name,
+                     getattr(self.config, f.name))
         self.status = "Joining"
         self.message_center.start()          # RuntimeServices
         self.catalog.start()
