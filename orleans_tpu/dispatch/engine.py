@@ -551,6 +551,86 @@ class VectorRuntime:
         return results
 
     # ------------------------------------------------------------------
+    # Device-tier actor→actor messaging (the ICI fabric as an engine API)
+    # ------------------------------------------------------------------
+    def route(self, dest_class: type, dest_keys, payload: dict, valid,
+              capacity: int = 256):
+        """Route per-message payloads to the shards owning ``dest_keys``
+        over the tick exchange (ONE all_to_all on the silo axis —
+        parallel.transport; the reference's silo-to-silo TCP fabric,
+        SURVEY §2.4 "Point-to-point messaging backend").
+
+        dest_keys/valid: [n_shards, B] device arrays (dense keys of
+        ``dest_class``); payload: dict of [n_shards, B, ...]. Returns
+        (recv_keys, recv_payload, recv_valid, drops) with recv lanes
+        [n_shards, n_shards*capacity]. Overflow beyond ``capacity`` lanes
+        per (src, dst) pair is dropped and counted (overload shedding —
+        the host re-routes next tick).
+        """
+        from ..parallel.transport import build_exchange
+
+        if "__key__" in payload:
+            raise ValueError("payload field name '__key__' is reserved")
+        tbl = self.table(dest_class)
+        per = max(tbl.dense_per_shard, 1)
+        key = ("exchange", tbl.n_shards, capacity)
+        ex = self._kernel_cache.get(key)
+        if ex is None:
+            ex = build_exchange(self.mesh, capacity=capacity)
+            self._kernel_cache[key] = ex
+        dest_shard = (dest_keys // per).astype(jnp.int32)
+        recv, recv_valid, drops = ex(
+            dest_shard, valid, {"__key__": dest_keys, **payload})
+        recv_keys = recv.pop("__key__")
+        return recv_keys, recv, recv_valid, drops
+
+    def apply_received(self, dest_class: type, method: str, recv_keys,
+                       recv_valid, args: dict):
+        """Apply routed messages as invocations on ``dest_class`` — the
+        receive half of a cross-shard actor call, entirely on device.
+
+        Turn semantics under fan-in: at most one message per actor per
+        tick. Duplicate same-actor deliveries within this batch are masked
+        off ON DEVICE (first occurrence wins — deterministic lane order)
+        and reported in the returned ``applied`` mask so the caller can
+        re-route them next tick (the mailbox-defer analog). Requires the
+        dest table's dense regime (keys pre-provisioned + activated; use
+        fan-in reductions — ops.segment_sum — for aggregation patterns
+        instead of high-duplication apply).
+
+        Returns (results, applied): results [n_shards, L, ...] per-lane
+        method results (junk on unapplied lanes), applied [n_shards, L].
+        """
+        from ..ops.route import rank_dense_keys
+
+        tbl = self.table(dest_class)
+        m = self.method_of(dest_class, method)
+        per = max(tbl.dense_per_shard, 1)
+        n, L = recv_keys.shape
+
+        def local(keys, ok):
+            k, v = keys[0], ok[0]
+            slot = jnp.where(v, k % per, tbl.capacity)
+            # dedup: only the first delivery per actor applies this tick
+            first = rank_dense_keys(jnp.where(v, slot,
+                                              tbl.capacity + 1)) == 0
+            applied = v & first
+            slot = jnp.where(applied, slot, tbl.capacity)
+            return slot[None], applied[None], \
+                (k & 0x7FFFFFFF).astype(jnp.int32)[None]
+
+        if tbl.n_shards > 1:
+            spec = P(SILO_AXIS)
+            local = jax.shard_map(
+                local, mesh=self.mesh, in_specs=(spec, spec),
+                out_specs=(spec, spec, spec), check_vma=False)
+        slots, applied, khash = jax.jit(local)(recv_keys, recv_valid)
+        fresh = jnp.zeros_like(applied)
+        results = self.call_batch_device(dest_class, method, slots, khash,
+                                         fresh, applied, args)
+        return results, applied
+
+    # ------------------------------------------------------------------
     # Kernel construction
     # ------------------------------------------------------------------
     def _kernel(self, cls: type, method: str, B: int,
