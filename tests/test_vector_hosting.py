@@ -166,6 +166,38 @@ async def test_multi_silo_single_owner_routing():
         assert len(owners) == 1
 
 
+async def test_vector_hosting_over_tcp(tmp_path):
+    """Device-tier grains reachable from an out-of-process-style client
+    over real TCP gateways (the full remote path: GatewayClient → socket
+    fabric → dispatcher vector bridge → kernel tick → response)."""
+    from orleans_tpu.membership import FileMembershipTable, join_cluster
+    from orleans_tpu.runtime import GatewayClient, SocketFabric
+
+    table = FileMembershipTable(str(tmp_path / "mbr.json"))
+    fabric = SocketFabric()
+    b = (SiloBuilder().with_name("vec-tcp").with_fabric(fabric)
+         .add_grains(HostGrain).with_config(response_timeout=5.0))
+    add_vector_grains(b, CounterVec, mesh=make_mesh(8),
+                      capacity_per_shard=16)
+    silo = b.build()
+    join_cluster(silo, table)
+    await silo.start()
+    client = None
+    try:
+        gw = f"127.0.0.1:{silo.silo_address.port}"
+        client = await GatewayClient([gw]).connect()
+        g = client.get_grain(CounterVec, 3)
+        assert int(await g.add(x=1.0)) == 1
+        assert int(await g.add(x=2.0)) == 2
+        out = await asyncio.gather(*(
+            client.get_grain(CounterVec, k).add(x=0.5) for k in range(10)))
+        assert all(int(v) >= 1 for v in out)
+    finally:
+        if client is not None:
+            await client.close_async()
+        await silo.stop()
+
+
 async def test_non_vector_grains_unaffected():
     silo = _build()
     await silo.start()
