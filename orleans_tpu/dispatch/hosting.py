@@ -27,7 +27,10 @@ def add_vector_grains(builder, *grain_classes: type[VectorGrain],
                       mesh=None, capacity_per_shard: int = 1024,
                       dense: dict[type, int] | None = None,
                       options=None, storage=None,
-                      flush_period: float = 1.0):
+                      flush_period: float = 1.0,
+                      checkpoint_dir: str | None = None,
+                      checkpoint_period: float = 30.0,
+                      checkpoint_keep: int = 3):
     """Register device-tier grain classes on a SiloBuilder.
 
     ``dense``: optional {class: n} pre-provisioning keys 0..n-1 with the
@@ -40,6 +43,11 @@ def add_vector_grains(builder, *grain_classes: type[VectorGrain],
     seconds via storage.checkpoint.VectorStorageBridge, with a final flush
     at silo stop. Resume stays per-actor-lazy: ``silo.vector_bridges[cls]
     .load(keys)`` rehydrates rows (the virtual-actor rebuild contract).
+
+    ``checkpoint_dir``: enables periodic whole-table orbax snapshots
+    (storage.checkpoint.VectorCheckpointer) every ``checkpoint_period``
+    seconds, keeping ``checkpoint_keep`` — the whole-silo resume path. If
+    a checkpoint exists at start, the silo restores it before serving.
     """
     for cls in grain_classes:
         if not issubclass(cls, VectorGrain):
@@ -57,6 +65,8 @@ def add_vector_grains(builder, *grain_classes: type[VectorGrain],
             silo.vector_interfaces[cls.__name__] = cls
         for cls, n in (dense or {}).items():
             silo.vector.table(cls).ensure_dense(n)
+        if checkpoint_dir is not None:
+            _install_checkpoints(silo)
         if storage is None:
             return
 
@@ -108,6 +118,54 @@ def add_vector_grains(builder, *grain_classes: type[VectorGrain],
             await flush_all()  # final write-behind drain
 
         from ..runtime.silo import ServiceLifecycleStage
+
+        silo.subscribe_lifecycle(
+            ServiceLifecycleStage.APPLICATION_SERVICES, start, stop)
+
+    def _install_checkpoints(silo) -> None:
+        import asyncio
+
+        from ..runtime.silo import ServiceLifecycleStage
+        from ..storage.checkpoint import VectorCheckpointer
+
+        ckpt = VectorCheckpointer(silo.vector, checkpoint_dir,
+                                  max_to_keep=checkpoint_keep)
+        silo.vector_checkpointer = ckpt
+        state = {"task": None, "step": 0}
+
+        async def snapshotter() -> None:
+            while True:
+                await asyncio.sleep(checkpoint_period)
+                state["step"] += 1
+                try:
+                    ckpt.save(state["step"])
+                    silo.stats.increment("vector.checkpoints")
+                except Exception:  # noqa: BLE001 — next period retries
+                    import logging
+                    logging.getLogger("orleans.vector").exception(
+                        "table checkpoint failed")
+
+        def start() -> None:
+            latest = ckpt.latest_step()
+            if latest is not None:
+                ckpt.restore(latest)  # whole-silo resume before serving
+                state["step"] = latest
+            state["task"] = asyncio.get_running_loop().create_task(
+                snapshotter())
+
+        async def stop() -> None:
+            if state["task"] is not None:
+                state["task"].cancel()
+                state["task"] = None
+            ckpt.wait()  # let an in-flight periodic write settle
+            state["step"] += 1
+            ckpt.save(state["step"])  # final snapshot
+            ckpt.wait()
+            # no ckpt.close(): orbax's manager shutdown tears down an
+            # executor shared across managers in this process, breaking a
+            # successor silo's checkpointer (restart-in-process is exactly
+            # the TestCluster/resume scenario); wait() has already settled
+            # all writes
 
         silo.subscribe_lifecycle(
             ServiceLifecycleStage.APPLICATION_SERVICES, start, stop)

@@ -13,8 +13,8 @@ survives restart even without a full table snapshot.
 
 Two recovery paths:
 * **whole-silo resume** — ``VectorCheckpointer.save(step)`` every N ticks
-  (async: device→host copy overlaps serving; orbax writes in background);
-  after restart ``restore()`` rebuilds every table + its host bookkeeping.
+  (synchronous D2H copy + write — see __init__ on why not async); after
+  restart ``restore()`` rebuilds every table + its host bookkeeping.
 * **per-actor lazy resume** — ``VectorStorageBridge.flush(keys)`` write-
   behind after ticks; on re-activation ``load(keys)`` scatters stored rows
   back into the table (the virtual-actor guarantee: the next call finds
@@ -73,18 +73,29 @@ class VectorCheckpointer:
 
         self._ocp = ocp
         self.runtime = runtime
+        # synchronous writes: the D2H copy (donation-safety, _state_tree)
+        # is the dominant sync cost anyway, and orbax's async writer
+        # shares process-global executors that race across manager
+        # restarts (the in-process resume scenario TestCluster exercises)
         self.manager = ocp.CheckpointManager(
             directory,
-            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep))
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                enable_async_checkpointing=False))
 
     def _state_tree(self) -> dict:
-        return {cls.__name__: dict(tbl.state)
+        # host copies, not device arrays: tick kernels DONATE the state
+        # buffers (in-place updates), so a device array handed to orbax's
+        # async writer can be deleted mid-save by the very next tick. The
+        # D2H copy here is the synchronous part; the file write stays async.
+        return {cls.__name__:
+                {f: np.asarray(a) for f, a in tbl.state.items()}
                 for cls, tbl in self.runtime.tables.items()}
 
     def save(self, step: int) -> None:
-        """Enqueue an async snapshot (returns before the write completes;
-        orbax copies device→host, then writes in a background thread —
-        serving continues)."""
+        """Snapshot: synchronous device→host copy (donation-safe, see
+        _state_tree) + synchronous write."""
+        self.manager.wait_until_finished()
         ocp = self._ocp
         meta = {cls.__name__: _table_meta(tbl)
                 for cls, tbl in self.runtime.tables.items()}

@@ -166,6 +166,39 @@ async def test_multi_silo_single_owner_routing():
         assert len(owners) == 1
 
 
+async def test_scheduled_checkpoints_and_whole_silo_resume(tmp_path):
+    """checkpoint_dir= schedules orbax table snapshots; a restarted silo
+    restores the latest before serving (whole-silo resume path)."""
+    def build():
+        b = SiloBuilder().with_name("ckpt").add_grains(HostGrain)
+        add_vector_grains(b, CounterVec, mesh=make_mesh(8),
+                          capacity_per_shard=32,
+                          checkpoint_dir=str(tmp_path / "ck"),
+                          checkpoint_period=0.1)
+        return b.build()
+
+    silo = build()
+    await silo.start()
+    client = await ClusterClient(silo.fabric).connect()
+    try:
+        for _ in range(3):
+            await client.get_grain(CounterVec, 8).add(x=1.0)
+        await asyncio.sleep(0.25)  # ≥1 scheduled snapshot
+        assert silo.stats.get("vector.checkpoints") >= 1
+    finally:
+        await client.close_async()
+        await silo.stop()  # final snapshot
+
+    silo2 = build()
+    await silo2.start()  # restores latest checkpoint before serving
+    client2 = await ClusterClient(silo2.fabric).connect()
+    try:
+        assert int(await client2.get_grain(CounterVec, 8).add(x=2.0)) == 4
+    finally:
+        await client2.close_async()
+        await silo2.stop()
+
+
 async def test_vector_hosting_over_tcp(tmp_path):
     """Device-tier grains reachable from an out-of-process-style client
     over real TCP gateways (the full remote path: GatewayClient → socket
