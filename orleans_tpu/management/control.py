@@ -26,6 +26,20 @@ class SiloControl:
     def __init__(self, silo: "Silo"):
         self.silo = silo
 
+    def _vector_stats(self) -> dict:
+        """Device-tier runtime stats (no reference analog — the vector
+        tier's management lens): per-class activation counts + tick/message
+        totals."""
+        rt = self.silo.vector
+        if rt is None:
+            return {}
+        return {
+            "ticks": rt.ticks,
+            "messages_processed": rt.messages_processed,
+            "classes": {cls.__name__: tbl.active_count()
+                        for cls, tbl in rt.tables.items()},
+        }
+
     async def ctl_runtime_stats(self) -> dict:
         """Per-silo stats snapshot (SiloRuntimeStatistics)."""
         return {
@@ -33,19 +47,27 @@ class SiloControl:
             "status": self.silo.status,
             "activation_count": self.silo.catalog.activation_count(),
             "stats": self.silo.stats.snapshot(),
+            "vector": self._vector_stats(),
         }
 
     async def ctl_activation_count(self) -> int:
-        return self.silo.catalog.activation_count()
+        n = self.silo.catalog.activation_count()
+        if self.silo.vector is not None:
+            n += sum(t.active_count()
+                     for t in self.silo.vector.tables.values())
+        return n
 
     async def ctl_grain_stats(self) -> dict[str, int]:
-        """Activation count per grain class (GetSimpleGrainStatistics)."""
+        """Activation count per grain class (GetSimpleGrainStatistics) —
+        both tiers."""
         counts: dict[str, int] = {}
         for act in self.silo.catalog.by_activation.values():
             if act.grain_id.is_system_target():
                 continue  # app grains only, matching GetSimpleGrainStatistics
             name = act.grain_class.__name__ if act.grain_class else "?"
             counts[name] = counts.get(name, 0) + 1
+        for cls, n in self._vector_stats().get("classes", {}).items():
+            counts[cls] = counts.get(cls, 0) + n
         return counts
 
     async def ctl_force_collection(self, age_seconds: float = 0.0) -> int:

@@ -166,6 +166,33 @@ async def test_multi_silo_single_owner_routing():
         assert len(owners) == 1
 
 
+async def test_management_sees_both_tiers():
+    from orleans_tpu.management import ManagementGrain, add_management
+
+    b = SiloBuilder().with_name("mgmt").add_grains(HostGrain)
+    add_vector_grains(b, CounterVec, mesh=make_mesh(8),
+                      capacity_per_shard=16)
+    add_management(b)
+    silo = b.build()
+    await silo.start()
+    client = await ClusterClient(silo.fabric).connect()
+    try:
+        await client.get_grain(CounterVec, 1).add(x=1.0)
+        await client.get_grain(CounterVec, 2).add(x=1.0)
+        await client.get_grain(HostGrain, 0).poke_vector(3, 1.0)
+        mgmt = client.get_grain(ManagementGrain, 0)
+        stats = await mgmt.get_simple_grain_statistics()
+        assert stats.get("CounterVec", 0) == 3
+        assert stats.get("HostGrain", 0) == 1
+        rs = await mgmt.get_runtime_statistics()
+        vec = next(iter(rs.values()))["vector"]
+        assert vec["messages_processed"] >= 3
+        assert vec["classes"]["CounterVec"] == 3
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
 async def test_scheduled_checkpoints_and_whole_silo_resume(tmp_path):
     """checkpoint_dir= schedules orbax table snapshots; a restarted silo
     restores the latest before serving (whole-silo resume path)."""
