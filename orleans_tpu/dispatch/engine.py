@@ -600,6 +600,12 @@ class VectorRuntime:
 
         Returns (results, applied): results [n_shards, L, ...] per-lane
         method results (junk on unapplied lanes), applied [n_shards, L].
+
+        Write-behind dirty tracking does NOT see exchange-applied writes
+        (the applied keys live on device; syncing them to host every tick
+        would defeat the all-device pipeline) — device-resident message
+        flows should persist via scheduled table checkpoints
+        (``add_vector_grains(checkpoint_dir=...)``) instead.
         """
         from ..ops.route import rank_dense_keys
 
