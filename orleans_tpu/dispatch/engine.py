@@ -177,6 +177,7 @@ class VectorRuntime:
         self._flush_waiters: list[asyncio.Future] = []
         self.ticks = 0
         self.messages_processed = 0
+        self.exchange_lanes = 0  # device-valid lanes (see call_batch_device)
         # write-behind dirty tracking (off by default: marking 1M keys per
         # bulk tick is pure overhead unless a storage bridge consumes it)
         self.track_dirty = False
@@ -547,7 +548,13 @@ class VectorRuntime:
         if not m.read_only:
             tbl.state = new_state
         self.ticks += 1
-        self.messages_processed += int(valid_b.shape[0] * B)
+        if isinstance(valid_b, np.ndarray):
+            self.messages_processed += int(valid_b.sum())
+        else:
+            # valid mask lives on device (exchange flows): counting it
+            # would force a sync — track lanes separately so
+            # messages_processed stays an honest delivered count
+            self.exchange_lanes += int(valid_b.shape[0] * B)
         return results
 
     # ------------------------------------------------------------------
@@ -610,9 +617,8 @@ class VectorRuntime:
         from ..ops.route import rank_dense_keys
 
         tbl = self.table(dest_class)
-        m = self.method_of(dest_class, method)
+        self.method_of(dest_class, method)  # validate the method exists
         per = max(tbl.dense_per_shard, 1)
-        n, L = recv_keys.shape
 
         def local(keys, ok):
             k, v = keys[0], ok[0]

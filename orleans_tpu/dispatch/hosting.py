@@ -101,6 +101,8 @@ def add_vector_grains(builder, *grain_classes: type[VectorGrain],
         async def flusher() -> None:
             while True:
                 await asyncio.sleep(flush_period)
+                if silo.status in ("Dead", "Stopped"):
+                    return  # kill skips lifecycle stops; die with the silo
                 try:
                     await flush_all()
                 except Exception:  # noqa: BLE001 — keep flushing next period
@@ -131,14 +133,31 @@ def add_vector_grains(builder, *grain_classes: type[VectorGrain],
         ckpt = VectorCheckpointer(silo.vector, checkpoint_dir,
                                   max_to_keep=checkpoint_keep)
         silo.vector_checkpointer = ckpt
-        state = {"task": None, "step": 0}
+        state = {"task": None, "step": 0, "quit": None}
 
         async def snapshotter() -> None:
+            # cooperative shutdown (never cancelled): orbax managers are
+            # not thread-safe, so a write must never overlap the final
+            # stop() save — stop sets `quit` and AWAITS this task, which
+            # finishes any in-flight write before exiting
             while True:
-                await asyncio.sleep(checkpoint_period)
-                state["step"] += 1
                 try:
-                    ckpt.save(state["step"])
+                    await asyncio.wait_for(state["quit"].wait(),
+                                           timeout=checkpoint_period)
+                    return  # graceful stop requested
+                except asyncio.TimeoutError:
+                    pass
+                if silo.status in ("Dead", "Stopped", "ShuttingDown"):
+                    return  # killed silos must not overwrite the successor's
+                            # checkpoints (kill skips lifecycle stops)
+                try:
+                    # capture on the loop (donation safety), write in a
+                    # thread — a multi-GB table write must not stall
+                    # membership probes and gateway traffic
+                    state["step"] += 1
+                    captured = ckpt.capture()
+                    await asyncio.to_thread(ckpt.write, state["step"],
+                                            captured)
                     silo.stats.increment("vector.checkpoints")
                 except Exception:  # noqa: BLE001 — next period retries
                     import logging
@@ -146,6 +165,7 @@ def add_vector_grains(builder, *grain_classes: type[VectorGrain],
                         "table checkpoint failed")
 
         def start() -> None:
+            state["quit"] = asyncio.Event()
             latest = ckpt.latest_step()
             if latest is not None:
                 ckpt.restore(latest)  # whole-silo resume before serving
@@ -154,10 +174,10 @@ def add_vector_grains(builder, *grain_classes: type[VectorGrain],
                 snapshotter())
 
         async def stop() -> None:
-            if state["task"] is not None:
-                state["task"].cancel()
-                state["task"] = None
-            ckpt.wait()  # let an in-flight periodic write settle
+            task, state["task"] = state["task"], None
+            if task is not None:
+                state["quit"].set()
+                await task  # in-flight write completes before the final save
             state["step"] += 1
             ckpt.save(state["step"])  # final snapshot
             ckpt.wait()

@@ -86,7 +86,10 @@ class ShardedActorTable:
         return self.capacity
 
     def active_count(self) -> int:
-        return len(self.key_to_slot) + self.dense_n
+        """Live activations: hashed slots + dense keys actually touched
+        (dense pre-provisioning reserves keyspace; activation is first
+        touch — the dense_active bitmap)."""
+        return len(self.key_to_slot) + int(self.dense_active.sum())
 
     # -- dense regime -----------------------------------------------------
     def ensure_dense(self, n: int) -> None:

@@ -36,6 +36,7 @@ class SiloControl:
         return {
             "ticks": rt.ticks,
             "messages_processed": rt.messages_processed,
+            "exchange_lanes": rt.exchange_lanes,
             "classes": {cls.__name__: tbl.active_count()
                         for cls, tbl in rt.tables.items()},
         }

@@ -85,23 +85,36 @@ class VectorCheckpointer:
 
     def _state_tree(self) -> dict:
         # host copies, not device arrays: tick kernels DONATE the state
-        # buffers (in-place updates), so a device array handed to orbax's
-        # async writer can be deleted mid-save by the very next tick. The
-        # D2H copy here is the synchronous part; the file write stays async.
+        # buffers (in-place updates), so a device array handed to the
+        # writer can be deleted mid-save by the very next tick. The D2H
+        # copy is the part that must happen before another tick runs.
         return {cls.__name__:
                 {f: np.asarray(a) for f, a in tbl.state.items()}
                 for cls, tbl in self.runtime.tables.items()}
 
-    def save(self, step: int) -> None:
-        """Snapshot: synchronous device→host copy (donation-safe, see
-        _state_tree) + synchronous write."""
-        self.manager.wait_until_finished()
-        ocp = self._ocp
+    def capture(self) -> tuple[dict, dict]:
+        """Donation-safe snapshot (synchronous D2H copy + bookkeeping).
+        Must run on the tick thread/loop so no kernel donates the buffers
+        mid-copy; the returned tree is plain numpy — write it from any
+        thread."""
+        state = self._state_tree()
         meta = {cls.__name__: _table_meta(tbl)
                 for cls, tbl in self.runtime.tables.items()}
+        return state, meta
+
+    def write(self, step: int, captured: tuple[dict, dict]) -> None:
+        """Persist a captured snapshot (thread-safe; hosting runs this in
+        a worker thread so the silo event loop keeps serving)."""
+        ocp = self._ocp
+        state, meta = captured
+        self.manager.wait_until_finished()
         self.manager.save(step, args=ocp.args.Composite(
-            state=ocp.args.StandardSave(self._state_tree()),
+            state=ocp.args.StandardSave(state),
             meta=ocp.args.JsonSave(meta)))
+
+    def save(self, step: int) -> None:
+        """capture() + write() in one synchronous call."""
+        self.write(step, self.capture())
 
     def wait(self) -> None:
         self.manager.wait_until_finished()
