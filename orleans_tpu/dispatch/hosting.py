@@ -80,18 +80,20 @@ def add_vector_grains(builder, *grain_classes: type[VectorGrain],
                 silo.vector, cls, storage)
         state = {"task": None}
 
-        async def flush_all() -> int:
+        async def flush_all(strict: bool = False) -> int:
             n = 0
             for cls in grain_classes:
                 keys = silo.vector.drain_dirty(cls)
                 if not len(keys):
                     continue
                 try:
-                    n += await silo.vector_bridges[cls].flush(keys)
-                except BaseException:
-                    # failed or cancelled mid-flush: the keys are already
-                    # drained — re-mark them so the next period (or the
-                    # final stop() drain) retries instead of losing them
+                    n += await silo.vector_bridges[cls].flush(
+                        keys, strict=strict)
+                except asyncio.CancelledError:
+                    # cancelled mid-flush: the keys are already drained —
+                    # re-mark them so the final stop() drain retries
+                    # instead of losing them (per-key storage failures
+                    # are re-marked inside flush itself)
                     silo.vector._mark_dirty(cls, keys)
                     raise
             if n:
@@ -114,10 +116,17 @@ def add_vector_grains(builder, *grain_classes: type[VectorGrain],
             state["task"] = asyncio.get_running_loop().create_task(flusher())
 
         async def stop() -> None:
-            if state["task"] is not None:
-                state["task"].cancel()
-                state["task"] = None
-            await flush_all()  # final write-behind drain
+            task, state["task"] = state["task"], None
+            if task is not None:
+                task.cancel()
+                # await the cancelled flusher so its BaseException re-mark
+                # lands BEFORE the final drain below — otherwise keys a
+                # mid-flight flush had already drained would be re-marked
+                # after stop's pass and silently never persisted
+                await asyncio.gather(task, return_exceptions=True)
+            # final write-behind drain: strict — a failure here has no
+            # next period to retry, so it must surface out of stop()
+            await flush_all(strict=True)
 
         from ..runtime.silo import ServiceLifecycleStage
 

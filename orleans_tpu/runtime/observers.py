@@ -100,6 +100,7 @@ class ObserverHost:
         self._address_of = client_address_of
         self._observers: dict[int, Any] = {}
         self._ids = itertools.count(1)
+        self._tasks: set[asyncio.Task] = set()
 
     def create_observer(self, obj: Any) -> ObserverRef:
         """CreateObjectReference: wrap a local object; its public methods
@@ -146,5 +147,9 @@ class ObserverHost:
                 log.exception("observer %s.%s raised", type(obj).__name__,
                               msg.method_name)
 
-        asyncio.ensure_future(run())
+        # retain the task: the loop holds tasks only weakly, so an
+        # unreferenced notification task can be GC'd before it runs
+        task = asyncio.ensure_future(run())
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
         return True

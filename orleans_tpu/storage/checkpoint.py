@@ -24,6 +24,7 @@ Two recovery paths:
 from __future__ import annotations
 
 import asyncio
+import logging
 from typing import TYPE_CHECKING, Iterable
 
 import jax
@@ -195,14 +196,36 @@ class VectorStorageBridge:
                 slots.append(loc[1])
         return np.asarray(shards, np.int32), np.asarray(slots, np.int32)
 
-    async def flush(self, keys: Iterable[int]) -> int:
+    async def flush(self, keys: Iterable[int], strict: bool = False) -> int:
         """Write-behind: persist the current device rows for ``keys``.
-        One batched device→host gather, then per-actor etag'd writes."""
-        keys = list(keys)
+        One batched device→host gather, then per-actor etag'd writes.
+
+        Per-key failure isolation: keys whose activation slot is gone
+        (released) are dropped with a log — there is no row left to
+        persist — and keys whose storage write fails are re-marked dirty
+        individually, so one bad key cannot wedge write-behind for the
+        whole class. Failures re-raise (after re-marking) when ``strict``
+        is set OR when the runtime has no dirty tracking to hold the
+        retry — a standalone bridge must never report silent success."""
+        keys = [int(k) for k in keys]
         if not keys:
             return 0
         tbl = self.runtime.table(self.grain_class)
-        shards, slots = self._locate(keys)
+        located = []
+        for k in keys:
+            if 0 <= k < tbl.dense_n:
+                located.append((k, k // tbl.dense_per_shard,
+                                k % tbl.dense_per_shard))
+            elif (loc := tbl.lookup(k)) is not None:
+                located.append((k, loc[0], loc[1]))
+            else:
+                logging.getLogger("orleans.vector").warning(
+                    "write-behind: key %d has no activation slot; dropping",
+                    k)
+        if not located:
+            return 0
+        shards = np.asarray([s for _, s, _ in located], np.int32)
+        slots = np.asarray([sl for _, _, sl in located], np.int32)
         host = {f: np.asarray(a[shards, slots])
                 for f, a in tbl.state.items()}
 
@@ -219,9 +242,23 @@ class VectorStorageBridge:
                 self.grain_type, self._grain_id(key), state, etag)
             self._etags[key] = etag
 
-        await asyncio.gather(*(write_one(i, int(k))
-                               for i, k in enumerate(keys)))
-        return len(keys)
+        results = await asyncio.gather(
+            *(write_one(i, k) for i, (k, _, _) in enumerate(located)),
+            return_exceptions=True)
+        failed = [k for (k, _, _), r in zip(located, results)
+                  if isinstance(r, BaseException)]
+        if failed:
+            self.runtime._mark_dirty(self.grain_class, failed)
+            first = next(r for r in results if isinstance(r, BaseException))
+            logging.getLogger("orleans.vector").warning(
+                "write-behind: %d/%d key writes failed (re-marked): %r",
+                len(failed), len(located), first)
+            if strict or not self.runtime.track_dirty:
+                # no retry mechanism will see the re-mark (or the caller
+                # demanded completeness — the final stop() drain): surface
+                # the failure instead of reporting partial success
+                raise first
+        return len(located) - len(failed)
 
     async def load(self, keys: Iterable[int]) -> list[int]:
         """Resume: read stored rows and scatter them into the table.

@@ -162,8 +162,37 @@ class TestVectorStorageBridge:
         bridge = VectorStorageBridge(rt, CounterGrain, storage)
         assert await bridge.load([3, 4]) == []
 
-    async def test_flush_unknown_key_raises(self):
+    async def test_flush_unknown_key_dropped(self):
+        # a key with no activation slot has no row to persist: it is
+        # dropped (logged), not raised — one bad key must not wedge
+        # write-behind for the whole class
         rt = _runtime(8)
         bridge = VectorStorageBridge(rt, CounterGrain, MemoryStorage())
-        with pytest.raises(KeyError):
-            await bridge.flush([999])
+        assert await bridge.flush([999]) == 0
+
+    async def test_flush_isolates_per_key_storage_failures(self):
+        # a storage failure on one key re-marks only that key dirty;
+        # the rest of the batch still persists
+        rt = _runtime(8)
+        rt.enable_dirty_tracking()
+        storage = MemoryStorage()
+        bridge = VectorStorageBridge(rt, CounterGrain, storage)
+        tbl = rt.table(CounterGrain)
+        for k in (1, 2, 3):
+            tbl.lookup_or_allocate(k)
+
+        real_write = storage.write
+
+        async def flaky_write(grain_type, grain_id, state, etag):
+            if grain_id.key == 2:
+                raise RuntimeError("injected storage fault")
+            return await real_write(grain_type, grain_id, state, etag)
+
+        storage.write = flaky_write
+        rt.drain_dirty(CounterGrain)  # clear allocation dirt
+        assert await bridge.flush([1, 2, 3]) == 2
+        # only the failed key was re-marked for the next period
+        assert sorted(int(k) for k in rt.drain_dirty(CounterGrain)) == [2]
+        s1, _ = await storage.read("CounterGrain", bridge._grain_id(1))
+        s2, _ = await storage.read("CounterGrain", bridge._grain_id(2))
+        assert s1 is not None and s2 is None
