@@ -82,6 +82,7 @@ def add_vector_grains(builder, *grain_classes: type[VectorGrain],
 
         async def flush_all(strict: bool = False) -> int:
             n = 0
+            first_error: BaseException | None = None
             for cls in grain_classes:
                 keys = silo.vector.drain_dirty(cls)
                 if not len(keys):
@@ -92,10 +93,20 @@ def add_vector_grains(builder, *grain_classes: type[VectorGrain],
                 except asyncio.CancelledError:
                     # cancelled mid-flush: the keys are already drained —
                     # re-mark them so the final stop() drain retries
-                    # instead of losing them (per-key storage failures
-                    # are re-marked inside flush itself)
+                    # instead of losing them
                     silo.vector._mark_dirty(cls, keys)
                     raise
+                except BaseException as e:  # noqa: BLE001
+                    # batch-phase failure (e.g. the device→host gather) or
+                    # a strict re-raise: re-mark so nothing drained is
+                    # lost (per-key write failures were already re-marked
+                    # inside flush; re-marking them twice is harmless),
+                    # then KEEP GOING — one class's bad storage must not
+                    # abandon the other classes' shutdown drain
+                    silo.vector._mark_dirty(cls, keys)
+                    first_error = first_error or e
+            if first_error is not None:
+                raise first_error
             if n:
                 silo.stats.increment("vector.storage.flushed", n)
             return n

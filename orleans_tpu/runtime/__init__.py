@@ -16,6 +16,11 @@ from .grain import (  # noqa: F401
     reentrant,
     stateless_worker,
 )
+from .filters import (  # noqa: F401
+    GrainCallContext,
+    IncomingCallContext,
+    OutgoingCallContext,
+)
 from .observers import ObserverHost, ObserverRef  # noqa: F401
 from .references import GrainFactory, GrainRef  # noqa: F401
 from .silo import (  # noqa: F401

@@ -169,6 +169,12 @@ class ClusterClient(RuntimeClient):
             log.debug("client dropping unexpected message %s",
                       msg.method_name)
 
+    def add_outgoing_call_filter(self, *filters) -> "ClusterClient":
+        """AddOutgoingGrainCallFilter, client side (ClientBuilder analog):
+        filters wrap every call this client sends."""
+        self.outgoing_call_filters.extend(filters)
+        return self
+
     # -- observers (CreateObjectReference / DeleteObjectReference) ---------
     def create_observer(self, obj):
         return self._observer_host.create_observer(obj)

@@ -215,6 +215,12 @@ class Dispatcher:
                 if done is not None and not done.done():
                     done.set_exception(e)
                 raise
+        if msg.method_name == "on_incoming_call":
+            # the filter hook is not a remote method: invoking it directly
+            # would run the gate with a caller-controlled context object
+            raise AttributeError(
+                "on_incoming_call is the grain-level call filter hook, "
+                "not a remotely invocable method")
         instance = activation.grain_instance
         fn = getattr(instance, msg.method_name, None)
         if fn is None:
@@ -222,6 +228,31 @@ class Dispatcher:
                 f"{activation.grain_class.__name__} has no method "
                 f"{msg.method_name!r}")
         args, kwargs = msg.body
+        # incoming call filter chain (InsideRuntimeClient.cs:362 →
+        # GrainMethodInvoker): silo filters first, then the grain's own
+        # on_incoming_call (grain-implements-the-filter form) last.
+        # Application traffic only — system/ping traffic (membership
+        # probes, directory RPCs, reminder ticks) must never be gated by
+        # user filters (the reference's filters wrap grain calls, not
+        # system-target messages).
+        from ..core.message import Category
+        silo_filters = self.silo.incoming_call_filters
+        grain_filter = getattr(instance, "on_incoming_call", None)
+        if (silo_filters or grain_filter is not None) and \
+                msg.category == Category.APPLICATION:
+            from .filters import IncomingCallContext, run_call_chain
+            chain = list(silo_filters)
+            if grain_filter is not None:
+                chain.append(grain_filter)
+
+            async def terminal(c):
+                return await fn(*c.args, **c.kwargs)
+
+            return await run_call_chain(IncomingCallContext(
+                chain, terminal, grain=instance,
+                grain_id=activation.grain_id,
+                interface_name=msg.interface_name,
+                method_name=msg.method_name, args=args, kwargs=kwargs))
         return await fn(*args, **kwargs)
 
     def run_message_pump(self, activation: ActivationData) -> None:

@@ -58,6 +58,11 @@ class RuntimeClient:
         self.callbacks: dict[int, CallbackData] = {}
         self.response_timeout = response_timeout
         self._timeout_sweeper: asyncio.Task | None = None
+        # outgoing call filter chain (IOutgoingGrainCallFilter; silo-side
+        # registration via SiloBuilder.add_outgoing_call_filter, client-side
+        # via ClusterClient.add_outgoing_call_filter)
+        self.outgoing_call_filters: list = []
+        self._filter_tasks: set[asyncio.Task] = set()
 
     # -- to be provided by subclass -------------------------------------
     @property
@@ -78,6 +83,74 @@ class RuntimeClient:
                      timeout: float | None = None,
                      target_silo: SiloAddress | None = None,
                      category=None):
+        # filters wrap APPLICATION grain calls only: system/ping traffic
+        # (membership probes, directory RPCs) must not be interceptable —
+        # a user short-circuit filter would otherwise fail probes and get
+        # healthy silos declared dead
+        if self.outgoing_call_filters and (
+                category is None or category == Category.APPLICATION):
+            from .filters import OutgoingCallContext, run_call_chain
+
+            # copy-isolate NOW, in the caller's turn: the chain runs in a
+            # later task, and caller mutations between send and task start
+            # must not leak into the callee (the same invariant the
+            # unfiltered path gets from deep_copy at make_request time)
+            args, kwargs = deep_copy((args, kwargs))
+
+            async def terminal(c):
+                res = self._send_request_unfiltered(
+                    target_grain=target_grain, grain_class=grain_class,
+                    interface_name=c.interface_name,
+                    method_name=c.method_name,
+                    args=tuple(c.args), kwargs=dict(c.kwargs),
+                    is_read_only=is_read_only,
+                    is_always_interleave=is_always_interleave,
+                    is_one_way=is_one_way, timeout=timeout,
+                    target_silo=target_silo, category=category)
+                return None if res is None else await res
+
+            ctx = OutgoingCallContext(
+                list(self.outgoing_call_filters), terminal,
+                grain_class=grain_class, target_grain=target_grain,
+                interface_name=interface_name, method_name=method_name,
+                args=args, kwargs=kwargs)
+            # the task copies the caller's context NOW, so the sender
+            # activation / RequestContext seen inside the chain (and by
+            # the eventual unfiltered send) is the caller's
+            task = asyncio.ensure_future(run_call_chain(ctx))
+            if not is_one_way:
+                return task
+            # fire-and-forget: retain the task (weakly-held loop refs) and
+            # surface filter errors in the log — there is no caller future
+            self._filter_tasks.add(task)
+
+            def _done(t: asyncio.Task) -> None:
+                self._filter_tasks.discard(t)
+                if not t.cancelled() and t.exception() is not None:
+                    log.error("outgoing filter chain failed for one-way "
+                              "%s.%s", interface_name, method_name,
+                              exc_info=t.exception())
+
+            task.add_done_callback(_done)
+            return None
+        return self._send_request_unfiltered(
+            target_grain=target_grain, grain_class=grain_class,
+            interface_name=interface_name, method_name=method_name,
+            args=args, kwargs=kwargs, is_read_only=is_read_only,
+            is_always_interleave=is_always_interleave,
+            is_one_way=is_one_way, timeout=timeout,
+            target_silo=target_silo, category=category)
+
+    def _send_request_unfiltered(self, *, target_grain: GrainId,
+                                 grain_class: type,
+                                 interface_name: str, method_name: str,
+                                 args: tuple, kwargs: dict,
+                                 is_read_only: bool = False,
+                                 is_always_interleave: bool = False,
+                                 is_one_way: bool = False,
+                                 timeout: float | None = None,
+                                 target_silo: SiloAddress | None = None,
+                                 category=None):
         timeout = self.response_timeout if timeout is None else timeout
         sender = current_activation.get()
         call_chain: tuple[GrainId, ...] = ()

@@ -244,6 +244,9 @@ class Silo:
         # join the vector runtime's tick (Dispatcher._handle_vector_request)
         self.vector: Any = None
         self.vector_interfaces: dict[str, type] = {}
+        # incoming grain-call filter chain (InsideRuntimeClient.cs:362);
+        # outgoing filters live on self.runtime_client
+        self.incoming_call_filters: list = []
         self.stream_providers: dict[str, Any] = {}
         self.status = "Created"
         self._lifecycle: list[tuple[int, Callable, Callable]] = []
@@ -381,6 +384,22 @@ class SiloBuilder:
 
     def with_fabric(self, fabric: "InProcFabric") -> "SiloBuilder":
         self._fabric = fabric
+        return self
+
+    def add_incoming_call_filter(self, *filters) -> "SiloBuilder":
+        """AddIncomingGrainCallFilter: run ``async f(ctx)`` around every
+        incoming grain invocation, in registration order
+        (SiloHostBuilderGrainCallFilterExtensions analog)."""
+        self._configurators.append(
+            lambda silo: silo.incoming_call_filters.extend(filters))
+        return self
+
+    def add_outgoing_call_filter(self, *filters) -> "SiloBuilder":
+        """AddOutgoingGrainCallFilter: run ``async f(ctx)`` around every
+        outgoing call made from inside this silo."""
+        self._configurators.append(
+            lambda silo: silo.runtime_client.outgoing_call_filters
+            .extend(filters))
         return self
 
     def configure(self, fn: Callable[[Silo], None]) -> "SiloBuilder":

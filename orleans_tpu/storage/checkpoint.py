@@ -180,21 +180,30 @@ class VectorStorageBridge:
     def _grain_id(self, key: int) -> GrainId:
         return GrainId.for_grain(GrainType.of(self.grain_type), int(key))
 
-    def _locate(self, keys) -> tuple[np.ndarray, np.ndarray]:
+    def _locate(self, keys, drop_missing: bool = False
+                ) -> tuple[list[int], np.ndarray, np.ndarray]:
+        """Resolve keys to (surviving_keys, shards, slots). Keys with no
+        activation slot raise KeyError, or are dropped with a log when
+        ``drop_missing`` (a released slot has no row left to persist)."""
         tbl = self.runtime.table(self.grain_class)
-        shards, slots = [], []
+        kept, shards, slots = [], [], []
         for k in keys:
             k = int(k)
             if 0 <= k < tbl.dense_n:
-                shards.append(k // tbl.dense_per_shard)
-                slots.append(k % tbl.dense_per_shard)
+                shard, slot = k // tbl.dense_per_shard, k % tbl.dense_per_shard
+            elif (loc := tbl.lookup(k)) is not None:
+                shard, slot = loc[0], loc[1]
+            elif drop_missing:
+                logging.getLogger("orleans.vector").warning(
+                    "write-behind: key %d has no activation slot; dropping",
+                    k)
+                continue
             else:
-                loc = tbl.lookup(k)
-                if loc is None:
-                    raise KeyError(f"key {k} has no activation slot")
-                shards.append(loc[0])
-                slots.append(loc[1])
-        return np.asarray(shards, np.int32), np.asarray(slots, np.int32)
+                raise KeyError(f"key {k} has no activation slot")
+            kept.append(k)
+            shards.append(shard)
+            slots.append(slot)
+        return kept, np.asarray(shards, np.int32), np.asarray(slots, np.int32)
 
     async def flush(self, keys: Iterable[int], strict: bool = False) -> int:
         """Write-behind: persist the current device rows for ``keys``.
@@ -211,21 +220,9 @@ class VectorStorageBridge:
         if not keys:
             return 0
         tbl = self.runtime.table(self.grain_class)
-        located = []
-        for k in keys:
-            if 0 <= k < tbl.dense_n:
-                located.append((k, k // tbl.dense_per_shard,
-                                k % tbl.dense_per_shard))
-            elif (loc := tbl.lookup(k)) is not None:
-                located.append((k, loc[0], loc[1]))
-            else:
-                logging.getLogger("orleans.vector").warning(
-                    "write-behind: key %d has no activation slot; dropping",
-                    k)
-        if not located:
+        kept, shards, slots = self._locate(keys, drop_missing=True)
+        if not kept:
             return 0
-        shards = np.asarray([s for _, s, _ in located], np.int32)
-        slots = np.asarray([sl for _, _, sl in located], np.int32)
         host = {f: np.asarray(a[shards, slots])
                 for f, a in tbl.state.items()}
 
@@ -243,22 +240,22 @@ class VectorStorageBridge:
             self._etags[key] = etag
 
         results = await asyncio.gather(
-            *(write_one(i, k) for i, (k, _, _) in enumerate(located)),
+            *(write_one(i, k) for i, k in enumerate(kept)),
             return_exceptions=True)
-        failed = [k for (k, _, _), r in zip(located, results)
+        failed = [k for k, r in zip(kept, results)
                   if isinstance(r, BaseException)]
         if failed:
             self.runtime._mark_dirty(self.grain_class, failed)
             first = next(r for r in results if isinstance(r, BaseException))
             logging.getLogger("orleans.vector").warning(
                 "write-behind: %d/%d key writes failed (re-marked): %r",
-                len(failed), len(located), first)
+                len(failed), len(kept), first)
             if strict or not self.runtime.track_dirty:
                 # no retry mechanism will see the re-mark (or the caller
                 # demanded completeness — the final stop() drain): surface
                 # the failure instead of reporting partial success
                 raise first
-        return len(located) - len(failed)
+        return len(kept) - len(failed)
 
     async def load(self, keys: Iterable[int]) -> list[int]:
         """Resume: read stored rows and scatter them into the table.
@@ -289,7 +286,7 @@ class VectorStorageBridge:
             dense = [k for k in fkeys if 0 <= k < tbl.dense_n]
             if dense:
                 tbl.dense_active[np.asarray(dense, int)] = True
-        shards, slots = self._locate(fkeys)
+        _, shards, slots = self._locate(fkeys)
         for f, arr in tbl.state.items():
             vals = np.stack([np.asarray(s[f]) for _, s, _ in found])
             tbl.state[f] = tbl._put(arr.at[shards, slots].set(

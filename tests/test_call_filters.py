@@ -1,0 +1,289 @@
+"""Grain call filters: ordering, argument/result rewriting, short-circuit,
+exception transform, grain-level filter, outgoing chain (reference:
+InsideRuntimeClient.cs:362, Core/GrainMethodInvoker.cs)."""
+
+import asyncio
+
+import pytest
+
+from orleans_tpu.runtime import ClusterClient, Grain, SiloBuilder
+
+
+class Echo(Grain):
+    async def say(self, text: str) -> str:
+        return f"echo:{text}"
+
+    async def boom(self) -> None:
+        raise ValueError("kaboom")
+
+
+class Guarded(Grain):
+    """Grain-level filter (grain implements the filter interface)."""
+
+    async def on_incoming_call(self, ctx):
+        if ctx.kwargs.get("secret") == "let-me-in" or \
+                (ctx.args and ctx.args[0] == "let-me-in"):
+            ctx.kwargs.pop("secret", None)
+            ctx.args = ()
+            await ctx.invoke()
+        else:
+            ctx.result = "denied"
+
+    async def protected(self, *args, **kwargs) -> str:
+        return "granted"
+
+
+async def _cluster(builder):
+    silo = builder.build()
+    await silo.start()
+    client = await ClusterClient(silo.fabric).connect()
+    return silo, client
+
+
+async def test_incoming_filters_run_in_order_around_invoke():
+    order = []
+
+    def make(tag):
+        async def f(ctx):
+            order.append(f"{tag}:pre")
+            await ctx.invoke()
+            order.append(f"{tag}:post")
+        return f
+
+    silo, client = await _cluster(
+        SiloBuilder().add_grains(Echo)
+        .add_incoming_call_filter(make("a"), make("b")))
+    try:
+        assert await client.get_grain(Echo, 1).say("x") == "echo:x"
+        # registration order inward, reverse order outward (chain nesting)
+        assert order == ["a:pre", "b:pre", "b:post", "a:post"]
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_incoming_filter_rewrites_args_and_result():
+    async def f(ctx):
+        ctx.args = tuple(a.upper() for a in ctx.args)
+        await ctx.invoke()
+        ctx.result = f"[{ctx.result}]"
+
+    silo, client = await _cluster(
+        SiloBuilder().add_grains(Echo).add_incoming_call_filter(f))
+    try:
+        assert await client.get_grain(Echo, 1).say("hi") == "[echo:HI]"
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_incoming_filter_short_circuits_without_invoke():
+    called = []
+
+    async def veto(ctx):
+        ctx.result = "vetoed"  # no ctx.invoke(): method never runs
+
+    async def never(ctx):
+        called.append(True)
+        await ctx.invoke()
+
+    silo, client = await _cluster(
+        SiloBuilder().add_grains(Echo)
+        .add_incoming_call_filter(veto, never))
+    try:
+        assert await client.get_grain(Echo, 1).say("x") == "vetoed"
+        assert called == []
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_incoming_filter_transforms_exception():
+    async def absorb(ctx):
+        try:
+            await ctx.invoke()
+        except ValueError as e:
+            ctx.result = f"caught:{e}"
+
+    silo, client = await _cluster(
+        SiloBuilder().add_grains(Echo).add_incoming_call_filter(absorb))
+    try:
+        assert await client.get_grain(Echo, 1).boom() == "caught:kaboom"
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_incoming_filter_exception_reaches_caller():
+    async def deny(ctx):
+        raise PermissionError("filtered out")
+
+    silo, client = await _cluster(
+        SiloBuilder().add_grains(Echo).add_incoming_call_filter(deny))
+    try:
+        with pytest.raises(PermissionError, match="filtered out"):
+            await client.get_grain(Echo, 1).say("x")
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_double_invoke_rejected():
+    async def twice(ctx):
+        await ctx.invoke()
+        await ctx.invoke()  # would run the grain method twice
+
+    silo, client = await _cluster(
+        SiloBuilder().add_grains(Echo).add_incoming_call_filter(twice))
+    try:
+        with pytest.raises(RuntimeError, match="more than once"):
+            await client.get_grain(Echo, 1).say("x")
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_grain_level_filter_runs_last_and_gates():
+    seen = []
+
+    async def silo_filter(ctx):
+        seen.append("silo")
+        await ctx.invoke()
+
+    silo, client = await _cluster(
+        SiloBuilder().add_grains(Guarded)
+        .add_incoming_call_filter(silo_filter))
+    try:
+        g = client.get_grain(Guarded, 9)
+        assert await g.protected("let-me-in") == "granted"
+        assert await g.protected("wrong") == "denied"
+        assert seen == ["silo", "silo"]  # silo filter ran before the gate
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_context_carries_identity():
+    captured = {}
+
+    async def spy(ctx):
+        captured["iface"] = ctx.interface_name
+        captured["method"] = ctx.method_name
+        captured["grain"] = type(ctx.grain).__name__
+        captured["key"] = ctx.grain_id.key
+        await ctx.invoke()
+
+    silo, client = await _cluster(
+        SiloBuilder().add_grains(Echo).add_incoming_call_filter(spy))
+    try:
+        await client.get_grain(Echo, 42).say("x")
+        assert captured == {"iface": "Echo", "method": "say",
+                            "grain": "Echo", "key": 42}
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_outgoing_filters_client_side():
+    order = []
+
+    async def out(ctx):
+        order.append(("pre", ctx.method_name, ctx.target_grain.key))
+        ctx.args = ("rewritten",)
+        await ctx.invoke()
+        order.append(("post", ctx.result))
+        ctx.result = ctx.result + "!"
+
+    silo = SiloBuilder().add_grains(Echo).build()
+    await silo.start()
+    client = await ClusterClient(silo.fabric).connect()
+    client.add_outgoing_call_filter(out)
+    try:
+        assert await client.get_grain(Echo, 3).say("orig") == \
+            "echo:rewritten!"
+        assert order == [("pre", "say", 3), ("post", "echo:rewritten")]
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_outgoing_filter_short_circuit_never_sends():
+    async def offline(ctx):
+        ctx.result = "cached-locally"
+
+    silo = SiloBuilder().add_grains(Echo).build()
+    await silo.start()
+    client = await ClusterClient(silo.fabric).connect()
+    client.add_outgoing_call_filter(offline)
+    try:
+        before = silo.stats.get("messaging.received.application")
+        assert await client.get_grain(Echo, 3).say("x") == "cached-locally"
+        await asyncio.sleep(0.05)
+        assert (silo.stats.get("messaging.received.application")) == before
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_filter_hook_not_remotely_invocable():
+    silo, client = await _cluster(SiloBuilder().add_grains(Guarded))
+    try:
+        with pytest.raises(AttributeError, match="filter hook"):
+            await client._send_request_unfiltered(
+                target_grain=client.get_grain(Guarded, 9).grain_id,
+                grain_class=Guarded, interface_name="Guarded",
+                method_name="on_incoming_call", args=(object(),),
+                kwargs={})
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_system_traffic_bypasses_filters():
+    """A short-circuiting filter must not intercept membership probes or
+    directory RPCs (Category.PING/SYSTEM) — only application calls."""
+    async def veto_everything(ctx):
+        ctx.result = "vetoed"
+
+    from orleans_tpu.testing import TestClusterBuilder
+
+    cluster = await (
+        TestClusterBuilder(n_silos=2)
+        .add_grains(Echo)
+        .configure_silo(lambda b: b
+                        .add_incoming_call_filter(veto_everything)
+                        .add_outgoing_call_filter(veto_everything))
+        .build().deploy())
+    try:
+        # membership stays healthy despite the hostile filter: probes and
+        # IAmAlive writes ride PING/SYSTEM lanes, which bypass the chain
+        await asyncio.sleep(0.5)
+        for silo in cluster.silos:
+            assert silo.status == "Running"
+        assert len(cluster.silos[0].membership.active_silos()) == 2
+        # while application calls ARE vetoed
+        assert await cluster.client.get_grain(Echo, 1).say("x") == "vetoed"
+    finally:
+        await cluster.stop_all()
+
+
+async def test_silo_outgoing_filter_wraps_grain_to_grain_calls():
+    order = []
+
+    async def out(ctx):
+        order.append(ctx.method_name)
+        await ctx.invoke()
+
+    class Front(Grain):
+        async def relay(self, text: str) -> str:
+            return await self.get_grain(Echo, 5).say(text)
+
+    silo, client = await _cluster(
+        SiloBuilder().add_grains(Echo, Front)
+        .add_outgoing_call_filter(out))
+    try:
+        assert await client.get_grain(Front, 1).relay("x") == "echo:x"
+        assert "say" in order  # the inner grain→grain leg was wrapped
+    finally:
+        await client.close_async()
+        await silo.stop()
