@@ -105,10 +105,10 @@ def add_vector_grains(builder, *grain_classes: type[VectorGrain],
                     # abandon the other classes' shutdown drain
                     silo.vector._mark_dirty(cls, keys)
                     first_error = first_error or e
-            if first_error is not None:
-                raise first_error
             if n:
                 silo.stats.increment("vector.storage.flushed", n)
+            if first_error is not None:
+                raise first_error
             return n
 
         async def flusher() -> None:

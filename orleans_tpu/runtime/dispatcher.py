@@ -44,6 +44,9 @@ class Dispatcher:
     def __init__(self, silo: "Silo"):
         self.silo = silo
         self.detect_deadlocks = silo.config.detect_deadlocks
+        # in-flight device-tier state recoveries: (class, key_hash) →
+        # future; concurrent calls for one recovering key share the load
+        self._vector_recoveries: dict = {}
 
     # ==================================================================
     # Receive path
@@ -118,7 +121,20 @@ class Dispatcher:
                     f"(schema-bound); got {len(args)} positional")
             key_hash = rt.key_hash_for(msg.target_grain.key,
                                        msg.target_grain.uniform_hash)
-            fut = rt.call(vcls, key_hash, msg.method_name, **kwargs)
+            bridge = getattr(self.silo, "vector_bridges", {}).get(vcls)
+            if bridge is not None and \
+                    self._vector_key_is_fresh(rt, vcls, key_hash):
+                # virtual-actor recovery (Catalog.cs:443 +
+                # StateStorageBridge.cs:49 on the device tier): this silo
+                # became the key's ring owner without its state — e.g.
+                # after the previous owner died — so rehydrate the row
+                # from write-behind storage before the first kernel tick
+                # touches it. Keys with no stored state proceed fresh
+                # (the lazy-recreate contract).
+                fut = asyncio.ensure_future(self._recover_then_call(
+                    rt, vcls, bridge, key_hash, msg.method_name, kwargs))
+            else:
+                fut = rt.call(vcls, key_hash, msg.method_name, **kwargs)
         except Exception as e:  # noqa: BLE001 — schema/arg errors → caller
             if msg.direction != Direction.ONE_WAY:
                 self.send_response(msg, make_error_response(msg, e))
@@ -136,6 +152,41 @@ class Dispatcher:
                 self.send_response(msg, make_response(msg, f.result()))
 
         fut.add_done_callback(done)
+
+    @staticmethod
+    def _vector_key_is_fresh(rt, vcls: type, key_hash: int) -> bool:
+        """True iff the key has no live row in the local table (first
+        touch on this silo — the recovery trigger)."""
+        tbl = rt.table(vcls)
+        if 0 <= key_hash < tbl.dense_n:
+            return not bool(tbl.dense_active[key_hash])
+        return tbl.lookup(key_hash) is None
+
+    async def _recover_then_call(self, rt, vcls: type, bridge,
+                                 key_hash: int, method: str, kwargs: dict):
+        """Rehydrate one key from write-behind storage, then run the call.
+        Concurrent first-touch calls share a single storage read; the
+        call itself joins the next tick as usual."""
+        rec_key = (vcls, key_hash)
+        rec = self._vector_recoveries.get(rec_key)
+        if rec is None:
+            if not self._vector_key_is_fresh(rt, vcls, key_hash):
+                # a recovery completed between the fresh-check in
+                # _handle_vector_request and this task running: loading
+                # again would re-scatter stale stored state over ticks
+                # that already ran
+                return await rt.call(vcls, key_hash, method, **kwargs)
+            rec = asyncio.ensure_future(bridge.load([key_hash]))
+            self._vector_recoveries[rec_key] = rec
+            try:
+                restored = await rec
+                if restored:
+                    self.silo.stats.increment("vector.storage.recovered")
+            finally:
+                self._vector_recoveries.pop(rec_key, None)
+        else:
+            await rec
+        return await rt.call(vcls, key_hash, method, **kwargs)
 
     def receive_request(self, activation: ActivationData, msg: Message) -> None:
         """ReceiveRequest:262 — gate, then run or enqueue."""

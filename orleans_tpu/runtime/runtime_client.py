@@ -106,7 +106,8 @@ class RuntimeClient:
                     is_read_only=is_read_only,
                     is_always_interleave=is_always_interleave,
                     is_one_way=is_one_way, timeout=timeout,
-                    target_silo=target_silo, category=category)
+                    target_silo=target_silo, category=category,
+                    body_precopied=True)
                 return None if res is None else await res
 
             ctx = OutgoingCallContext(
@@ -114,10 +115,24 @@ class RuntimeClient:
                 grain_class=grain_class, target_grain=target_grain,
                 interface_name=interface_name, method_name=method_name,
                 args=args, kwargs=kwargs)
+
+            async def bounded_chain():
+                # the whole chain — filters AND the call they wrap — runs
+                # under the response timeout: a stalled filter must fail
+                # like a stalled silo would, not wedge the caller's turn
+                budget = self.response_timeout if timeout is None else timeout
+                try:
+                    return await asyncio.wait_for(
+                        run_call_chain(ctx), budget or None)
+                except asyncio.TimeoutError:
+                    raise GrainCallTimeoutError(
+                        f"{interface_name}.{method_name} outgoing filter "
+                        f"chain timed out after {budget}s") from None
+
             # the task copies the caller's context NOW, so the sender
             # activation / RequestContext seen inside the chain (and by
             # the eventual unfiltered send) is the caller's
-            task = asyncio.ensure_future(run_call_chain(ctx))
+            task = asyncio.ensure_future(bounded_chain())
             if not is_one_way:
                 return task
             # fire-and-forget: retain the task (weakly-held loop refs) and
@@ -150,7 +165,8 @@ class RuntimeClient:
                                  is_one_way: bool = False,
                                  timeout: float | None = None,
                                  target_silo: SiloAddress | None = None,
-                                 category=None):
+                                 category=None,
+                                 body_precopied: bool = False):
         timeout = self.response_timeout if timeout is None else timeout
         sender = current_activation.get()
         call_chain: tuple[GrainId, ...] = ()
@@ -167,7 +183,10 @@ class RuntimeClient:
             target_grain=target_grain,
             interface_name=interface_name,
             method_name=method_name,
-            body=deep_copy((args, kwargs)),
+            # filtered sends already copy-isolated at send_request time;
+            # copying twice would double serialization on the hot path
+            body=(args, kwargs) if body_precopied
+            else deep_copy((args, kwargs)),
             direction=Direction.ONE_WAY if is_one_way else Direction.REQUEST,
             category=category if category is not None else Category.APPLICATION,
             target_silo=target_silo,

@@ -166,6 +166,72 @@ async def test_multi_silo_single_owner_routing():
         assert len(owners) == 1
 
 
+async def test_vector_failover_resurrects_state_on_new_owner():
+    """Kill the silo owning a key's device state: the next call routes to
+    the new ring owner, which rehydrates the row from write-behind
+    storage before executing — the virtual-actor reliability guarantee
+    (Catalog.cs:443 + StateStorageBridge.cs:49) on the device tier."""
+    from orleans_tpu.storage import MemoryStorage
+    from orleans_tpu.testing import TestClusterBuilder
+
+    storage = MemoryStorage()
+    cluster = (TestClusterBuilder(2)
+               .add_grains(HostGrain)
+               .with_vector_grains(CounterVec, mesh=make_mesh(2),
+                                   capacity_per_shard=16,
+                                   storage=storage, flush_period=0.05)
+               .build())
+    async with cluster:
+        key = 21
+        g = cluster.client.get_grain(CounterVec, key)
+        for i in range(3):
+            assert int(await g.add(x=float(i))) == i + 1
+        owners = [s for s in cluster.silos
+                  if s.vector.table(CounterVec).lookup(key) is not None
+                  or (0 <= key < s.vector.table(CounterVec).dense_n
+                      and s.vector.table(CounterVec).dense_active[key])]
+        assert len(owners) == 1
+        owner = owners[0]
+        await asyncio.sleep(0.25)   # ≥1 write-behind flush before the kill
+        await cluster.kill_silo(owner)
+        await cluster.wait_for_death(owner)
+        # next call lands on the surviving silo, which resumes from the
+        # persisted count=3 — NOT from fresh state
+        assert int(await g.add(x=9.0)) == 4
+        survivor = next(s for s in cluster.silos if s is not owner)
+        assert survivor.stats.get("vector.storage.recovered") >= 1
+        row = survivor.vector.table(CounterVec).read_row(key)
+        assert float(row["last"]) == 9.0
+
+
+async def test_vector_failover_unpersisted_key_starts_fresh():
+    """A key the dead owner never flushed starts over on the new owner —
+    the lazy-recreate contract (state is only as durable as the last
+    write-behind flush, exactly the reference's storage semantics)."""
+    from orleans_tpu.storage import MemoryStorage
+    from orleans_tpu.testing import TestClusterBuilder
+
+    storage = MemoryStorage()
+    cluster = (TestClusterBuilder(2)
+               .add_grains(HostGrain)
+               .with_vector_grains(CounterVec, mesh=make_mesh(2),
+                                   capacity_per_shard=16,
+                                   storage=storage,
+                                   flush_period=30.0)  # never fires
+               .build())
+    async with cluster:
+        key = 34
+        g = cluster.client.get_grain(CounterVec, key)
+        assert int(await g.add(x=1.0)) == 1
+        owners = [s for s in cluster.silos
+                  if s.vector.table(CounterVec).lookup(key) is not None
+                  or (0 <= key < s.vector.table(CounterVec).dense_n
+                      and s.vector.table(CounterVec).dense_active[key])]
+        await cluster.kill_silo(owners[0])
+        await cluster.wait_for_death(owners[0])
+        assert int(await g.add(x=2.0)) == 1  # fresh row: nothing stored
+
+
 async def test_management_sees_both_tiers():
     from orleans_tpu.management import ManagementGrain, add_management
 
