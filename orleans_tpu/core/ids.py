@@ -96,6 +96,10 @@ class GrainType:
 KeyType = Union[int, str, bytes]
 
 
+_grain_id_intern: dict = {}
+_INTERN_LIMIT = 1 << 17
+
+
 @dataclass(frozen=True)
 class GrainId:
     """Grain identity = (category, type_code, primary key [, key extension]).
@@ -132,6 +136,19 @@ class GrainId:
     @classmethod
     def for_grain(cls, grain_type: GrainType, key: KeyType,
                   key_ext: str | None = None) -> "GrainId":
+        # interning (the reference's Interner.cs): grain ids are built on
+        # every get_grain call and the hash in __post_init__ is the single
+        # hottest id-layer cost — reuse frozen instances for hashable keys
+        if isinstance(key, (int, str)):
+            k = (grain_type.type_code, key, key_ext)
+            cached = _grain_id_intern.get(k)
+            if cached is None:
+                cached = cls(GrainCategory.GRAIN, grain_type.type_code,
+                             key, key_ext)
+                if len(_grain_id_intern) >= _INTERN_LIMIT:
+                    _grain_id_intern.clear()  # bounded; ids are cheap to remake
+                _grain_id_intern[k] = cached
+            return cached
         return cls(GrainCategory.GRAIN, grain_type.type_code, key, key_ext)
 
     @classmethod

@@ -118,8 +118,13 @@ class DistributedLocator:
     # ------------------------------------------------------------------
     # Locator protocol
     # ------------------------------------------------------------------
-    async def locate(self, msg: Message, grain_class: type | None) -> SiloAddress:
-        """AddressMessage:715 — resolve the hosting silo for a request."""
+    def try_locate_sync(self, msg: Message, grain_class: type | None
+                        ) -> SiloAddress | None:
+        """Synchronously-resolvable addressing: system targets, stateless
+        workers, cache hits, and locally-owned directory partitions. The
+        dispatcher uses this to skip a task round trip per send — only the
+        remote-owner directory hop needs the async path. Returns None when
+        a remote hop is required."""
         grain_id = msg.target_grain
         if grain_id.is_system_target() or grain_id.is_client():
             return msg.target_silo or self.silo.silo_address
@@ -132,18 +137,33 @@ class DistributedLocator:
         if cached is not None and cached in self.alive_set:
             self.cache.move_to_end(grain_id)
             return cached
+        owner = self.ring.owner(grain_id.uniform_hash) or self.silo.silo_address
+        if owner != self.silo.silo_address:
+            return None  # remote directory hop — async path
+        placement_name = getattr(grain_class, "__orleans_placement__",
+                                 None) if grain_class else None
+        silo, is_new = self.local_lookup_or_place(
+            grain_id, placement_name, self.silo.silo_address,
+            msg.interface_name, msg.interface_version)
+        msg.is_new_placement = is_new
+        self._cache_put(grain_id, silo)
+        return silo
+
+    async def locate(self, msg: Message, grain_class: type | None) -> SiloAddress:
+        """AddressMessage:715 — resolve the hosting silo for a request."""
+        target = self.try_locate_sync(msg, grain_class)
+        if target is not None:
+            return target
+        grain_id = msg.target_grain
+        if grain_class is None:
+            grain_class = self.silo.registry.resolve(msg.interface_name)
         placement_name = getattr(grain_class, "__orleans_placement__",
                                  None) if grain_class else None
         owner = self.ring.owner(grain_id.uniform_hash) or self.silo.silo_address
-        if owner == self.silo.silo_address:
-            silo, is_new = self.local_lookup_or_place(
-                grain_id, placement_name, self.silo.silo_address,
-                msg.interface_name, msg.interface_version)
-        else:
-            silo, is_new = await self._target_ref(
-                owner, "dir_lookup_or_place", grain_id, placement_name,
-                self.silo.silo_address, msg.interface_name,
-                msg.interface_version)
+        silo, is_new = await self._target_ref(
+            owner, "dir_lookup_or_place", grain_id, placement_name,
+            self.silo.silo_address, msg.interface_name,
+            msg.interface_version)
         msg.is_new_placement = is_new
         self._cache_put(grain_id, silo)
         return silo

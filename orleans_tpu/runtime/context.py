@@ -26,6 +26,11 @@ _request_context: contextvars.ContextVar[dict | None] = contextvars.ContextVar(
     "orleans_request_context", default=None
 )
 
+# RequestContext key the ambient TransactionInfo rides under (shared with
+# transactions.context; the runtime needs it to piggyback callee joins on
+# response headers without importing the transactions package)
+TXN_KEY = "orleans.txn"
+
 
 class RequestContext:
     """Static accessors mirroring the reference API

@@ -227,10 +227,14 @@ class Dispatcher:
         try:
             result = await self.invoke(activation, msg)
             if msg.direction == Direction.REQUEST:
-                self.send_response(msg, make_response(msg, deep_copy(result)))
+                resp = make_response(msg, deep_copy(result))
+                self._attach_txn_joins(resp)
+                self.send_response(msg, resp)
         except BaseException as e:  # noqa: BLE001 — grain errors flow to caller
             if msg.direction == Direction.REQUEST:
-                self.send_response(msg, make_error_response(msg, e))
+                resp = make_error_response(msg, e)
+                self._attach_txn_joins(resp)
+                self.send_response(msg, resp)
             else:
                 log.exception("one-way turn failed on %s.%s",
                               msg.interface_name, msg.method_name)
@@ -249,6 +253,19 @@ class Dispatcher:
             current_activation.reset(token_a)
             activation.reset_running(msg)
             self.run_message_pump(activation)
+
+    @staticmethod
+    def _attach_txn_joins(resp: Message) -> None:
+        """Piggyback the turn's transaction participant set on the
+        response header, so callee-side joins fold back into the caller's
+        TransactionInfo (the reference's TransactionInfo message-header
+        round trip; merged in RuntimeClient.receive_response). Error
+        responses carry it too — the root's abort must notify every
+        participant that joined before the failure."""
+        from .context import TXN_KEY, RequestContext
+        info = RequestContext.get(TXN_KEY)
+        if info is not None and getattr(info, "participants", None):
+            resp.transaction_info = (info.id, dict(info.participants))
 
     async def invoke(self, activation: ActivationData, msg: Message):
         """Resolve and call the grain method (Invoke:294-474, codegen
@@ -349,6 +366,21 @@ class Dispatcher:
     def send_message(self, msg: Message, grain_class: type | None = None) -> None:
         """AsyncSendMessage:645 — address if needed, then transmit."""
         if msg.target_silo is None:
+            # sync fast path: cache hits / local-owner placements resolve
+            # without an addressing task (the common case by far)
+            try:
+                target = self.silo.locator.try_locate_sync(msg, grain_class)
+            except Exception as e:  # noqa: BLE001 — same contract as async
+                log.exception("addressing failed for %s", msg.target_grain)
+                if msg.direction == Direction.REQUEST:
+                    resp = make_error_response(msg, e)
+                    resp.target_silo = msg.sending_silo
+                    self.transmit(resp)
+                return
+            if target is not None:
+                msg.target_silo = target
+                self.transmit(msg)
+                return
             asyncio.get_running_loop().create_task(
                 self._address_and_send(msg, grain_class))
         else:

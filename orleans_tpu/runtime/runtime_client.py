@@ -28,7 +28,7 @@ from ..core.message import (
     make_request,
 )
 from ..core.serialization import deep_copy
-from .context import RequestContext, current_activation
+from .context import TXN_KEY, RequestContext, current_activation
 
 if TYPE_CHECKING:
     from .activation import ActivationData
@@ -39,14 +39,18 @@ MAX_RESEND_COUNT = 3  # SiloMessagingOptions.MaxResendCount analog
 
 
 class CallbackData:
-    """One outstanding request: future + timeout bookkeeping (CallbackData.cs)."""
+    """One outstanding request: future + timeout bookkeeping (CallbackData.cs).
+    ``txn_info`` is the caller's ambient TransactionInfo (if any) so
+    callee joins piggybacked on the response can merge back into it."""
 
-    __slots__ = ("message", "future", "deadline")
+    __slots__ = ("message", "future", "deadline", "txn_info")
 
-    def __init__(self, message: Message, future: asyncio.Future, deadline: float | None):
+    def __init__(self, message: Message, future: asyncio.Future,
+                 deadline: float | None, txn_info=None):
         self.message = message
         self.future = future
         self.deadline = deadline
+        self.txn_info = txn_info
 
 
 class RuntimeClient:
@@ -209,7 +213,8 @@ class RuntimeClient:
         loop = asyncio.get_running_loop()
         future: asyncio.Future = loop.create_future()
         deadline = (time.monotonic() + timeout) if timeout else None
-        self.callbacks[msg.id] = CallbackData(msg, future, deadline)
+        self.callbacks[msg.id] = CallbackData(
+            msg, future, deadline, txn_info=RequestContext.get(TXN_KEY))
         self._ensure_sweeper()
         try:
             self.transmit(msg)
@@ -226,6 +231,13 @@ class RuntimeClient:
             return
         if cb.future.done():
             return
+        # fold callee transaction joins back into the caller's ambient
+        # info (the TransactionInfo response-header merge; idempotent for
+        # the in-proc shared-object case)
+        if cb.txn_info is not None and msg.transaction_info is not None:
+            tid, participants = msg.transaction_info
+            if tid == cb.txn_info.id:
+                cb.txn_info.merge(participants)
         if msg.response_kind == ResponseKind.SUCCESS:
             cb.future.set_result(msg.body)
         elif msg.response_kind == ResponseKind.ERROR:

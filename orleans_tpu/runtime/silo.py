@@ -81,6 +81,12 @@ class SiloConfig:
     membership_vote_expiration: float = 10.0
     directory_cache_size: int = 100_000
     turn_warning_length: float = 0.2  # TurnWarningLengthThreshold
+    # run new turn tasks eagerly to their first suspension
+    # (asyncio.eager_task_factory): a turn that completes without awaiting
+    # skips the event-loop round trip entirely — the asyncio analog of the
+    # reference's inline WorkItemGroup execution (WorkItemGroup.cs:269
+    # runs queued tasks synchronously on the worker thread)
+    eager_turns: bool = True
 
 
 class GrainRegistry:
@@ -276,6 +282,10 @@ class Silo:
             log.info("SiloConfig.%s = %r", f.name,
                      getattr(self.config, f.name))
         self.status = "Joining"
+        if self.config.eager_turns:
+            # idempotent across silos sharing one loop
+            asyncio.get_running_loop().set_task_factory(
+                asyncio.eager_task_factory)
         self.message_center.start()          # RuntimeServices
         self.catalog.start()
         self.fabric.register_silo(self)

@@ -2,7 +2,13 @@
 src/Orleans.Runtime/Transactions/): @transactional scopes, TransactionalState
 versioned grain state, singleton TM grain running 2PC."""
 
-from .context import ambient_txn
+from .context import TransactionInfo, ambient_txn
+from .log import (
+    FileTransactionLog,
+    InMemoryTransactionLog,
+    SqliteTransactionLog,
+    TransactionLog,
+)
 from .manager import (
     TransactionAgent,
     TransactionManagerGrain,
@@ -12,7 +18,9 @@ from .manager import (
 from .state import TransactionalGrain, TransactionalState
 
 __all__ = [
-    "transactional", "add_transactions", "ambient_txn",
+    "transactional", "add_transactions", "ambient_txn", "TransactionInfo",
     "TransactionAgent", "TransactionManagerGrain",
     "TransactionalGrain", "TransactionalState",
+    "TransactionLog", "InMemoryTransactionLog", "FileTransactionLog",
+    "SqliteTransactionLog",
 ]

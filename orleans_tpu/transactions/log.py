@@ -1,0 +1,124 @@
+"""Durable transaction commit log.
+
+Re-design of /root/reference/src/Orleans.Transactions/TransactionLog.cs
+(storage-backed commit log the TM appends decisions to before announcing
+them) behind a pluggable provider interface, with in-memory / append-only
+file / sqlite backends — the same provider split the membership table and
+reminder table use (cloud log storage such as
+Orleans.Transactions.AzureStorage maps to the File/Sqlite backends here;
+no cloud egress in scope).
+
+The log is the TM's durable truth: a decision is COMMITTED the moment its
+record is appended, before any participant hears the outcome. A TM
+activation replays the log on activate (seq + decision map), which is what
+makes TM failover safe: in-doubt participants query ``decision_of`` against
+the recovered map.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+from typing import Iterable
+
+__all__ = ["TransactionLog", "InMemoryTransactionLog", "FileTransactionLog",
+           "SqliteTransactionLog"]
+
+
+class TransactionLog:
+    """Provider contract. One log instance may be shared by several TM
+    shards; records carry the shard id so each shard replays its own."""
+
+    async def append(self, shard: int, txn: str, decision: str,
+                     version: int) -> None:
+        raise NotImplementedError
+
+    async def replay(self, shard: int) -> tuple[int, dict[str, str]]:
+        """Return (max_version_seen, {txn: decision}) for one shard."""
+        raise NotImplementedError
+
+
+class InMemoryTransactionLog(TransactionLog):
+    """Test/dev backend; survives silo restarts when the instance is shared
+    (the InMemoryTransactionLog analog of InMemoryMembershipTable)."""
+
+    def __init__(self) -> None:
+        self.records: list[tuple[int, str, str, int]] = []
+
+    async def append(self, shard: int, txn: str, decision: str,
+                     version: int) -> None:
+        self.records.append((shard, txn, decision, version))
+
+    async def replay(self, shard: int) -> tuple[int, dict[str, str]]:
+        return _fold(r for r in self.records if r[0] == shard)
+
+
+class FileTransactionLog(TransactionLog):
+    """Append-only JSONL file, fsync'd per decision — the durability
+    point of the 2PC (TransactionLog.cs's storage append)."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+
+    async def append(self, shard: int, txn: str, decision: str,
+                     version: int) -> None:
+        line = json.dumps({"s": shard, "t": txn, "d": decision,
+                           "v": version}, separators=(",", ":"))
+        with open(self.path, "a", encoding="utf-8") as f:
+            f.write(line + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    async def replay(self, shard: int) -> tuple[int, dict[str, str]]:
+        if not os.path.exists(self.path):
+            return 0, {}
+
+        def rows():
+            with open(self.path, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    r = json.loads(line)
+                    if r["s"] == shard:
+                        yield r["s"], r["t"], r["d"], r["v"]
+
+        return _fold(rows())
+
+
+class SqliteTransactionLog(TransactionLog):
+    """Sqlite-backed log (the AdoNet analog)."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        with self._db() as db:
+            db.execute(
+                "CREATE TABLE IF NOT EXISTS txn_log ("
+                " shard INTEGER, txn TEXT, decision TEXT, version INTEGER)")
+
+    def _db(self) -> sqlite3.Connection:
+        return sqlite3.connect(self.path)
+
+    async def append(self, shard: int, txn: str, decision: str,
+                     version: int) -> None:
+        with self._db() as db:
+            db.execute("INSERT INTO txn_log VALUES (?,?,?,?)",
+                       (shard, txn, decision, version))
+
+    async def replay(self, shard: int) -> tuple[int, dict[str, str]]:
+        with self._db() as db:
+            rows = db.execute(
+                "SELECT shard, txn, decision, version FROM txn_log"
+                " WHERE shard=?", (shard,)).fetchall()
+        return _fold(rows)
+
+
+def _fold(rows: Iterable[tuple[int, str, str, int]]
+          ) -> tuple[int, dict[str, str]]:
+    seq = 0
+    decisions: dict[str, str] = {}
+    for _, txn, decision, version in rows:
+        decisions[txn] = decision
+        seq = max(seq, version)
+    return seq, decisions

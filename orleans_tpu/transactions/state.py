@@ -54,27 +54,27 @@ class TransactionalState:
 
     # -- grain-facing API (PerformRead/PerformUpdate) -------------------
     async def get(self) -> Any:
-        txn = ambient_txn()
-        if txn is None:
+        info = ambient_txn()
+        if info is None:
             return deep_copy(self.committed)
-        ws = await self._enter(txn)
+        ws = await self._enter(info)
         return ws["value"]
 
     async def set(self, value: Any) -> None:
-        txn = ambient_txn()
-        if txn is None:
+        info = ambient_txn()
+        if info is None:
             raise TransactionAbortedError(
                 f"state {self.name!r} can only be written inside a "
                 "transaction (wrap the method with @transactional)")
-        ws = await self._enter(txn)
+        ws = await self._enter(info)
         ws["value"] = value
         ws["written"] = True
 
-    async def _enter(self, txn: str) -> dict:
-        ws = self.workspace.get(txn)
+    async def _enter(self, info) -> dict:
+        ws = self.workspace.get(info.id)
         if ws is None:
-            await self.owner._txn_join(txn)
-            ws = self.workspace[txn] = {
+            self.owner._txn_join(info)
+            ws = self.workspace[info.id] = {
                 "value": deep_copy(self.committed),
                 "read_version": self.committed_version,
                 "written": False,
@@ -147,13 +147,14 @@ class TransactionalGrain(Grain):
     def _txn_storage_type(self, st: TransactionalState) -> str:
         return f"txn:{type(self).__name__}:{st.name}"
 
-    # -- join: register as participant with the TM -----------------------
-    async def _txn_join(self, txn: str) -> None:
-        if txn in self._txn_joined:
+    # -- join: register into the ambient participant set (caller-side
+    # collection — zero TM round trips; the set rides back to the root
+    # on response headers, see transactions/context.py) ------------------
+    def _txn_join(self, info) -> None:
+        if info.id in self._txn_joined:
             return
-        self._txn_joined.add(txn)
-        agent = self._activation.runtime.transactions
-        await agent.join(txn, self.grain_id, type(self).__name__)
+        self._txn_joined.add(info.id)
+        info.join(self.grain_id, type(self).__name__)
 
     # -- 2PC surface called by the TM (interleave: the root caller is
     # blocked awaiting commit while these arrive) ------------------------
