@@ -253,6 +253,24 @@ class DistributedLocator:
     # ------------------------------------------------------------------
     def on_membership_change(self, silos: list[SiloAddress],
                              dead: list[SiloAddress]) -> None:
+        # Catalog.OnSiloStatusChange (Catalog.cs:175,1400 via the
+        # directory callback, LocalGrainDirectory.cs:274-326): local
+        # activations whose directory registration lived on a dead silo's
+        # partition lost that registration with the partition — the next
+        # remote call would mint a duplicate activation elsewhere and the
+        # two would race on storage etags. Deactivate them first (checked
+        # against the pre-update ring, which still maps the dead silo's
+        # range); the next call re-creates and re-registers cleanly.
+        if dead:
+            dead_set = set(dead)
+            catalog = self.silo.catalog
+            for gid, acts in list(catalog.by_grain.items()):
+                if gid.is_system_target():
+                    continue
+                reg_owner = self.ring.owner(gid.uniform_hash)
+                if reg_owner in dead_set:
+                    for act in list(acts):
+                        catalog.schedule_deactivation(act)
         self.ring.update(silos)
         alive = set(silos)
         self.alive_set = alive

@@ -47,6 +47,36 @@ class Dispatcher:
         # in-flight device-tier state recoveries: (class, key_hash) →
         # future; concurrent calls for one recovering key share the load
         self._vector_recoveries: dict = {}
+        # strong refs to every in-flight turn/addressing task: the event
+        # loop holds tasks weakly, so an unreferenced turn can be GC'd
+        # mid-await — its coroutine is then close()d in a foreign context
+        # and the contextvar reset in the finally block raises. This is
+        # the scheduler's owned-work-item discipline (WorkItemGroup.cs:12
+        # owns its queued tasks); also what stop() drains.
+        self._turn_tasks: set[asyncio.Task] = set()
+
+    def _track(self, task: "asyncio.Task | asyncio.Future"):
+        self._turn_tasks.add(task)
+        task.add_done_callback(self._turn_tasks.discard)
+        return task
+
+    async def drain_turns(self, timeout: float | None = None) -> None:
+        """Wait for in-flight turns to finish; cancel stragglers after
+        ``timeout``. Called on graceful silo stop so no turn outlives the
+        runtime that its response path needs."""
+        pending = [t for t in self._turn_tasks if not t.done()]
+        if not pending:
+            return
+        done, still = await asyncio.wait(pending, timeout=timeout)
+        for t in still:
+            t.cancel()
+        if still:
+            await asyncio.gather(*still, return_exceptions=True)
+
+    def cancel_turns(self) -> None:
+        """Abandon all in-flight turns (ungraceful kill)."""
+        for t in list(self._turn_tasks):
+            t.cancel()
 
     # ==================================================================
     # Receive path
@@ -131,8 +161,9 @@ class Dispatcher:
                 # from write-behind storage before the first kernel tick
                 # touches it. Keys with no stored state proceed fresh
                 # (the lazy-recreate contract).
-                fut = asyncio.ensure_future(self._recover_then_call(
-                    rt, vcls, bridge, key_hash, msg.method_name, kwargs))
+                fut = self._track(asyncio.ensure_future(
+                    self._recover_then_call(
+                        rt, vcls, bridge, key_hash, msg.method_name, kwargs)))
             else:
                 fut = rt.call(vcls, key_hash, msg.method_name, **kwargs)
         except Exception as e:  # noqa: BLE001 — schema/arg errors → caller
@@ -214,8 +245,8 @@ class Dispatcher:
     def _handle_incoming(self, activation: ActivationData, msg: Message) -> None:
         """HandleIncomingRequest:399 → schedule the turn."""
         activation.record_running(msg)
-        asyncio.get_running_loop().create_task(
-            self._run_turn(activation, msg))
+        self._track(asyncio.get_running_loop().create_task(
+            self._run_turn(activation, msg)))
 
     async def _run_turn(self, activation: ActivationData, msg: Message) -> None:
         """One turn: invoke the grain method, send the response, pump
@@ -230,6 +261,11 @@ class Dispatcher:
                 resp = make_response(msg, deep_copy(result))
                 self._attach_txn_joins(resp)
                 self.send_response(msg, resp)
+        except asyncio.CancelledError:
+            # silo stop/kill abandoned this turn: no response through a
+            # fabric that may already be torn down — the caller's pending
+            # request is broken by runtime_client.close() instead
+            raise
         except BaseException as e:  # noqa: BLE001 — grain errors flow to caller
             if msg.direction == Direction.REQUEST:
                 resp = make_error_response(msg, e)
@@ -381,8 +417,8 @@ class Dispatcher:
                 msg.target_silo = target
                 self.transmit(msg)
                 return
-            asyncio.get_running_loop().create_task(
-                self._address_and_send(msg, grain_class))
+            self._track(asyncio.get_running_loop().create_task(
+                self._address_and_send(msg, grain_class)))
         else:
             self.transmit(msg)
 

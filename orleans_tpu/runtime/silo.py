@@ -37,6 +37,33 @@ log = logging.getLogger("orleans.silo")
 
 __all__ = ["SiloConfig", "Silo", "SiloBuilder", "ServiceLifecycleStage"]
 
+# eager_task_factory is a per-loop setting shared by every silo on the
+# loop (and the embedding app). Refcount installs so the last silo to
+# stop restores whatever factory the application had before.
+_eager_refs: dict[int, tuple[int, Any]] = {}
+
+
+def _install_eager_factory(loop: asyncio.AbstractEventLoop) -> None:
+    key = id(loop)
+    if key in _eager_refs:
+        n, prev = _eager_refs[key]
+        _eager_refs[key] = (n + 1, prev)
+        return
+    _eager_refs[key] = (1, loop.get_task_factory())
+    loop.set_task_factory(asyncio.eager_task_factory)
+
+
+def _uninstall_eager_factory(loop: asyncio.AbstractEventLoop) -> None:
+    key = id(loop)
+    if key not in _eager_refs:
+        return
+    n, prev = _eager_refs[key]
+    if n <= 1:
+        del _eager_refs[key]
+        loop.set_task_factory(prev)
+    else:
+        _eager_refs[key] = (n - 1, prev)
+
 
 class ServiceLifecycleStage:
     """Ordered stages (Core/Lifecycle/ServiceLifecycleStage.cs)."""
@@ -283,9 +310,8 @@ class Silo:
                      getattr(self.config, f.name))
         self.status = "Joining"
         if self.config.eager_turns:
-            # idempotent across silos sharing one loop
-            asyncio.get_running_loop().set_task_factory(
-                asyncio.eager_task_factory)
+            _install_eager_factory(asyncio.get_running_loop())
+            self._eager_installed = True
         self.message_center.start()          # RuntimeServices
         self.catalog.start()
         self.fabric.register_silo(self)
@@ -306,9 +332,14 @@ class Silo:
         self.status = "ShuttingDown" if graceful else "Dead"
         if not graceful and self.membership is not None:
             self.membership.stop()  # kill: timers die with us, no goodbye row
+        if not graceful:
+            self.dispatcher.cancel_turns()
         if graceful:
             if self.membership is not None:
                 await self.membership.shutdown()
+            # let in-flight turns finish before tearing down the catalog;
+            # stragglers past the deactivation budget are cancelled
+            await self.dispatcher.drain_turns(self.config.deactivation_timeout)
             await self.catalog.stop()
             # push surviving directory entries (grains hosted on OTHER
             # silos) to ring successors — without this their registrations
@@ -324,6 +355,9 @@ class Silo:
         self.message_center.stop()
         self.runtime_client.close()
         self.fabric.unregister_silo(self, dead=not graceful)
+        if getattr(self, "_eager_installed", False):
+            self._eager_installed = False
+            _uninstall_eager_factory(asyncio.get_running_loop())
         self.status = "Stopped"
 
     def register_system_target(self, instance, name: str) -> GrainId:

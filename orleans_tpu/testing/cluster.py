@@ -105,9 +105,16 @@ class TestClusterBuilder:
                                              pull_period=0.05, **kw))
         return self
 
-    def with_transactions(self) -> "TestClusterBuilder":
+    def with_transactions(self, log_provider=None,
+                          shards: int | None = None) -> "TestClusterBuilder":
         from ..transactions import add_transactions
-        self._silo_configurators.append(add_transactions)
+        kw = {}
+        if log_provider is not None:
+            kw["log_provider"] = log_provider
+        if shards is not None:
+            kw["shards"] = shards
+        self._silo_configurators.append(
+            lambda b: add_transactions(b, **kw))
         return self
 
     def with_vector_grains(self, *grain_classes: type,

@@ -13,17 +13,31 @@ record is appended, before any participant hears the outcome. A TM
 activation replays the log on activate (seq + decision map), which is what
 makes TM failover safe: in-doubt participants query ``decision_of`` against
 the recovered map.
+
+Growth is bounded the way the reference truncates below the stable mark
+(TransactionLog.cs): once every participant has acknowledged a decision
+and a retention window has passed, the TM calls ``rewrite`` with the
+records still live; a ``seq`` watermark record preserves the shard's
+version sequence across compactions.
+
+Blocking I/O (fsync, sqlite) runs via ``loop.run_in_executor`` so a
+commit decision never stalls the silo's event loop.
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
 import os
 import sqlite3
+import threading
 from typing import Iterable
 
 __all__ = ["TransactionLog", "InMemoryTransactionLog", "FileTransactionLog",
            "SqliteTransactionLog"]
+
+# decision value reserved for the compaction watermark record
+_SEQ_MARK = "__seq__"
 
 
 class TransactionLog:
@@ -34,8 +48,15 @@ class TransactionLog:
                      version: int) -> None:
         raise NotImplementedError
 
-    async def replay(self, shard: int) -> tuple[int, dict[str, str]]:
-        """Return (max_version_seen, {txn: decision}) for one shard."""
+    async def replay(self, shard: int) -> tuple[int, dict[str, tuple[str, int]]]:
+        """Return (max_version_seen, {txn: (decision, version)}) for one
+        shard."""
+        raise NotImplementedError
+
+    async def rewrite(self, shard: int,
+                      live: dict[str, tuple[str, int]], seq: int) -> None:
+        """Compact: replace the shard's records with ``live`` plus a seq
+        watermark. Other shards' records are preserved."""
         raise NotImplementedError
 
 
@@ -50,75 +71,145 @@ class InMemoryTransactionLog(TransactionLog):
                      version: int) -> None:
         self.records.append((shard, txn, decision, version))
 
-    async def replay(self, shard: int) -> tuple[int, dict[str, str]]:
+    async def replay(self, shard: int) -> tuple[int, dict[str, tuple[str, int]]]:
         return _fold(r for r in self.records if r[0] == shard)
+
+    async def rewrite(self, shard: int,
+                      live: dict[str, tuple[str, int]], seq: int) -> None:
+        self.records = [r for r in self.records if r[0] != shard]
+        self.records.append((shard, "", _SEQ_MARK, seq))
+        self.records.extend((shard, t, d, v) for t, (d, v) in live.items())
 
 
 class FileTransactionLog(TransactionLog):
     """Append-only JSONL file, fsync'd per decision — the durability
-    point of the 2PC (TransactionLog.cs's storage append)."""
+    point of the 2PC (TransactionLog.cs's storage append). The fsync runs
+    in the default executor; a lock serializes writers so compaction's
+    replace-rename cannot race an append."""
 
     def __init__(self, path: str) -> None:
         self.path = path
+        self._io_lock = threading.Lock()
 
     async def append(self, shard: int, txn: str, decision: str,
                      version: int) -> None:
         line = json.dumps({"s": shard, "t": txn, "d": decision,
                            "v": version}, separators=(",", ":"))
-        with open(self.path, "a", encoding="utf-8") as f:
-            f.write(line + "\n")
-            f.flush()
-            os.fsync(f.fileno())
 
-    async def replay(self, shard: int) -> tuple[int, dict[str, str]]:
+        def write() -> None:
+            with self._io_lock, open(self.path, "a", encoding="utf-8") as f:
+                f.write(line + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+
+        await asyncio.get_running_loop().run_in_executor(None, write)
+
+    def _read_all(self) -> list[tuple[int, str, str, int]]:
+        """Callers must hold ``_io_lock`` — an unlocked read can observe
+        a torn half-flushed line from a concurrent append/rewrite."""
         if not os.path.exists(self.path):
-            return 0, {}
+            return []
+        out = []
+        with open(self.path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                r = json.loads(line)
+                out.append((r["s"], r["t"], r["d"], r["v"]))
+        return out
 
-        def rows():
-            with open(self.path, encoding="utf-8") as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    r = json.loads(line)
-                    if r["s"] == shard:
-                        yield r["s"], r["t"], r["d"], r["v"]
+    async def replay(self, shard: int) -> tuple[int, dict[str, tuple[str, int]]]:
+        def read():
+            with self._io_lock:
+                return self._read_all()
 
-        return _fold(rows())
+        rows = await asyncio.get_running_loop().run_in_executor(None, read)
+        return _fold(r for r in rows if r[0] == shard)
+
+    async def rewrite(self, shard: int,
+                      live: dict[str, tuple[str, int]], seq: int) -> None:
+        def compact() -> None:
+            with self._io_lock:  # _read_all is called under the lock here
+                keep = [r for r in self._read_all() if r[0] != shard]
+                keep.append((shard, "", _SEQ_MARK, seq))
+                keep.extend((shard, t, d, v) for t, (d, v) in live.items())
+                tmp = self.path + ".tmp"
+                with open(tmp, "w", encoding="utf-8") as f:
+                    for s, t, d, v in keep:
+                        f.write(json.dumps(
+                            {"s": s, "t": t, "d": d, "v": v},
+                            separators=(",", ":")) + "\n")
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self.path)
+
+        await asyncio.get_running_loop().run_in_executor(None, compact)
 
 
 class SqliteTransactionLog(TransactionLog):
-    """Sqlite-backed log (the AdoNet analog)."""
+    """Sqlite-backed log (the AdoNet analog). One connection, WAL mode,
+    used from the executor; ``close()`` releases it."""
 
     def __init__(self, path: str) -> None:
         self.path = path
-        with self._db() as db:
-            db.execute(
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db_lock = threading.Lock()
+        with self._db_lock:
+            self._db.execute("PRAGMA journal_mode=WAL")
+            self._db.execute(
                 "CREATE TABLE IF NOT EXISTS txn_log ("
                 " shard INTEGER, txn TEXT, decision TEXT, version INTEGER)")
+            self._db.commit()
 
-    def _db(self) -> sqlite3.Connection:
-        return sqlite3.connect(self.path)
+    def close(self) -> None:
+        with self._db_lock:
+            self._db.close()
 
     async def append(self, shard: int, txn: str, decision: str,
                      version: int) -> None:
-        with self._db() as db:
-            db.execute("INSERT INTO txn_log VALUES (?,?,?,?)",
-                       (shard, txn, decision, version))
+        def write() -> None:
+            with self._db_lock:
+                self._db.execute("INSERT INTO txn_log VALUES (?,?,?,?)",
+                                 (shard, txn, decision, version))
+                self._db.commit()
 
-    async def replay(self, shard: int) -> tuple[int, dict[str, str]]:
-        with self._db() as db:
-            rows = db.execute(
-                "SELECT shard, txn, decision, version FROM txn_log"
-                " WHERE shard=?", (shard,)).fetchall()
-        return _fold(rows)
+        await asyncio.get_running_loop().run_in_executor(None, write)
+
+    async def replay(self, shard: int) -> tuple[int, dict[str, tuple[str, int]]]:
+        def read():
+            with self._db_lock:
+                return self._db.execute(
+                    "SELECT shard, txn, decision, version FROM txn_log"
+                    " WHERE shard=?", (shard,)).fetchall()
+
+        return _fold(await asyncio.get_running_loop().run_in_executor(
+            None, read))
+
+    async def rewrite(self, shard: int,
+                      live: dict[str, tuple[str, int]], seq: int) -> None:
+        def compact() -> None:
+            with self._db_lock:
+                self._db.execute("DELETE FROM txn_log WHERE shard=?",
+                                 (shard,))
+                self._db.execute("INSERT INTO txn_log VALUES (?,?,?,?)",
+                                 (shard, "", _SEQ_MARK, seq))
+                self._db.executemany(
+                    "INSERT INTO txn_log VALUES (?,?,?,?)",
+                    [(shard, t, d, v) for t, (d, v) in live.items()])
+                self._db.commit()
+
+        await asyncio.get_running_loop().run_in_executor(None, compact)
 
 
 def _fold(rows: Iterable[tuple[int, str, str, int]]
-          ) -> tuple[int, dict[str, str]]:
+          ) -> tuple[int, dict[str, tuple[str, int]]]:
     seq = 0
-    decisions: dict[str, str] = {}
+    decisions: dict[str, tuple[str, int]] = {}
     for _, txn, decision, version in rows:
-        decisions[txn] = decision
+        if decision == _SEQ_MARK:
+            seq = max(seq, version)
+            continue
+        decisions[txn] = (decision, version)
         seq = max(seq, version)
     return seq, decisions

@@ -33,6 +33,7 @@ from __future__ import annotations
 import asyncio
 import functools
 import logging
+import random
 import time
 from typing import TYPE_CHECKING
 
@@ -57,6 +58,11 @@ __all__ = ["TransactionManagerGrain", "TransactionAgent", "transactional",
 
 DEFAULT_TXN_TIMEOUT = 10.0
 DEFAULT_TM_SHARDS = 4
+# undelivered-outcome redelivery cadence + log compaction policy
+RETRY_PERIOD = 0.5
+ACK_RETENTION = 30.0       # keep acked decisions this long for duplicate
+                           # client retries (well past PREPARE_LOCK_TTL)
+COMPACT_MIN_PRUNABLE = 256
 
 
 @reentrant
@@ -67,7 +73,24 @@ class TransactionManagerGrain(Grain):
 
     def __init__(self) -> None:
         self._seq: int | None = None       # last version this shard issued
-        self._decisions: dict[str, str] = {}
+        # txn -> (decision, commit_version); version 0 for aborts
+        self._decisions: dict[str, tuple[str, int]] = {}
+        self._deciding: dict[str, asyncio.Future] = {}
+        # txn -> [(gid, iface, method, args)] outcome notifications that
+        # failed delivery; re-driven by the redelivery worker so a
+        # participant that missed its commit never holds a stale lock
+        # past one retry period (TransactionManager.cs:709's notification
+        # re-drive)
+        self._undelivered: dict[str, list] = {}
+        # txn -> monotonic time every participant acked the outcome;
+        # compaction prunes acked decisions after ACK_RETENTION
+        self._acked_at: dict[str, float] = {}
+        self._worker: asyncio.Task | None = None
+        # compaction barrier: while set, new decisions wait and the
+        # compactor waits for in-flight appends — otherwise a decision
+        # logged during the rewrite is erased from both disk and memory
+        self._compact_gate: asyncio.Event | None = None
+        self._appends_inflight = 0
 
     @property
     def _cfg(self) -> "TransactionAgent":
@@ -85,6 +108,23 @@ class TransactionManagerGrain(Grain):
         if self._decisions:
             log.info("TM shard %d recovered %d decisions (seq=%d)",
                      shard, len(self._decisions), self._seq)
+            # replayed decisions are already-settled history: their
+            # participants resolved (or will via decision_of) long ago.
+            # Mark them acked from replay time so compaction's retention
+            # window still bounds the log after a failover.
+            now = time.monotonic()
+            for txn in self._decisions:
+                self._acked_at.setdefault(txn, now)
+        self._worker = asyncio.ensure_future(self._redelivery_loop())
+
+    async def on_deactivate(self) -> None:
+        if self._worker is not None:
+            self._worker.cancel()
+            try:
+                await self._worker
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._worker = None
 
     async def commit_transaction(self, txn: str, participants: list,
                                  deadline: float) -> bool:
@@ -93,56 +133,175 @@ class TransactionManagerGrain(Grain):
         caller's agent."""
         prior = self._decisions.get(txn)
         if prior is not None:            # duplicate commit (client retry)
-            return prior == "committed"
+            return prior[0] == "committed"
         if time.time() > deadline:
             await self._decide(txn, "aborted")
-            await self._fanout(participants, "_txn_abort", txn)
+            await self._fanout(txn, participants, "_txn_abort", txn)
             return False
-        votes = await asyncio.gather(
-            *(self._call(gid, iface, "_txn_prepare", txn)
-              for gid, iface in participants),
-            return_exceptions=True)
+        votes = await _collect(
+            [self._call(gid, iface, "_txn_prepare", txn)
+             for gid, iface in participants])
         if all(v is True for v in votes):
             shard = int(self.grain_id.key)
             n = self._cfg.shards
-            # shard-namespaced monotone sequence: globally distinct
+            # shard-namespaced monotone sequence, reserved synchronously
+            # (no await between read and advance): globally distinct
             self._seq = (self._seq + n) if self._seq else (shard + n)
-            version = self._seq
-            await self._decide(txn, "committed", version)
-            await self._fanout(participants, "_txn_commit", txn, version)
+            decision, version = await self._decide(txn, "committed",
+                                                   self._seq)
+            if decision == "committed":
+                await self._fanout(txn, participants, "_txn_commit", txn,
+                                   version)
+                return True
+            await self._fanout(txn, participants, "_txn_abort", txn)
+            return False
+        decision, version = await self._decide(txn, "aborted")
+        if decision == "committed":      # lost race with a duplicate commit
+            await self._fanout(txn, participants, "_txn_commit", txn, version)
             return True
-        await self._decide(txn, "aborted")
-        await self._fanout(participants, "_txn_abort", txn)
+        await self._fanout(txn, participants, "_txn_abort", txn)
         return False
 
     async def abort_transaction(self, txn: str, participants: list) -> None:
-        await self._decide(txn, "aborted")
-        await self._fanout(participants, "_txn_abort", txn)
+        decision, version = await self._decide(txn, "aborted")
+        if decision == "committed":
+            # late/duplicate abort for an already-committed txn: the
+            # logged decision wins — redeliver the commit instead of
+            # overwriting it (a recovered TM must never replay a commit
+            # as an abort)
+            await self._fanout(txn, participants, "_txn_commit", txn, version)
+            return
+        await self._fanout(txn, participants, "_txn_abort", txn)
 
-    async def decision_of(self, txn: str) -> str | None:
-        return self._decisions.get(txn)
+    async def decision_of(self, txn: str,
+                          resolve: bool = False) -> tuple[str, int] | None:
+        """(decision, commit_version) or None. The version lets an
+        in-doubt participant apply a missed commit, not just learn of it.
+
+        ``resolve=True`` (participant in-doubt resolution) makes presumed
+        abort DURABLE: an unknown transaction is logged as aborted before
+        answering, so a commit racing this inquiry (e.g. a 2PC whose vote
+        gather outlived the prepare-lock TTL) loses to the recorded abort
+        instead of committing on participants that already dropped their
+        prepares."""
+        prior = self._decisions.get(txn)
+        if prior is not None:
+            return prior
+        pending = self._deciding.get(txn)
+        if pending is not None:
+            return await pending
+        if resolve:
+            rec = await self._decide(txn, "aborted")
+            # the inquiring participant IS the resolution — no fanout
+            # will ever ack this record, so mark it prunable now
+            self._acked_at[txn] = time.monotonic()
+            return rec
+        return None
 
     # -- internals -------------------------------------------------------
     async def _decide(self, txn: str, decision: str,
-                      version: int = 0) -> None:
+                      version: int = 0) -> tuple[str, int]:
         """Write-ahead: the log append IS the commit point
-        (TransactionLog.cs) — participants are only told afterwards."""
-        await self._cfg.log.append(int(self.grain_id.key), txn, decision,
-                                   version)
-        self._decisions[txn] = decision
-
-    async def _fanout(self, participants: list, method: str, *args) -> None:
-        async def one(gid, iface):
+        (TransactionLog.cs) — participants are only told afterwards.
+        Idempotent: a prior decision (in-memory or being appended) always
+        wins; returns the winning (decision, version)."""
+        prior = self._decisions.get(txn)
+        if prior is not None:
+            return prior
+        pending = self._deciding.get(txn)
+        if pending is not None:          # concurrent commit/abort race
+            return await pending
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._deciding[txn] = fut
+        try:
+            while self._compact_gate is not None:   # wait out compaction
+                await self._compact_gate.wait()
+            self._appends_inflight += 1
             try:
-                await self._call(gid, iface, method, *args)
-            except Exception:  # noqa: BLE001 — decision is logged; the
-                # participant re-syncs from storage/decision_of on
-                # reactivation (lock-TTL steal covers stuck prepares)
-                log.warning("%s delivery failed for %s", method, gid,
-                            exc_info=True)
+                await self._cfg.log.append(int(self.grain_id.key), txn,
+                                           decision, version)
+            finally:
+                self._appends_inflight -= 1
+            rec = (decision, version)
+            self._decisions[txn] = rec
+            fut.set_result(rec)
+            return rec
+        except BaseException as e:
+            fut.set_exception(e)
+            fut.exception()              # consumed; avoid unretrieved warn
+            raise
+        finally:
+            self._deciding.pop(txn, None)
 
-        await asyncio.gather(*(one(gid, iface)
-                               for gid, iface in participants))
+    async def _fanout(self, txn: str, participants: list, method: str,
+                      *args) -> None:
+        failed: list = []
+        outcomes = await _collect(
+            [self._call(gid, iface, method, *args)
+             for gid, iface in participants])
+        for (gid, iface), out in zip(participants, outcomes):
+            if isinstance(out, BaseException):
+                # decision is logged; queue for redelivery (plus
+                # participant-side decision_of resolution on lock
+                # expiry / reactivation)
+                log.warning("%s delivery failed for %s: %r", method, gid,
+                            out)
+                failed.append((gid, iface, method, args))
+        if failed:
+            self._undelivered.setdefault(txn, []).extend(failed)
+        else:
+            self._acked_at[txn] = time.monotonic()
+
+    async def _redelivery_loop(self) -> None:
+        """Re-drive undelivered outcome notifications and compact the
+        decision log once acked decisions age out (the reference's
+        truncation below the stable mark)."""
+        while True:
+            await asyncio.sleep(RETRY_PERIOD)
+            if self._activation.runtime.status not in ("Running", "Joining"):
+                return  # silo killed/stopped: a dead silo must not keep
+                        # driving 2PC outcomes (on_deactivate never ran)
+            for txn in list(self._undelivered):
+                queue = self._undelivered.pop(txn, [])
+                still: list = []
+                for gid, iface, method, args in queue:
+                    try:
+                        await self._call(gid, iface, method, *args)
+                    except Exception:  # noqa: BLE001
+                        still.append((gid, iface, method, args))
+                if still:
+                    self._undelivered[txn] = still
+                else:
+                    self._acked_at[txn] = time.monotonic()
+            await self._maybe_compact()
+
+    async def _maybe_compact(self) -> None:
+        now = time.monotonic()
+        prunable = [t for t, at in self._acked_at.items()
+                    if now - at > ACK_RETENTION]
+        if len(prunable) < COMPACT_MIN_PRUNABLE:
+            return
+        if self._compact_gate is not None:
+            return
+        gate = self._compact_gate = asyncio.Event()
+        try:
+            # quiesce: no snapshot until in-flight appends land, and no
+            # new appends until the rewrite finishes (the gate in _decide)
+            while self._appends_inflight:
+                await asyncio.sleep(0.001)  # executor fsync may take ms
+            pruned = set(prunable)
+            live = {t: d for t, d in self._decisions.items()
+                    if t not in pruned}
+            await self._cfg.log.rewrite(int(self.grain_id.key), live,
+                                        self._seq or 0)
+            self._decisions = live
+            for t in prunable:
+                self._acked_at.pop(t, None)
+            log.info("TM shard %s compacted %d decisions (%d live)",
+                     self.grain_id.key, len(prunable), len(live))
+        finally:
+            self._compact_gate = None
+            gate.set()
 
     def _call(self, grain_id: GrainId, iface: str, method: str, *args):
         silo = self._activation.runtime
@@ -156,6 +315,27 @@ class TransactionManagerGrain(Grain):
             target_grain=grain_id, grain_class=cls, interface_name=iface,
             method_name=method, args=args, kwargs={},
             is_always_interleave=True)
+
+
+async def _collect(calls: list) -> list:
+    """Await every call, mapping exceptions to values (the
+    gather(return_exceptions=True) contract) WITHOUT wrapping each call
+    in a Task: the 2PC rounds are mostly direct local coroutines, where
+    sequential awaits do the same work minus a task creation per
+    participant; remote calls are already-transmitted futures, so their
+    round trips still overlap."""
+    out = []
+    for c in calls:
+        try:
+            out.append(await c)
+        except asyncio.CancelledError:
+            # parent turn cancelled (silo stop/kill): propagate — a
+            # cancelled 2PC round must not keep driving the protocol
+            # against a tearing-down runtime
+            raise
+        except BaseException as e:  # noqa: BLE001
+            out.append(e)
+    return out
 
 
 def _local_always_interleave_call(silo, grain_id: GrainId, method: str,
@@ -224,8 +404,9 @@ class TransactionAgent:
         await self._tm_call(info.id, "abort_transaction", info.id,
                             list(info.participants.values()))
 
-    async def decision_of(self, txn_id: str) -> str | None:
-        return await self._tm_call(txn_id, "decision_of", txn_id)
+    async def decision_of(self, txn_id: str,
+                          resolve: bool = False) -> tuple[str, int] | None:
+        return await self._tm_call(txn_id, "decision_of", txn_id, resolve)
 
 
 def transactional(fn=None, *, option: str = "required"):
@@ -254,20 +435,34 @@ def transactional(fn=None, *, option: str = "required"):
             if agent is None:
                 raise TransactionError(
                     "no transaction agent installed (add_transactions)")
-            info = agent.start()
-            set_ambient_txn(info)
-            try:
-                result = await fn(self, *args, **kwargs)
-            except BaseException:
+            # Root scope: optimistic-conflict aborts retry with fresh
+            # reads until the original deadline (the standard OCC retry
+            # loop; the reference's TransactionalState resolves the same
+            # conflicts by queueing on locks). Application exceptions
+            # abort once and propagate — only validation conflicts retry.
+            retry_deadline = time.time() + DEFAULT_TXN_TIMEOUT
+            attempt = 0
+            while True:
+                info = agent.start()
+                set_ambient_txn(info)
+                try:
+                    result = await fn(self, *args, **kwargs)
+                except BaseException:
+                    clear_ambient_txn()
+                    await agent.abort(info)
+                    raise
                 clear_ambient_txn()
-                await agent.abort(info)
-                raise
-            clear_ambient_txn()
-            if not await agent.commit(info):
-                raise TransactionAbortedError(
-                    f"transaction {info.id} aborted (conflict or "
-                    "participant failure)")
-            return result
+                if await agent.commit(info):
+                    return result
+                attempt += 1
+                if time.time() >= retry_deadline:
+                    raise TransactionAbortedError(
+                        f"transaction {info.id} aborted after {attempt} "
+                        "attempts (conflict or participant failure)")
+                # jittered backoff: colliding retries must desynchronize
+                await asyncio.sleep(
+                    min(0.0005 * (2 ** min(attempt, 5)), 0.01)
+                    * (0.5 + random.random()))
 
         wrapper.__orleans_transaction__ = option
         return wrapper

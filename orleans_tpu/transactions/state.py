@@ -23,6 +23,7 @@ Usage::
 
 from __future__ import annotations
 
+import asyncio
 import time
 from typing import Any
 
@@ -34,6 +35,7 @@ from .context import ambient_txn
 __all__ = ["TransactionalState", "TransactionalGrain"]
 
 PREPARE_LOCK_TTL = 10.0  # steal an expired lock: TM died mid-2PC
+COMMIT_WAIT = 0.05       # max wait for an in-flight commit before reading
 
 
 class TransactionalState:
@@ -51,11 +53,26 @@ class TransactionalState:
         self.workspace: dict[str, dict] = {}
         self.lock: tuple[str, float] | None = None  # (txn id, deadline)
         self._etag: str | None = None  # storage etag of the committed row
+        # durably-prepared write awaiting its outcome: {"txn", "value",
+        # "read_version", "written": True}. Persisted at prepare time so a
+        # participant crash between prepare and commit cannot lose a write
+        # the TM logged as committed (the prepare-record half of
+        # TransactionalState.cs's persistence protocol).
+        self.pending_prepare: dict | None = None
+        self._prep_etag: str | None = None
+        self._release_event: asyncio.Event | None = None
 
     # -- grain-facing API (PerformRead/PerformUpdate) -------------------
     async def get(self) -> Any:
         info = ambient_txn()
         if info is None:
+            if self.pending_prepare is not None and self.owner is not None:
+                now = time.time()
+                if self.lock is None or self.lock[1] <= now:
+                    # an in-doubt prepared write outlived its lock: settle
+                    # it so non-transactional reads don't serve a value a
+                    # logged commit is about to replace
+                    await self.owner._resolve_in_doubt(now)
             return deep_copy(self.committed)
         ws = await self._enter(info)
         return ws["value"]
@@ -70,9 +87,46 @@ class TransactionalState:
         ws["value"] = value
         ws["written"] = True
 
+    def _busy_for(self, txn: str) -> bool:
+        """Another transaction holds a prepare lock (mid-commit — settles
+        within a 2PC round trip). Write INTENT deliberately does not
+        block entry: intents are held for a whole root-call span, so
+        waiting on them convoys opposite-order acquisitions into
+        COMMIT_WAIT stalls (measured 5× throughput loss); stale reads
+        against an intent settle cheaply via prepare-abort + retry."""
+        return self.lock is not None and self.lock[0] != txn
+
+    def _signal_release(self) -> None:
+        ev = self._release_event
+        if ev is not None:
+            ev.set()
+
     async def _enter(self, info) -> dict:
         ws = self.workspace.get(info.id)
         if ws is None:
+            if self._busy_for(info.id):
+                # another transaction is mid-commit (prepare lock) or has
+                # an uncommitted write on this state: wait briefly for it
+                # to settle instead of snapshotting a version that is
+                # about to be replaced — a read now is doomed at prepare.
+                # This is the lock-queue behavior of the reference's
+                # TransactionalState (State/TransactionalState.cs:611);
+                # the read-version check at prepare remains the safety
+                # net, and the COMMIT_WAIT bound prevents opposite-order
+                # acquisition deadlocks.
+                deadline = time.time() + COMMIT_WAIT
+                while self._busy_for(info.id):
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        break
+                    ev = self._release_event
+                    if ev is None:
+                        ev = self._release_event = asyncio.Event()
+                    ev.clear()
+                    try:
+                        await asyncio.wait_for(ev.wait(), remaining)
+                    except asyncio.TimeoutError:
+                        break
             self.owner._txn_join(info)
             ws = self.workspace[info.id] = {
                 "value": deep_copy(self.committed),
@@ -89,6 +143,12 @@ class TransactionalState:
         if self.lock is not None and self.lock[1] > now and \
                 self.lock[0] != txn:
             return False  # another transaction is mid-commit on this state
+        if self.pending_prepare is not None and \
+                self.pending_prepare["txn"] != txn:
+            # an in-doubt durable prepare survived resolution (TM
+            # unreachable): its write may still commit — refuse to
+            # validate over it even though the lock expired
+            return False
         if ws["read_version"] != self.committed_version:
             return False  # someone committed since we read
         self.lock = (txn, now + PREPARE_LOCK_TTL)
@@ -99,6 +159,15 @@ class TransactionalState:
         ws = self.workspace.pop(txn, None)
         if self.lock is not None and self.lock[0] == txn:
             self.lock = None
+        if self.pending_prepare is not None and \
+                self.pending_prepare["txn"] == txn:
+            if ws is None:
+                # crash-recovered prepare: the in-memory workspace died
+                # with the previous activation, but the durable prepare
+                # record carries the write
+                ws = self.pending_prepare
+            self.pending_prepare = None
+        self._signal_release()
         if ws is None or not ws["written"]:
             return False
         self.committed = ws["value"]
@@ -109,6 +178,10 @@ class TransactionalState:
         self.workspace.pop(txn, None)
         if self.lock is not None and self.lock[0] == txn:
             self.lock = None
+        if self.pending_prepare is not None and \
+                self.pending_prepare["txn"] == txn:
+            self.pending_prepare = None
+        self._signal_release()
 
 
 class TransactionalGrain(Grain):
@@ -130,9 +203,10 @@ class TransactionalGrain(Grain):
                 out.append(v)
         return out
 
-    # -- lifecycle: recover committed values from storage ----------------
+    # -- lifecycle: recover committed values + in-doubt prepares ---------
     async def on_activate(self) -> None:
         silo = self._activation.runtime
+        now = time.time()
         for st in self._txn_states():
             provider = silo.storage_manager.get(st.storage_name)
             if provider is None:
@@ -143,9 +217,95 @@ class TransactionalGrain(Grain):
             if data is not None:
                 st.committed = data["value"]
                 st.committed_version = data["version"]
+            prep, petag = await provider.read(
+                self._txn_prep_type(st), self.grain_id)
+            st._prep_etag = petag
+            if prep is not None and \
+                    prep["read_version"] >= st.committed_version:
+                # the previous activation died between prepare and
+                # outcome: hold the prepare (locked) and ask the TM
+                # (a prepare whose read_version is already stale lost
+                # its transaction — the commit round would have bumped
+                # committed_version past it — so it is droppable)
+                st.pending_prepare = prep
+                st.lock = (prep["txn"], now + PREPARE_LOCK_TTL)
+        await self._resolve_in_doubt(now, force_query=True)
 
     def _txn_storage_type(self, st: TransactionalState) -> str:
         return f"txn:{type(self).__name__}:{st.name}"
+
+    def _txn_prep_type(self, st: TransactionalState) -> str:
+        return f"txnprep:{type(self).__name__}:{st.name}"
+
+    async def _resolve_in_doubt(self, now: float,
+                                force_query: bool = False) -> None:
+        """Resolve held prepares whose outcome never arrived by asking
+        the transaction's TM shard (``decision_of`` against the durable
+        decision log) — committed → apply the prepared write; aborted →
+        drop it; unknown after the lock expired → presumed abort (the TM
+        logs before announcing, so an unknown txn can never later commit
+        without a fresh prepare round). ``force_query=True`` (reactivation)
+        queries even while the lock is fresh, so a decision the previous
+        incarnation missed applies immediately; an unknown outcome is then
+        held until expiry in case the 2PC is still in flight."""
+        silo = self._activation.runtime
+        agent = getattr(silo, "transactions", None)
+        for st in self._txn_states():
+            pending = st.pending_prepare
+            if pending is None:
+                continue
+            expired = (st.lock is None or st.lock[1] <= now
+                       or st.lock[0] != pending["txn"])
+            if not expired and not force_query:
+                continue                  # outcome may still be in flight
+            decision = None
+            reachable = False
+            if agent is not None:
+                try:
+                    # resolve=True on expiry: the TM logs a durable
+                    # presumed-abort for an unknown txn, so a slow 2PC
+                    # can no longer commit after we drop the prepare
+                    decision = await agent.decision_of(
+                        pending["txn"], resolve=expired)
+                    reachable = True
+                except Exception:  # noqa: BLE001 — TM unreachable: leave
+                    # the prepare held; the next prepare/retry re-asks
+                    continue
+            if decision is not None and decision[0] == "committed":
+                if st.commit(pending["txn"], decision[1]):
+                    await self._persist_committed(st, silo)
+                await self._clear_prepare(st, silo)
+            elif decision is not None:
+                st.abort(pending["txn"])
+                await self._clear_prepare(st, silo)
+            elif reachable and expired:
+                # the authoritative shard has no record: presumed abort
+                st.abort(pending["txn"])
+                await self._clear_prepare(st, silo)
+            # else: unknown but lock still fresh — hold for the outcome
+
+    async def _persist_committed(self, st: TransactionalState, silo) -> None:
+        provider = silo.storage_manager.get(st.storage_name)
+        if provider is not None:
+            st._etag = await provider.write(
+                self._txn_storage_type(st), self.grain_id,
+                {"value": st.committed, "version": st.committed_version},
+                etag=st._etag)
+
+    async def _persist_prepare(self, st: TransactionalState, silo,
+                               prep: dict) -> None:
+        provider = silo.storage_manager.get(st.storage_name)
+        if provider is not None:
+            st._prep_etag = await provider.write(
+                self._txn_prep_type(st), self.grain_id, prep,
+                etag=st._prep_etag)
+
+    async def _clear_prepare(self, st: TransactionalState, silo) -> None:
+        provider = silo.storage_manager.get(st.storage_name)
+        if provider is not None and st._prep_etag is not None:
+            await provider.clear(self._txn_prep_type(st), self.grain_id,
+                                 st._prep_etag)
+            st._prep_etag = None
 
     # -- join: register into the ambient participant set (caller-side
     # collection — zero TM round trips; the set rides back to the root
@@ -161,9 +321,36 @@ class TransactionalGrain(Grain):
     @always_interleave
     async def _txn_prepare(self, txn: str) -> bool:
         now = time.time()
-        votes = [st.prepare(txn, now) for st in self._txn_states()]
+        states = self._txn_states()
+        if any(st.pending_prepare is not None
+               and (st.lock is None or st.lock[1] <= now
+                    or st.lock[0] != st.pending_prepare["txn"])
+               for st in states):
+            # an earlier transaction's outcome never arrived and its lock
+            # expired: resolve it via the TM's durable decision before
+            # voting — stealing the lock blind would let this transaction
+            # validate against a read_version the in-doubt commit is
+            # about to bump (the divergence the decision log exists to
+            # prevent)
+            await self._resolve_in_doubt(now)
+        votes = [st.prepare(txn, now) for st in states]
         if not all(votes):
-            for st in self._txn_states():
+            for st in states:
+                st.abort(txn)
+            self._txn_joined.discard(txn)
+            return False
+        silo = self._activation.runtime
+        try:
+            for st in states:
+                ws = st.workspace.get(txn)
+                if ws is not None and ws["written"]:
+                    prep = {"txn": txn, "value": ws["value"],
+                            "read_version": ws["read_version"],
+                            "written": True}
+                    st.pending_prepare = prep
+                    await self._persist_prepare(st, silo, prep)
+        except Exception:  # noqa: BLE001 — durable prepare failed: vote no
+            for st in states:
                 st.abort(txn)
             self._txn_joined.discard(txn)
             return False
@@ -173,18 +360,21 @@ class TransactionalGrain(Grain):
     async def _txn_commit(self, txn: str, commit_version: int) -> None:
         silo = self._activation.runtime
         for st in self._txn_states():
+            had_prepare = st.pending_prepare is not None and \
+                st.pending_prepare["txn"] == txn
             if st.commit(txn, commit_version):
-                provider = silo.storage_manager.get(st.storage_name)
-                if provider is not None:
-                    st._etag = await provider.write(
-                        self._txn_storage_type(st), self.grain_id,
-                        {"value": st.committed,
-                         "version": st.committed_version},
-                        etag=st._etag)
+                await self._persist_committed(st, silo)
+            if had_prepare:
+                await self._clear_prepare(st, silo)
         self._txn_joined.discard(txn)
 
     @always_interleave
     async def _txn_abort(self, txn: str) -> None:
+        silo = self._activation.runtime
         for st in self._txn_states():
+            had_prepare = st.pending_prepare is not None and \
+                st.pending_prepare["txn"] == txn
             st.abort(txn)
+            if had_prepare:
+                await self._clear_prepare(st, silo)
         self._txn_joined.discard(txn)
