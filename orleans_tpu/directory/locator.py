@@ -61,6 +61,23 @@ class DirectoryTarget:
         self.locator.local_unregister(address)
         return True
 
+    async def dir_drop_stale(self, grain_id: GrainId, silo: SiloAddress,
+                             live_activations: list) -> bool:
+        """Drop a registration that points at ``silo`` unless it names one
+        of the activations ``silo`` reports live — the directory half of
+        UnregisterAfterNonexistingActivation (Catalog.cs:29 rejection →
+        LocalGrainDirectory cleanup): without this, an entry left behind
+        by a dead activation (e.g. planted by a re-range handoff that
+        raced a deactivation) ping-pongs every lookup into the forward
+        limit forever."""
+        cur = self.locator.partition.get(grain_id)
+        if cur is not None and cur.silo == silo and \
+                cur.activation not in live_activations:
+            self.locator.partition.pop(grain_id, None)
+            self.locator.cache.pop(grain_id, None)
+            return True
+        return False
+
     async def dir_handoff(self, entries: list):
         """Bulk-receive partition entries from a re-ranging peer
         (GrainDirectoryHandoffManager)."""
@@ -199,6 +216,29 @@ class DistributedLocator:
     def invalidate_cache(self, grain_id: GrainId) -> None:
         self.cache.pop(grain_id, None)
 
+    async def unregister_after_nonexistent(self, grain_id: GrainId) -> None:
+        """This silo received a message for ``grain_id`` but hosts no such
+        activation: tell the directory owner to drop any registration
+        pointing here (unless it names an activation that is in fact
+        live — a re-creation racing this report keeps its entry)."""
+        live = [a.activation_id
+                for a in self.silo.catalog.by_grain.get(grain_id, [])]
+        owner = self.ring.owner(grain_id.uniform_hash)
+        me = self.silo.silo_address
+        try:
+            if owner is None or owner == me:
+                cur = self.partition.get(grain_id)
+                if cur is not None and cur.silo == me and \
+                        cur.activation not in live:
+                    self.partition.pop(grain_id, None)
+                    self.cache.pop(grain_id, None)
+            else:
+                await self._target_ref(owner, "dir_drop_stale", grain_id,
+                                       me, live)
+        except Exception:  # noqa: BLE001 — best-effort heal; the next
+            # miss reports again
+            log.debug("stale-entry report failed for %s", grain_id)
+
     # ------------------------------------------------------------------
     # Owner-side partition ops
     # ------------------------------------------------------------------
@@ -270,7 +310,11 @@ class DistributedLocator:
                 reg_owner = self.ring.owner(gid.uniform_hash)
                 if reg_owner in dead_set:
                     for act in list(acts):
-                        catalog.schedule_deactivation(act)
+                        # stateless workers are never directory-registered
+                        # (catalog._init_activation skips them) — nothing
+                        # of theirs died with the partition
+                        if not act.is_stateless_worker:
+                            catalog.schedule_deactivation(act)
         self.ring.update(silos)
         alive = set(silos)
         self.alive_set = alive

@@ -190,19 +190,48 @@ class MessageCenter:
                 rej.target_silo = msg.sending_silo
                 self.silo.fabric.deliver(rej)
             return
-        self.inbound[msg.category].put_nowait(msg)
+        q = self.inbound[msg.category]
+        if not q.qsize() and not cfg.load_shedding_enabled:
+            # (with shedding on, ingress must accumulate in the queue —
+            # queue depth IS the shed signal)
+            # hot-path shortcut: nothing queued ahead of this message, so
+            # routing inline preserves FIFO while skipping a queue hop +
+            # pump-task wakeup per message (the asyncio analog of the
+            # reference's inline WorkItemGroup execution; silo-to-self
+            # sends already short-circuit the same way in
+            # Dispatcher.transmit). Backlogged categories keep the queue
+            # so shedding and fairness still apply.
+            try:
+                self._route(msg)
+            except Exception:  # noqa: BLE001 — same contract as the pump
+                log.exception("inbound routing failed for %s",
+                              msg.method_name)
+            return
+        q.put_nowait(msg)
 
     async def _pump(self, cat: Category) -> None:
         q = self.inbound[cat]
         while True:
             msg = await q.get()
-            try:
-                self._route(msg)
-            except Exception:  # noqa: BLE001
-                log.exception("inbound routing failed for %s", msg.method_name)
+            while True:
+                try:
+                    self._route(msg)
+                except Exception:  # noqa: BLE001
+                    log.exception("inbound routing failed for %s",
+                                  msg.method_name)
+                # drain whatever else arrived in one wakeup (the
+                # IncomingMessageAgent drains its queue per scheduling
+                # round, not one message per thread turn)
+                try:
+                    msg = q.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+
+    _RECEIVED_STAT = {c: f"messaging.received.{c.name.lower()}"
+                      for c in Category}
 
     def _route(self, msg: Message) -> None:
-        self.silo.stats.increment(f"messaging.received.{msg.category.name.lower()}")
+        self.silo.stats.increment(self._RECEIVED_STAT[msg.category])
         if msg.direction != Direction.RESPONSE and (
                 msg.target_silo is None
                 or msg.target_silo != self.silo.silo_address):
@@ -330,6 +359,9 @@ class Silo:
         if self.status == "Stopped":
             return
         self.status = "ShuttingDown" if graceful else "Dead"
+        invalidate = getattr(self.fabric, "invalidate_alive_cache", None)
+        if invalidate is not None:
+            invalidate()  # stop routing client ingress to this silo now
         if not graceful and self.membership is not None:
             self.membership.stop()  # kill: timers die with us, no goodbye row
         if not graceful:
