@@ -178,6 +178,11 @@ class GrainId:
         hash; one good 64-bit hash serves both here)."""
         return self._hash64
 
+    def __hash__(self) -> int:
+        # grain ids key every hot dict (catalog, directory, caches); the
+        # precomputed 64-bit hash beats re-hashing the field tuple per op
+        return self._hash64
+
     def is_client(self) -> bool:
         return self.category == GrainCategory.CLIENT
 
@@ -203,6 +208,12 @@ class SiloAddress:
     port: int
     generation: int
     mesh_index: int = -1
+    _uh: int = field(default=-1, compare=False, repr=False)
+
+    def __post_init__(self) -> None:
+        if self._uh < 0:
+            object.__setattr__(self, "_uh", stable_hash64(
+                f"silo|{self.host}|{self.port}|{self.generation}"))
 
     @property
     def endpoint(self) -> str:
@@ -210,7 +221,10 @@ class SiloAddress:
 
     @property
     def uniform_hash(self) -> int:
-        return stable_hash64(f"silo|{self.host}|{self.port}|{self.generation}")
+        return self._uh
+
+    def __hash__(self) -> int:
+        return self._uh
 
     def same_endpoint(self, other: "SiloAddress") -> bool:
         return self.host == other.host and self.port == other.port

@@ -81,6 +81,32 @@ def deep_copy(obj: Any) -> Any:
     return copy.deepcopy(obj)
 
 
+_SCALAR_TYPES = frozenset((int, float, str, bytes, bool, type(None),
+                           complex))
+
+
+def copy_call_body(args: tuple, kwargs: dict) -> tuple:
+    """Copy-isolate an RPC body. The dominant call shape — a few scalar
+    positional args, no kwargs — shares by reference (scalars are
+    immutable); anything else takes the full deep-copy walk. This is the
+    hand-rolled analog of the reference's codegen'd per-signature copiers
+    (SerializationManager.cs:173-201)."""
+    if not kwargs:
+        for a in args:
+            if type(a) not in _SCALAR_TYPES:
+                break
+        else:
+            return args, kwargs
+    return deep_copy((args, kwargs))
+
+
+def copy_result(result: Any) -> Any:
+    """Copy-isolate an RPC result; scalars pass through untouched."""
+    if type(result) in _SCALAR_TYPES:
+        return result
+    return deep_copy(result)
+
+
 def serialize(obj: Any) -> bytes:
     """Wire-tier encode (fallback-serializer slot, ``SerializationManager.cs:50``).
 

@@ -37,6 +37,7 @@ class InProcFabric:
         self.silos: dict[SiloAddress, Any] = {}
         self.clients: dict[SiloAddress, "ClusterClient"] = {}
         self.dead: set[SiloAddress] = set()
+        self._alive_cache: list[SiloAddress] | None = None
         self._ports = itertools.count(11111)
         self._generation = itertools.count(1)
         # ordered pairs of endpoints whose traffic is dropped (partition tests)
@@ -53,13 +54,20 @@ class InProcFabric:
     def register_silo(self, silo) -> None:
         self.silos[silo.silo_address] = silo
         self.dead.discard(silo.silo_address)
+        self._alive_cache = None
         self._broadcast_membership()
 
     def unregister_silo(self, silo, dead: bool = False) -> None:
         self.silos.pop(silo.silo_address, None)
         if dead:
             self.dead.add(silo.silo_address)
+        self._alive_cache = None
         self._broadcast_membership(dead=[silo.silo_address] if dead else [])
+
+    def invalidate_alive_cache(self) -> None:
+        """Called on silo status transitions (e.g. Running→ShuttingDown)
+        that change gateway eligibility without (un)registration."""
+        self._alive_cache = None
 
     def _broadcast_membership(self, dead: list[SiloAddress] | None = None) -> None:
         """Fan membership changes to every silo's locator. When a membership
@@ -81,12 +89,17 @@ class InProcFabric:
         self.clients.pop(client.silo_address, None)
 
     def is_dead(self, addr: SiloAddress) -> bool:
-        return addr in self.dead or (
-            addr not in self.silos and addr not in self.clients)
+        # dead ⊆ unregistered (unregister_silo removes + marks), so one
+        # membership test decides
+        return not (addr in self.silos or addr in self.clients)
 
     def alive_silos(self) -> list[SiloAddress]:
-        return [a for a, s in self.silos.items() if s.status in
-                ("Running", "Joining")]
+        cached = self._alive_cache
+        if cached is None:
+            cached = self._alive_cache = [
+                a for a, s in self.silos.items()
+                if s.status in ("Running", "Joining")]
+        return cached
 
     # -- fault injection --------------------------------------------------
     def partition(self, a: SiloAddress, b: SiloAddress) -> None:

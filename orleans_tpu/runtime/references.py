@@ -10,7 +10,6 @@ messages through the runtime client.
 
 from __future__ import annotations
 
-import functools
 from typing import TYPE_CHECKING, Any
 
 from ..core.ids import GrainId
@@ -29,7 +28,8 @@ class GrainRef:
     ``@one_way`` return None immediately (fire-and-forget).
     """
 
-    __slots__ = ("grain_class", "grain_id", "_client", "_methods")
+    __slots__ = ("grain_class", "grain_id", "_client", "_methods",
+                 "_invokers")
 
     def __init__(self, grain_class: type, grain_id: GrainId,
                  client: "RuntimeClient"):
@@ -37,27 +37,43 @@ class GrainRef:
         self.grain_id = grain_id
         self._client = client
         self._methods = remote_methods(grain_class)
+        self._invokers: dict[str, Any] = {}
 
     def __getattr__(self, name: str):
+        # bound invoker closures are cached per method with the call
+        # flags pre-resolved — the per-call work of the codegen'd proxy
+        # method body (GrainReferenceGenerator.cs:22 emits exactly this)
+        hit = self._invokers.get(name)
+        if hit is not None:
+            return hit
         fn = self._methods.get(name)
         if fn is None:
             raise AttributeError(
                 f"{self.grain_class.__name__} has no remote method {name!r} "
                 f"(remote methods are public async defs)")
-        return functools.partial(self._invoke, name, fn)
+        client = self._client
+        gid, cls = self.grain_id, self.grain_class
+        iface = cls.__name__
+        read_only = getattr(fn, "__orleans_read_only__", False)
+        interleave = getattr(fn, "__orleans_always_interleave__", False)
+        one_way = getattr(fn, "__orleans_one_way__", False)
+
+        def invoke(*args: Any, **kwargs: Any):
+            # skip the filter-dispatch wrapper when no filters are
+            # registered (checked per call: filters may be added later)
+            send = (client.send_request if client.outgoing_call_filters
+                    else client._send_request_unfiltered)
+            return send(
+                target_grain=gid, grain_class=cls, interface_name=iface,
+                method_name=name, args=args, kwargs=kwargs,
+                is_read_only=read_only, is_always_interleave=interleave,
+                is_one_way=one_way)
+
+        self._invokers[name] = invoke
+        return invoke
 
     def _invoke(self, name: str, fn, *args: Any, **kwargs: Any):
-        return self._client.send_request(
-            target_grain=self.grain_id,
-            grain_class=self.grain_class,
-            interface_name=self.grain_class.__name__,
-            method_name=name,
-            args=args,
-            kwargs=kwargs,
-            is_read_only=getattr(fn, "__orleans_read_only__", False),
-            is_always_interleave=getattr(fn, "__orleans_always_interleave__", False),
-            is_one_way=getattr(fn, "__orleans_one_way__", False),
-        )
+        return self.__getattr__(name)(*args, **kwargs)
 
     def __eq__(self, other: object) -> bool:
         return (isinstance(other, GrainRef)

@@ -27,7 +27,7 @@ from ..core.message import (
     ResponseKind,
     make_request,
 )
-from ..core.serialization import deep_copy
+from ..core.serialization import copy_call_body, deep_copy
 from .context import TXN_KEY, RequestContext, current_activation
 
 if TYPE_CHECKING:
@@ -36,6 +36,15 @@ if TYPE_CHECKING:
 log = logging.getLogger("orleans.rpc")
 
 MAX_RESEND_COUNT = 3  # SiloMessagingOptions.MaxResendCount analog
+
+
+def _resolve_future(fut: asyncio.Future, value, exc) -> None:
+    if fut.done():
+        return  # timed out / broken / cancelled while deferred
+    if exc is not None:
+        fut.set_exception(exc)
+    else:
+        fut.set_result(value)
 
 
 class CallbackData:
@@ -190,7 +199,7 @@ class RuntimeClient:
             # filtered sends already copy-isolated at send_request time;
             # copying twice would double serialization on the hot path
             body=(args, kwargs) if body_precopied
-            else deep_copy((args, kwargs)),
+            else copy_call_body(args, kwargs),
             direction=Direction.ONE_WAY if is_one_way else Direction.REQUEST,
             category=category if category is not None else Category.APPLICATION,
             target_silo=target_silo,
@@ -239,11 +248,20 @@ class RuntimeClient:
             if tid == cb.txn_info.id:
                 cb.txn_info.merge(participants)
         if msg.response_kind == ResponseKind.SUCCESS:
-            cb.future.set_result(msg.body)
+            # resolve via call_soon, not synchronously: with inline
+            # delivery + eager turns a whole RPC can complete before the
+            # caller first awaits, and a caller awaiting an already-done
+            # future never suspends — tight call loops would then starve
+            # every background task (membership refresh, reminder ticks).
+            # One deferred resolution per call guarantees each RPC yields
+            # at least once, like a real wire hop does.
+            asyncio.get_running_loop().call_soon(
+                _resolve_future, cb.future, msg.body, None)
         elif msg.response_kind == ResponseKind.ERROR:
             exc = msg.body if isinstance(msg.body, BaseException) else \
                 RejectionError(str(msg.body))
-            cb.future.set_exception(exc)
+            asyncio.get_running_loop().call_soon(
+                _resolve_future, cb.future, None, exc)
         else:  # rejection — transparently resend transient rejections
             # GATEWAY_TOO_BUSY is retryable: the resend re-picks a gateway
             # (the reference's client reroutes around overloaded gateways)
@@ -273,10 +291,13 @@ class RuntimeClient:
             if msg.rejection_type is not None and \
                     msg.rejection_type.name == "GATEWAY_TOO_BUSY":
                 from ..core.errors import GatewayTooBusyError
-                cb.future.set_exception(GatewayTooBusyError(
-                    msg.rejection_info or "gateway overloaded"))
+                asyncio.get_running_loop().call_soon(
+                    _resolve_future, cb.future, None, GatewayTooBusyError(
+                        msg.rejection_info or "gateway overloaded"))
                 return
-            cb.future.set_exception(RejectionError(msg.rejection_info or "rejected"))
+            asyncio.get_running_loop().call_soon(
+                _resolve_future, cb.future, None,
+                RejectionError(msg.rejection_info or "rejected"))
 
     def break_outstanding_to_dead_silo(self, silo: SiloAddress) -> None:
         """``BreakOutstandingMessagesToDeadSilo:726``."""
