@@ -8,16 +8,24 @@ prints calls/sec). Each heartbeat round is ONE vectorized dispatch tick
 over the sharded actor table; the metric of record is grain msgs/sec/chip
 with the per-round (== per-message p99) latency distribution.
 
-What is measured (and why): the headline number is **steady-state
-dispatch** — K-round scanned ticks over payload batches already staged in
-HBM, cycling through several distinct staged buffers. This mirrors the
-reference harness, which measures in-proc dispatch with messages already
-materialized (PingBenchmark keeps its request objects in memory; no NIC on
-the measured path). Ingest cost is measured separately and reported in
-``extra.ingest_bound_msgs_per_sec``: in this dev environment host→device
-goes through a tunneled PCIe path (~20 MB/s bursts with multi-second
-contention spikes), an artifact a production v5e host (direct PCIe, NIC
-gateway staging batches asynchronously) does not share.
+What is measured (and why):
+
+* **Headline** — steady-state dispatch over payloads already staged in
+  HBM, with PIPELINE_DEPTH super-rounds in flight (dispatch N+1..N+D
+  while N executes). This mirrors the reference harness (PingBenchmark
+  keeps its request objects in memory; no NIC on the measured path) and
+  the deployment shape (the gateway stages batches ahead of the tick
+  that consumes them). Round latency is measured from steady-state
+  inter-completion intervals, and the full distribution is emitted
+  (p50/p90/p99/p99.9/max) so dev-tunnel stalls are separable from
+  dispatch: a stalled super-round (>5x median) is counted and reported,
+  not hidden.
+* **Ingest** — double-buffered host→device pipeline: a staging thread
+  packs + uploads super-batch N+1 while the scan kernel consumes N (the
+  gateway's staging role, Gateway.cs:17). In this dev environment
+  host→device crosses a tunneled PCIe path (~20 MB/s with multi-second
+  contention spikes) that a production v5e host does not share;
+  ingest_bytes_per_sec is reported so the transport bound is explicit.
 
 Prints exactly one JSON line:
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
@@ -29,6 +37,8 @@ vs_baseline is value / 1e6 — the driver-supplied target of >=1M msgs/sec
 import json
 import sys
 import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
@@ -37,9 +47,11 @@ sys.path.insert(0, ".")
 N_PLAYERS = 1_000_000
 ROUNDS_PER_UPLOAD = 8  # K heartbeat rounds scanned inside one kernel call
 N_STAGED = 4           # distinct pre-staged payload super-batches, cycled
+PIPELINE_DEPTH = 4     # super-rounds in flight (dispatch-ahead)
 WARMUP_ITERS = 3
 MEASURE_SECONDS = 10.0
 INGEST_SECONDS = 8.0
+STALL_FACTOR = 5.0     # a super-round slower than 5x median is a stall
 BASELINE_MSGS_PER_SEC = 1_000_000.0
 
 
@@ -99,13 +111,15 @@ def main() -> None:
     # job in deployment: ingest batches land in device memory ahead of the
     # tick that consumes them)
     d_slots, d_khash, d_valid, d_zero = plan.device_operands(tbl._put)
-    staged = []
-    for i in range(N_STAGED):
-        batch = np.stack([
+
+    def pack_super(i: int) -> np.ndarray:
+        return np.stack([
             plan.pack((pos + np.float16(0.001 * (i * K + k))).astype(
                 np.float16), np.float16, (2,))
             for k in range(K)])
-        staged.append(tbl._put_rounds(jnp.asarray(batch)))
+
+    staged = [tbl._put_rounds(jnp.asarray(pack_super(i)))
+              for i in range(N_STAGED)]
     kern = rt._scan_kernel(PlayerGrain, "heartbeat", plan.B, K,
                            contiguous=rt._plan_contiguous(tbl, plan))
 
@@ -119,38 +133,69 @@ def main() -> None:
         jax.block_until_ready(super_round(i))
         rounds_done += K
 
-    # ---- headline: steady-state dispatch throughput --------------------
-    lat = []
+    # ---- headline: pipelined steady-state dispatch throughput ----------
+    # Keep PIPELINE_DEPTH supers in flight; completions are timestamped as
+    # each oldest in-flight super finishes. Steady-state inter-completion
+    # intervals ARE the super-round service times once the pipe is full.
+    inflight: deque = deque()
+    completions: list[float] = []
     supers = 0
     t0 = time.perf_counter()
     while time.perf_counter() - t0 < MEASURE_SECONDS:
-        t1 = time.perf_counter()
-        jax.block_until_ready(super_round(supers))
-        lat.append(time.perf_counter() - t1)
+        inflight.append(super_round(supers))
         supers += 1
+        if len(inflight) >= PIPELINE_DEPTH:
+            jax.block_until_ready(inflight.popleft())
+            completions.append(time.perf_counter())
+    while inflight:
+        jax.block_until_ready(inflight.popleft())
+        completions.append(time.perf_counter())
     rounds_done += supers * K
-    lat = np.array(lat)
-    med = float(np.median(lat))
-    msgs_per_sec = (K * N_PLAYERS) / med
-    p99_round_ms = float(np.percentile(lat, 99)) / K * 1e3
 
-    # ---- secondary: ingest-inclusive (pack + tunnel upload each time) --
+    comp = np.array(completions)
+    intervals = np.diff(comp)                    # super-round service times
+    elapsed = comp[-1] - comp[0]
+    msgs_per_sec = (len(intervals) * K * N_PLAYERS) / elapsed
+    per_round_ms = intervals / K * 1e3
+    med_super = float(np.median(intervals))
+    stall_mask = intervals > STALL_FACTOR * med_super
+    dist = {p: round(float(np.percentile(per_round_ms, p)), 3)
+            for p in (50, 90, 99, 99.9)}
+    p99_round_ms = dist[99]
+    non_stall = per_round_ms[~stall_mask]
+    p99_excl_stalls = round(float(np.percentile(non_stall, 99)), 3) \
+        if non_stall.size else None
+
+    # ---- secondary: double-buffered ingest pipeline --------------------
+    # A staging thread packs + uploads super-batch N+1 while the device
+    # consumes N (upload overlaps compute; jax device_put is async).
+    stager = ThreadPoolExecutor(1)
+
+    def stage(i: int):
+        return tbl._put_rounds(jnp.asarray(pack_super(i % (2 * N_STAGED))))
+
+    nxt = stager.submit(stage, 0)
     ingest_supers = 0
+    ingest_inflight: deque = deque()
     t0 = time.perf_counter()
-    inflight = []
     while time.perf_counter() - t0 < INGEST_SECONDS:
-        r = rt.call_batch_rounds(
-            PlayerGrain, "heartbeat", keys,
-            {"pos": np.broadcast_to(pos, (K, N_PLAYERS, 2))},
-            plan=plan, device_results=True)
-        inflight.append(r)
-        if len(inflight) >= 2:
-            jax.block_until_ready(inflight.pop(0))
+        buf = nxt.result()                      # staged batch for this super
+        nxt = stager.submit(stage, ingest_supers + 1)  # overlap next upload
+        new_state, res = kern(tbl.state, d_slots, d_khash, d_zero, d_valid,
+                              {"pos": buf})
+        tbl.state = new_state
+        ingest_inflight.append(res)
+        if len(ingest_inflight) >= 2:
+            jax.block_until_ready(ingest_inflight.popleft())
         ingest_supers += 1
-    jax.block_until_ready(inflight[-1])
+    while ingest_inflight:
+        jax.block_until_ready(ingest_inflight.popleft())
     ingest_elapsed = time.perf_counter() - t0
+    stager.shutdown(wait=False)
     rounds_done += ingest_supers * K
     ingest_msgs_per_sec = ingest_supers * K * N_PLAYERS / ingest_elapsed
+    bytes_per_super = K * N_PLAYERS * 2 * 2     # K rounds x 2 f16 coords
+    ingest_bytes_per_sec = ingest_supers * bytes_per_super / ingest_elapsed
 
     # sanity: every player's state advanced exactly once per round
     row = tbl.read_row(N_PLAYERS // 2)
@@ -163,12 +208,18 @@ def main() -> None:
         "vs_baseline": round(msgs_per_sec / BASELINE_MSGS_PER_SEC, 3),
         "extra": {
             "n_players": N_PLAYERS,
-            "rounds_measured": supers * K,
+            "rounds_measured": len(intervals) * K,
             "rounds_per_super": K,
+            "pipeline_depth": PIPELINE_DEPTH,
             "staged_batches": N_STAGED,
-            "p99_round_latency_ms": round(p99_round_ms, 3),
-            "median_super_round_ms": round(med * 1e3, 3),
+            "p99_round_latency_ms": p99_round_ms,
+            "round_latency_ms": dist,
+            "round_latency_max_ms": round(float(per_round_ms.max()), 3),
+            "median_super_round_ms": round(med_super * 1e3, 3),
+            "stall_supers": int(stall_mask.sum()),
+            "p99_round_latency_ms_excluding_stalls": p99_excl_stalls,
             "ingest_bound_msgs_per_sec": round(ingest_msgs_per_sec, 1),
+            "ingest_bytes_per_sec": round(ingest_bytes_per_sec, 1),
             "ingest_supers": ingest_supers,
             "devices": n_dev,
             "platform": jax.devices()[0].platform,

@@ -7,9 +7,11 @@ from .journaled import (
     LogViewAdaptor,
     StateStorageAdaptor,
     log_consistency,
+    replicated_journal,
 )
 
 __all__ = [
-    "JournaledGrain", "log_consistency", "LogViewAdaptor",
-    "LogStorageAdaptor", "StateStorageAdaptor", "CustomStorageAdaptor",
+    "JournaledGrain", "log_consistency", "replicated_journal",
+    "LogViewAdaptor", "LogStorageAdaptor", "StateStorageAdaptor",
+    "CustomStorageAdaptor",
 ]

@@ -8,12 +8,22 @@ confirmed State, TransitionState), the three ``ILogViewAdaptor`` providers —
 ``CustomStorage/LogViewAdaptor.cs:378`` (user-defined read/apply) — and the
 CAS-retry write loop of ``Common/PrimaryBasedLogViewAdaptor.cs:907`` (on
 etag conflict: reload the primary, replay pending entries, write again).
-Multi-cluster notification tracking is a design hook (``notify``), not
-implemented (SURVEY §2.4: geo replication out of minimum scope).
+
+**Replication + notifications** (the notification-tracking half of
+``PrimaryBasedLogViewAdaptor.cs:907``): a ``@replicated_journal`` grain
+hosts one replica per silo (reads scale out; writes serialize through the
+storage CAS). After a replica confirms events it broadcasts
+``(from_version, events, new_version)`` to every peer silo's journal
+notification target; receivers fold in-order notifications directly into
+their confirmed view — no storage re-read — buffer out-of-order ones, and
+catch up from storage only when a gap persists. Failed notification sends
+are re-driven by a writer-side retry worker with backoff (the reference's
+notification worker loop).
 """
 
 from __future__ import annotations
 
+import asyncio
 import logging
 from typing import TYPE_CHECKING, Any
 
@@ -26,10 +36,20 @@ if TYPE_CHECKING:
 
 log = logging.getLogger("orleans.eventsourcing")
 
-__all__ = ["JournaledGrain", "log_consistency", "LogViewAdaptor",
-           "LogStorageAdaptor", "StateStorageAdaptor", "CustomStorageAdaptor"]
+__all__ = ["JournaledGrain", "log_consistency", "replicated_journal",
+           "LogViewAdaptor", "LogStorageAdaptor", "StateStorageAdaptor",
+           "CustomStorageAdaptor"]
 
 MAX_WRITE_RETRIES = 16
+# out-of-order notifications buffered before falling back to a storage read
+MAX_NOTIFICATION_BUFFER = 64
+# a version gap older than this triggers a storage catch-up even if the
+# buffer is small (a dropped notification would otherwise stall the
+# replica forever at low write rates)
+GAP_CATCH_UP_DELAY = 1.0
+NOTIFY_RETRIES = 3
+NOTIFY_RETRY_BASE = 0.1
+JOURNAL_NOTIFY_TARGET = "journal-notify"
 
 
 class LogViewAdaptor:
@@ -163,6 +183,54 @@ _ADAPTORS = {
 }
 
 
+def replicated_journal(cls: type) -> type:
+    """Class decorator: host one replica of this journaled grain per silo
+    (stateless-worker placement, cap 1) and keep replicas converged via
+    confirmed-event notifications instead of storage re-reads — the
+    replica/notification model of PrimaryBasedLogViewAdaptor.cs:907
+    applied across silos. Writes from any replica remain safe: the
+    adaptors' CAS append serializes them through storage."""
+    cls.__journal_replicated__ = True
+    cls.__orleans_stateless_worker__ = 1  # one local replica per silo
+    return cls
+
+
+class JournalNotificationTarget:
+    """Per-silo system target receiving confirmed-event notifications and
+    folding them into local replicas as gated turns (the receiving half
+    of the reference's notification tracking)."""
+
+    def __init__(self, silo) -> None:
+        self.silo = silo
+
+    async def journal_notify(self, class_name: str, key, key_ext,
+                             from_version: int, events: list,
+                             new_version: int) -> bool:
+        from ..core.ids import GrainId, GrainType
+        gid = GrainId.for_grain(GrainType.of(class_name), key, key_ext)
+        acts = self.silo.catalog.by_grain.get(gid)
+        if not acts:
+            return False   # no local replica: it will load from storage
+        for act in list(acts):
+            inst = act.grain_instance
+            if isinstance(inst, JournaledGrain):
+                # run as a gated turn so the fold never interleaves with
+                # a half-finished grain turn on the same activation
+                await self.silo.dispatcher.run_closed_turn(
+                    act, lambda i=inst: i._fold_notification(
+                        from_version, list(events), new_version))
+        return True
+
+
+def install_journal_notifier(silo) -> None:
+    """Idempotently register the notification system target on a silo
+    (called from Silo.start when a replicated journal class is hosted)."""
+    if getattr(silo, "_journal_notifier", None) is None:
+        silo._journal_notifier = JournalNotificationTarget(silo)
+        silo.register_system_target(silo._journal_notifier,
+                                    JOURNAL_NOTIFY_TARGET)
+
+
 def log_consistency(provider: str, storage_name: str = "Default"):
     """Class decorator choosing the consistency provider
     ([LogConsistencyProvider] attribute analog)."""
@@ -218,6 +286,102 @@ class JournaledGrain(Grain):
             raise
         self._confirmed, self._version = state, version
         self._adaptor.notify(self, batch)
+        if getattr(type(self), "__journal_replicated__", False):
+            self._broadcast_confirmed(batch, version)
+
+    # -- replica notifications (PrimaryBasedLogViewAdaptor.cs:907) -------
+    def _fold_notification(self, from_version: int, events: list,
+                           new_version: int) -> None:
+        """Apply a peer's confirmed events without re-reading storage:
+        in-order → fold directly; out-of-order → buffer; persistent gap →
+        schedule a storage catch-up."""
+        if new_version <= self._version:
+            return                       # duplicate / already seen
+        self._notif_buffer[from_version] = (events, new_version)
+        while self._version in self._notif_buffer:
+            ev, nv = self._notif_buffer.pop(self._version)
+            st = self._confirmed
+            for e in ev:
+                st = self.apply_event(st, e)
+            self._confirmed, self._version = st, nv
+        # prune buffered entries the fold has passed
+        for fv in [v for v in self._notif_buffer if v < self._version]:
+            self._notif_buffer.pop(fv, None)
+        if len(self._notif_buffer) > MAX_NOTIFICATION_BUFFER:
+            self._notif_buffer.clear()
+            self._schedule_catch_up(delay=0.0)
+        elif self._notif_buffer:
+            # a gap exists (a notification was lost or is late): if it
+            # persists past GAP_CATCH_UP_DELAY, read storage — without
+            # this a dropped notification stalls the replica forever at
+            # low write rates
+            self._schedule_catch_up(delay=GAP_CATCH_UP_DELAY)
+
+    def _schedule_catch_up(self, delay: float) -> None:
+        if self._catch_up_task is not None and not self._catch_up_task.done():
+            return
+        version_at_schedule = self._version
+        act = self._activation
+
+        async def catch_up() -> None:
+            if delay:
+                await asyncio.sleep(delay)
+                if self._version > version_at_schedule or \
+                        not self._notif_buffer:
+                    return              # the gap healed on its own
+            try:
+                # run gated on the activation (like the fold) so the load
+                # cannot interleave with a grain turn mid-await
+                await act.runtime.dispatcher.run_closed_turn(
+                    act, self.refresh_now)
+            except Exception:  # noqa: BLE001
+                log.exception("journal catch-up failed for %s",
+                              self.grain_id)
+
+        self._catch_up_task = asyncio.ensure_future(catch_up())
+
+    def _broadcast_confirmed(self, batch: list, new_version: int) -> None:
+        """Writer side: push (from_version, events, new_version) to every
+        peer silo's notification target; failures retry with backoff
+        (the reference's notification worker)."""
+        silo = self._activation.runtime
+        from_version = new_version - len(batch)
+        peers = [s for s in getattr(silo.locator, "alive_list", [])
+                 if s != silo.silo_address]
+        if not peers:
+            return
+        gid = self.grain_id
+
+        async def notify_one(peer) -> None:
+            from ..core.ids import GrainId, type_code_of
+            from ..core.message import Category
+            target = GrainId.system_target(
+                type_code_of(JOURNAL_NOTIFY_TARGET), peer)
+            for attempt in range(NOTIFY_RETRIES):
+                try:
+                    await silo.runtime_client.send_request(
+                        target_grain=target,
+                        grain_class=JournalNotificationTarget,
+                        interface_name="JournalNotificationTarget",
+                        method_name="journal_notify",
+                        args=(type(self).__name__, gid.key, gid.key_ext,
+                              from_version, list(batch), new_version),
+                        kwargs={}, target_silo=peer,
+                        category=Category.SYSTEM)
+                    return
+                except Exception:  # noqa: BLE001 — peer may be mid-death;
+                    # its replica reloads from storage on next activation
+                    await asyncio.sleep(NOTIFY_RETRY_BASE * (2 ** attempt))
+            log.warning("journal notification to %s gave up for %s",
+                        peer, gid)
+
+        tasks = getattr(silo, "_journal_notify_tasks", None)
+        if tasks is None:
+            tasks = silo._journal_notify_tasks = set()
+        for peer in peers:
+            t = asyncio.ensure_future(notify_one(peer))
+            tasks.add(t)
+            t.add_done_callback(tasks.discard)
 
     @property
     def state(self) -> Any:
@@ -242,12 +406,27 @@ class JournaledGrain(Grain):
         return list(self._pending)
 
     async def refresh_now(self) -> None:
-        """Re-read the confirmed view from storage (RetrieveConfirmedState)."""
-        self._confirmed, self._version = await self._adaptor.load(self)
+        """Re-read the confirmed view from storage (RetrieveConfirmedState).
+        The in-memory view only moves forward: CAS appends mean the stored
+        version is monotone, so a load older than what we already confirmed
+        (a read that raced a concurrent local append) is discarded."""
+        state, version = await self._adaptor.load(self)
+        if version > self._version:
+            self._confirmed, self._version = state, version
+            for fv in [v for v in self._notif_buffer if v < version]:
+                self._notif_buffer.pop(fv, None)
 
     # -- lifecycle -------------------------------------------------------
     async def on_activate(self) -> None:
         provider, storage_name = type(self).__log_consistency__
         self._adaptor = _ADAPTORS[provider](storage_name)
         self._pending: list = []
+        # out-of-order notification buffer: from_version → (events, new_v)
+        self._notif_buffer: dict[int, tuple[list, int]] = {}
+        self._catch_up_task: asyncio.Task | None = None
         self._confirmed, self._version = await self._adaptor.load(self)
+
+    async def on_deactivate(self) -> None:
+        if self._catch_up_task is not None:
+            self._catch_up_task.cancel()
+            self._catch_up_task = None

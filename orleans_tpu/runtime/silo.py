@@ -343,6 +343,13 @@ class Silo:
             self._eager_installed = True
         self.message_center.start()          # RuntimeServices
         self.catalog.start()
+        # replicated journaled grains need the notification target up
+        # before any replica confirms events (eventsourcing notifications)
+        for cls in self.registry.all_classes():
+            if getattr(cls, "__journal_replicated__", False):
+                from ..eventsourcing.journaled import install_journal_notifier
+                install_journal_notifier(self)
+                break
         self.fabric.register_silo(self)
         for stage, start, _ in sorted(self._lifecycle, key=lambda x: x[0]):
             r = start()
@@ -384,6 +391,9 @@ class Silo:
                 r = stop()
                 if asyncio.iscoroutine(r):
                     await r
+        # background notification/retry tasks must not outlive the runtime
+        for t in list(getattr(self, "_journal_notify_tasks", ())):
+            t.cancel()
         self.message_center.stop()
         self.runtime_client.close()
         self.fabric.unregister_silo(self, dead=not graceful)

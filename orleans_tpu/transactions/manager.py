@@ -325,13 +325,17 @@ async def _collect(calls: list) -> list:
     participant; remote calls are already-transmitted futures, so their
     round trips still overlap."""
     out = []
-    for c in calls:
+    for idx, c in enumerate(calls):
         try:
             out.append(await c)
         except asyncio.CancelledError:
             # parent turn cancelled (silo stop/kill): propagate — a
             # cancelled 2PC round must not keep driving the protocol
-            # against a tearing-down runtime
+            # against a tearing-down runtime. Close not-yet-awaited
+            # coroutines so they don't leak "never awaited" warnings.
+            for rest in calls[idx + 1:]:
+                if asyncio.iscoroutine(rest):
+                    rest.close()
             raise
         except BaseException as e:  # noqa: BLE001
             out.append(e)

@@ -166,3 +166,162 @@ async def test_batched_events_confirm_atomically():
         assert snap["version"] == 3 and snap["state"]["count"] == 6
     finally:
         await stop(silo, client)
+
+
+# ---------------------------------------------------------------------------
+# Replicated journals: confirmed-event notifications between silos
+# (PrimaryBasedLogViewAdaptor.cs:907 notification tracking)
+# ---------------------------------------------------------------------------
+
+from orleans_tpu.eventsourcing import replicated_journal
+
+
+@replicated_journal
+class ReplCounter(CounterJournal):
+    """One replica per silo; replicas converge via notifications."""
+
+
+class CountingStorage(MemoryStorage):
+    """MemoryStorage that counts reads, to prove notification folds do
+    not re-read storage."""
+
+    def __init__(self):
+        super().__init__()
+        self.reads = 0
+
+    async def read(self, grain_type, grain_id):
+        self.reads += 1
+        return await super().read(grain_type, grain_id)
+
+
+async def _start_two_silos(storage):
+    fabric = InProcFabric()
+    silos = []
+    for i in range(2):
+        s = (SiloBuilder().with_name(f"es{i}").with_fabric(fabric)
+             .add_grains(*GRAINS, ReplCounter)
+             .with_storage("Default", storage).build())
+        await s.start()
+        silos.append(s)
+    client = await ClusterClient(fabric).connect()
+    return fabric, silos, client
+
+
+async def test_replica_sees_confirmed_events_without_storage_read():
+    storage = CountingStorage()
+    fabric, silos, client = await _start_two_silos(storage)
+    try:
+        a = silos[0].grain_factory.get_grain(ReplCounter, "r1")
+        b = silos[1].grain_factory.get_grain(ReplCounter, "r1")
+        # activate both replicas (each silo hosts its own)
+        assert (await a.snapshot())["version"] == 0
+        assert (await b.snapshot())["version"] == 0
+        reads_before = storage.reads
+
+        await a.bump(5, "x")          # replica A confirms an event
+        # replica B's confirmed view advances via the notification fold
+        for _ in range(100):
+            snap = await b.snapshot()
+            if snap["version"] == 1:
+                break
+            await asyncio.sleep(0.01)
+        assert snap["version"] == 1 and snap["state"]["count"] == 5
+        # ... with ZERO additional storage reads on any replica (the
+        # append path re-reads its own row; B must not)
+        b_types_read = storage.reads - reads_before
+        # A's confirm does exactly one read (CAS read-before-write);
+        # B does none.
+        assert b_types_read <= 1, b_types_read
+    finally:
+        await client.close_async()
+        for s in silos:
+            await s.stop()
+
+
+async def test_replica_buffers_out_of_order_notifications():
+    storage = CountingStorage()
+    fabric, silos, client = await _start_two_silos(storage)
+    try:
+        b = silos[1].grain_factory.get_grain(ReplCounter, "r2")
+        assert (await b.snapshot())["version"] == 0
+        from orleans_tpu.core.ids import GrainId
+        from orleans_tpu.runtime.grain import grain_type_of
+        acts = silos[1].catalog.by_grain[
+            GrainId.for_grain(grain_type_of(ReplCounter), "r2")]
+        inst = acts[0].grain_instance
+        # deliver version 1->2 before 0->1: must buffer, then fold both
+        inst._fold_notification(1, [{"delta": 2, "op": "b"}], 2)
+        assert inst.version == 0            # gap: buffered
+        inst._fold_notification(0, [{"delta": 1, "op": "a"}], 1)
+        assert inst.version == 2            # both folded in order
+        assert inst.state["count"] == 3
+        assert inst.state["ops"] == ["a", "b"]
+        # duplicates/old notifications are ignored
+        inst._fold_notification(0, [{"delta": 9, "op": "dup"}], 1)
+        assert inst.version == 2 and inst.state["count"] == 3
+    finally:
+        await client.close_async()
+        for s in silos:
+            await s.stop()
+
+
+async def test_concurrent_replica_writers_serialize_via_cas():
+    storage = CountingStorage()
+    fabric, silos, client = await _start_two_silos(storage)
+    try:
+        a = silos[0].grain_factory.get_grain(ReplCounter, "r3")
+        b = silos[1].grain_factory.get_grain(ReplCounter, "r3")
+        await a.snapshot(); await b.snapshot()
+        await asyncio.gather(*(a.bump(1, f"a{i}") for i in range(5)),
+                             *(b.bump(1, f"b{i}") for i in range(5)))
+        # all 10 events land (CAS append retries fold on conflicts);
+        # both replicas converge to version 10
+        for _ in range(200):
+            sa = await a.snapshot()
+            sb = await b.snapshot()
+            if sa["version"] == 10 and sb["version"] == 10:
+                break
+            await asyncio.sleep(0.01)
+        assert sa["version"] == 10 and sa["state"]["count"] == 10
+        assert sb["version"] == 10 and sb["state"]["count"] == 10
+        assert sorted(sa["state"]["ops"]) == sorted(sb["state"]["ops"])
+    finally:
+        await client.close_async()
+        for s in silos:
+            await s.stop()
+
+
+async def test_replica_gap_catches_up_from_storage():
+    """A lost notification (gap) must not stall the replica: after
+    GAP_CATCH_UP_DELAY it reloads the confirmed view from storage."""
+    storage = CountingStorage()
+    fabric, silos, client = await _start_two_silos(storage)
+    try:
+        a = silos[0].grain_factory.get_grain(ReplCounter, "r4")
+        b = silos[1].grain_factory.get_grain(ReplCounter, "r4")
+        await a.snapshot(); await b.snapshot()
+
+        from orleans_tpu.core.ids import GrainId
+        from orleans_tpu.runtime.grain import grain_type_of
+        gid = GrainId.for_grain(grain_type_of(ReplCounter), "r4")
+        inst = silos[1].catalog.by_grain[gid][0].grain_instance
+
+        await a.bump(1, "a")      # v1 — then simulate v0->v1 notify LOST
+        # deliver only the v1->v2 notification (out of order forever)
+        await a.bump(2, "b")      # v2 (B may receive both legitimately;
+        # force the gap instead by resetting B below)
+        inst._version = 0
+        inst._confirmed = inst.initial_state()
+        inst._notif_buffer.clear()
+        inst._fold_notification(1, [{"delta": 2, "op": "b"}], 2)
+        assert inst.version == 0  # gapped
+        # the gap-persistence catch-up must kick in within ~1s + slack
+        for _ in range(40):
+            await asyncio.sleep(0.1)
+            if inst.version >= 2:
+                break
+        assert inst.version == 2 and inst.state["count"] == 3
+    finally:
+        await client.close_async()
+        for s in silos:
+            await s.stop()
