@@ -309,6 +309,12 @@ class JournaledGrain(Grain):
             self._notif_buffer.pop(fv, None)
         if len(self._notif_buffer) > MAX_NOTIFICATION_BUFFER:
             self._notif_buffer.clear()
+            # a pending delayed catch-up would see the cleared buffer and
+            # declare the gap healed — replace it with an immediate one
+            if self._catch_up_task is not None and \
+                    not self._catch_up_task.done():
+                self._catch_up_task.cancel()
+                self._catch_up_task = None
             self._schedule_catch_up(delay=0.0)
         elif self._notif_buffer:
             # a gap exists (a notification was lost or is late): if it

@@ -350,6 +350,12 @@ class Silo:
                 from ..eventsourcing.journaled import install_journal_notifier
                 install_journal_notifier(self)
                 break
+        if self.vector is not None:
+            # vector-hosting silos must accept forwarded bulk stream items
+            # even when no stream provider is configured locally — peers'
+            # pulling agents route owner-partitioned sub-batches here
+            from ..streams.pubsub import install_vector_stream_target
+            install_vector_stream_target(self)
         self.fabric.register_silo(self)
         for stage, start, _ in sorted(self._lifecycle, key=lambda x: x[0]):
             r = start()

@@ -158,10 +158,13 @@ class _ConsumerPump:
 
     async def _deliver(self, batch: QueueBatch) -> None:
         silo = self.agent.provider.silo
+        # shared across retry attempts: a mid-batch failure resumes at the
+        # failed item instead of re-applying delivered ones
+        progress: dict = {}
         try:
             await retry(
                 lambda: deliver_to_consumer(
-                    silo, self.handle, batch.items, batch.seq),
+                    silo, self.handle, batch.items, batch.seq, progress),
                 max_attempts=self.agent.max_delivery_attempts,
                 backoff=ExponentialBackoff(min_delay=0.05, max_delay=2.0))
         except Exception as exc:  # noqa: BLE001 — retries exhausted

@@ -62,9 +62,16 @@ def implicit_stream_subscription(namespace: str):
 
 def implicit_consumers(silo: "Silo", stream: StreamId) -> list[SubscriptionHandle]:
     """ImplicitStreamSubscriberTable: registered classes whose declared
-    namespaces include this stream's — consumer key = stream key."""
+    namespaces include this stream's — consumer key = stream key. Device
+    tier (VectorGrain) classes participate too: their deliveries ride
+    batched kernel ticks (deliver_to_vector_consumer)."""
     out = []
-    for cls in silo.registry.all_classes():
+    classes = list(silo.registry.all_classes())
+    seen = {c.__name__ for c in classes}
+    for vcls in getattr(silo, "vector_interfaces", {}).values():
+        if vcls.__name__ not in seen:
+            classes.append(vcls)
+    for cls in classes:
         if stream.namespace in getattr(cls, "__implicit_stream_ns__", ()):
             gid = GrainId.for_grain(GrainType.of(cls.__name__), stream.key)
             out.append(SubscriptionHandle(
@@ -86,17 +93,219 @@ async def resolve_consumers(silo: "Silo", stream: StreamId
 
 
 async def deliver_to_consumer(silo: "Silo", handle: SubscriptionHandle,
-                              items: list, first_token: int) -> None:
+                              items: list, first_token: int,
+                              progress: dict | None = None) -> None:
     """Deliver events as ordinary grain calls (the consumer-extension path):
-    ``await consumer.<method>(item, token)`` per event, in order."""
+    ``await consumer.<method>(item, token)`` per event, in order. Consumers
+    that are device-tier (VectorGrain) classes take the batched kernel path
+    instead — see :func:`deliver_to_vector_consumer`.
+
+    ``progress`` (per delivery attempt-set, owned by one consumer pump):
+    records how many items of this batch were fully delivered, so a retry
+    after a mid-batch failure resumes at the failed item instead of
+    re-applying the whole batch. Delivery remains at-least-once — the
+    failed item itself may have partially applied — matching the
+    reference's stream redelivery contract (consumers dedup by token)."""
+    if progress is None:
+        progress = {}
+    vcls = silo.vector_interfaces.get(handle.interface_name)
+    if vcls is not None and getattr(silo, "vector", None) is not None:
+        return await deliver_to_vector_consumer(silo, vcls, handle, items,
+                                                progress)
     cls = silo.registry.resolve(handle.interface_name)
     if cls is None:
         raise LookupError(
             f"stream consumer class {handle.interface_name} not registered")
-    for i, item in enumerate(items):
+    for i in range(progress.get("done", 0), len(items)):
         fut = silo.runtime_client.send_request(
             target_grain=handle.grain_id, grain_class=cls,
             interface_name=handle.interface_name,
             method_name=handle.method_name,
-            args=(item, first_token + i), kwargs={})
+            args=(items[i], first_token + i), kwargs={})
         await fut
+        progress["done"] = i + 1
+
+
+async def deliver_to_vector_consumer(silo: "Silo", vcls: type,
+                                     handle: SubscriptionHandle,
+                                     items: list,
+                                     progress: dict | None = None) -> None:
+    """Device-tier stream delivery: the pulling agent's per-event host
+    turns (PersistentStreamPullingAgent.cs:350-368) become batched kernel
+    ticks over the consumer VectorGrain class.
+
+    Item shapes (per QueueBatch item, in order):
+
+    * ``{"keys": [M], "args": {field: [M, ...]}}`` — one ``call_batch``
+      tick delivering M events (one per key);
+    * ``{"keys": [M], "args_rounds": {field: [K, M, ...]}}`` — one
+      scanned ``call_batch_rounds`` kernel delivering K sequential rounds
+      to the same keys (K·M events, per-key order preserved);
+    * ``{"key": k, <field>: value, ...}`` — a single event; joins the
+      runtime's coalescing tick (rt.call), so scalar trickles from many
+      streams still share kernel launches.
+
+    Events inside one stream stay ordered: each pump delivers its stream's
+    batches sequentially, and rounds are sequential inside the scan.
+    """
+    import numpy as np
+
+    rt = silo.vector
+    method = handle.method_name
+    if progress is None:
+        progress = {}
+    delivered = 0
+    for i in range(progress.get("done", 0), len(items)):
+        item = items[i]
+        if isinstance(item, dict) and "keys" in item:
+            delivered += await _deliver_bulk_item(silo, rt, vcls, method,
+                                                  item)
+        elif isinstance(item, dict) and "key" in item:
+            delivered += await _deliver_scalar_item(silo, rt, vcls, method,
+                                                    item)
+        else:
+            raise TypeError(
+                f"vector stream item must be a dict with 'keys' (bulk) or "
+                f"'key' (single); got {type(item).__name__}")
+        progress["done"] = i + 1
+    silo.stats.increment("streams.vector.delivered", delivered)
+
+
+async def _deliver_scalar_item(silo: "Silo", rt, vcls: type, method: str,
+                               item: dict) -> int:
+    """One scalar event, owner-routed like every other vector call: on the
+    key's ring owner it joins the runtime's coalescing tick; elsewhere it
+    forwards as a 1-key bulk item (Dispatcher._handle_vector_request's
+    single-owner rule — executing on a non-owner would mint divergent
+    device state)."""
+    import numpy as np
+
+    key = item["key"]
+    kwargs = {k: v for k, v in item.items() if k != "key"}
+    gid = GrainId.for_grain(GrainType.of(vcls.__name__), key)
+    me = silo.silo_address
+    owner = silo.locator.ring.owner(gid.uniform_hash) or me
+    if owner == me:
+        kh = rt.key_hash_for(key, gid.uniform_hash)
+        await rt.call(vcls, kh, method, **kwargs)
+        return 1
+    sub = {"keys": np.asarray([key]),
+           "args": {f: np.asarray([v]) for f, v in kwargs.items()}}
+    from ..core.ids import type_code_of
+    from ..core.message import Category
+    target = GrainId.system_target(type_code_of(VECTOR_STREAM_TARGET), owner)
+    await silo.runtime_client.send_request(
+        target_grain=target, grain_class=VectorStreamDeliverTarget,
+        interface_name="VectorStreamDeliverTarget",
+        method_name="vector_stream_deliver",
+        args=(vcls.__name__, method, sub), kwargs={},
+        target_silo=owner, category=Category.SYSTEM)
+    return 1
+
+
+def _bulk_events(item: dict) -> int:
+    import numpy as np
+
+    if "args_rounds" in item:
+        K = np.asarray(next(iter(item["args_rounds"].values()))).shape[0]
+        return K * len(item["keys"])
+    return len(item["keys"])
+
+
+def _run_bulk_local(rt, vcls: type, method: str, item: dict) -> int:
+    import numpy as np
+
+    keys = np.asarray(item["keys"])
+    if "args_rounds" in item:
+        rt.call_batch_rounds(vcls, method, keys, item["args_rounds"],
+                             device_results=True)
+    else:
+        rt.call_batch(vcls, method, keys, item.get("args", {}),
+                      device_results=True)
+    return _bulk_events(item)
+
+
+async def _deliver_bulk_item(silo: "Silo", rt, vcls: type, method: str,
+                             item: dict) -> int:
+    """Run one bulk item, respecting single-owner routing: in a
+    multi-silo cluster each key's device-tier state lives on its ring
+    owner (Dispatcher._handle_vector_request), so the item is partitioned
+    by owner and remote sub-items ride a system-target hop. The
+    single-silo (production TPU-host) case skips partitioning entirely —
+    that is the >=1M events/sec path."""
+    import numpy as np
+
+    ring = silo.locator.ring
+    me = silo.silo_address
+    alive = getattr(silo.locator, "alive_list", None) or [me]
+    if len(alive) <= 1:
+        return _run_bulk_local(rt, vcls, method, item)
+
+    keys = np.asarray(item["keys"])
+    cls_type = GrainType.of(vcls.__name__)
+    owners = [ring.owner(GrainId.for_grain(cls_type, int(k)).uniform_hash)
+              or me for k in keys]
+    groups: dict = {}
+    for idx, owner in enumerate(owners):
+        groups.setdefault(owner, []).append(idx)
+    total = 0
+    for owner, idxs in groups.items():
+        sub = _slice_bulk_item(item, keys, idxs)
+        if owner == me:
+            total += _run_bulk_local(rt, vcls, method, sub)
+        else:
+            from ..core.ids import type_code_of
+            from ..core.message import Category
+            target = GrainId.system_target(
+                type_code_of(VECTOR_STREAM_TARGET), owner)
+            await silo.runtime_client.send_request(
+                target_grain=target, grain_class=VectorStreamDeliverTarget,
+                interface_name="VectorStreamDeliverTarget",
+                method_name="vector_stream_deliver",
+                args=(vcls.__name__, method, sub), kwargs={},
+                target_silo=owner, category=Category.SYSTEM)
+            total += _bulk_events(sub)
+    return total
+
+
+def _slice_bulk_item(item: dict, keys, idxs: list) -> dict:
+    import numpy as np
+
+    sel = np.asarray(idxs)
+    sub: dict = {"keys": keys[sel]}
+    if "args_rounds" in item:
+        sub["args_rounds"] = {f: np.asarray(a)[:, sel]
+                              for f, a in item["args_rounds"].items()}
+    elif "args" in item:
+        sub["args"] = {f: np.asarray(a)[sel]
+                       for f, a in item["args"].items()}
+    return sub
+
+
+VECTOR_STREAM_TARGET = "vector-stream-deliver"
+
+
+class VectorStreamDeliverTarget:
+    """Per-silo system target executing forwarded bulk stream items on
+    the keys' owner silo (the remote half of single-owner delivery)."""
+
+    def __init__(self, silo) -> None:
+        self.silo = silo
+
+    async def vector_stream_deliver(self, class_name: str, method: str,
+                                    item: dict) -> int:
+        vcls = self.silo.vector_interfaces.get(class_name)
+        if vcls is None or self.silo.vector is None:
+            raise LookupError(
+                f"no vector interface {class_name!r} on this silo")
+        return _run_bulk_local(self.silo.vector, vcls, method, item)
+
+
+def install_vector_stream_target(silo) -> None:
+    """Idempotently register the bulk-delivery system target (called when
+    a persistent-stream provider is installed on a vector-hosting silo)."""
+    if getattr(silo, "_vector_stream_target", None) is None and \
+            silo.vector is not None:
+        silo._vector_stream_target = VectorStreamDeliverTarget(silo)
+        silo.register_system_target(silo._vector_stream_target,
+                                    VECTOR_STREAM_TARGET)

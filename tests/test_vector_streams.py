@@ -1,0 +1,224 @@
+"""Device-tier stream delivery: persistent-stream batches addressed to
+VectorGrain consumers ride batched kernel ticks (call_batch /
+call_batch_rounds) instead of per-event host turns — the pulling-agent
+pump of PersistentStreamPullingAgent.cs:141,350-368 re-expressed for the
+device tier."""
+
+import asyncio
+import time
+
+import numpy as np
+import jax.numpy as jnp
+
+from orleans_tpu.dispatch import VectorGrain, actor_method, add_vector_grains
+from orleans_tpu.parallel import make_mesh
+from orleans_tpu.runtime import ClusterClient, InProcFabric, SiloBuilder
+from orleans_tpu.streams import (
+    MemoryQueueAdapter,
+    StreamId,
+    add_persistent_streams,
+)
+from orleans_tpu.streams.pubsub import implicit_stream_subscription
+
+
+@implicit_stream_subscription("telemetry")
+class SensorVec(VectorGrain):
+    """Device-tier stream consumer: one row per sensor key."""
+
+    STATE = {"events": (jnp.int32, ()), "total": (jnp.float32, ())}
+
+    @staticmethod
+    def initial_state(key_hash):
+        return {"events": jnp.int32(0), "total": jnp.float32(0)}
+
+    @actor_method(args={"v": (jnp.float32, ())})
+    def on_next(state, args):
+        new = {"events": state["events"] + 1,
+               "total": state["total"] + args["v"]}
+        return new, new["events"]
+
+
+def _build_silos(n, adapter, n_dense=64):
+    fabric = InProcFabric()
+    silos = []
+    for i in range(n):
+        b = (SiloBuilder().with_name(f"vs{i}").with_fabric(fabric)
+             .with_config(response_timeout=5.0))
+        add_vector_grains(b, SensorVec, mesh=make_mesh(1),
+                          capacity_per_shard=max(64, n_dense),
+                          dense={SensorVec: n_dense})
+        add_persistent_streams(b, "queue", adapter, pull_period=0.02)
+        silos.append(b.build())
+    return fabric, silos
+
+
+async def test_bulk_item_delivers_through_call_batch():
+    adapter = MemoryQueueAdapter(n_queues=2)
+    fabric, silos = _build_silos(1, adapter)
+    silo = silos[0]
+    await silo.start()
+    client = await ClusterClient(fabric).connect()
+    try:
+        provider = silo.stream_providers["queue"]
+        stream = StreamId("queue", "telemetry", "s1")
+        keys = np.arange(32)
+        vals = np.arange(32, dtype=np.float32)
+        await provider.produce(stream, [
+            {"keys": keys, "args": {"v": vals}}])
+        # the pulling agent picks it up and runs ONE batched tick
+        tbl = silo.vector.table(SensorVec)
+        for _ in range(100):
+            await asyncio.sleep(0.02)
+            if int(tbl.read_row(31)["events"]) == 1:
+                break
+        for k in (0, 7, 31):
+            row = tbl.read_row(k)
+            assert int(row["events"]) == 1
+            assert float(row["total"]) == float(k)
+        assert silo.stats.get("streams.vector.delivered") == 32
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_rounds_item_preserves_per_key_order():
+    adapter = MemoryQueueAdapter(n_queues=2)
+    fabric, silos = _build_silos(1, adapter)
+    silo = silos[0]
+    await silo.start()
+    client = await ClusterClient(fabric).connect()
+    try:
+        provider = silo.stream_providers["queue"]
+        stream = StreamId("queue", "telemetry", "s2")
+        keys = np.arange(16)
+        K = 4
+        rounds = np.ones((K, 16), dtype=np.float32)
+        await provider.produce(stream, [
+            {"keys": keys, "args_rounds": {"v": rounds}}])
+        tbl = silo.vector.table(SensorVec)
+        for _ in range(100):
+            await asyncio.sleep(0.02)
+            if int(tbl.read_row(0)["events"]) == K:
+                break
+        row = tbl.read_row(3)
+        assert int(row["events"]) == K          # K sequential rounds ran
+        assert float(row["total"]) == float(K)
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_scalar_items_coalesce_via_rt_call():
+    adapter = MemoryQueueAdapter(n_queues=2)
+    fabric, silos = _build_silos(1, adapter)
+    silo = silos[0]
+    await silo.start()
+    client = await ClusterClient(fabric).connect()
+    try:
+        provider = silo.stream_providers["queue"]
+        stream = StreamId("queue", "telemetry", "s3")
+        await provider.produce(stream, [
+            {"key": 2, "v": np.float32(5.0)},
+            {"key": 2, "v": np.float32(7.0)}])
+        tbl = silo.vector.table(SensorVec)
+        for _ in range(100):
+            await asyncio.sleep(0.02)
+            if int(tbl.read_row(2)["events"]) == 2:
+                break
+        row = tbl.read_row(2)
+        assert int(row["events"]) == 2 and float(row["total"]) == 12.0
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_provider_path_sustains_1m_events_per_sec():
+    """The VERDICT acceptance: >=1M events/sec through the PROVIDER path
+    (produce → queue → pulling agent → pub-sub resolve → batched kernel
+    delivery), not the raw device harness."""
+    N = 50_000
+    adapter = MemoryQueueAdapter(n_queues=1)
+    fabric, silos = _build_silos(1, adapter, n_dense=N)
+    silo = silos[0]
+    await silo.start()
+    client = await ClusterClient(fabric).connect()
+    try:
+        provider = silo.stream_providers["queue"]
+        stream = StreamId("queue", "telemetry", "big")
+        keys = np.arange(N)
+        K = 8
+        rounds = np.ones((K, N), dtype=np.float32)
+        tbl = silo.vector.table(SensorVec)
+
+        # warmup (activates rows + compiles the scan kernel off the clock)
+        await provider.produce(stream, [
+            {"keys": keys, "args_rounds": {"v": rounds}}])
+        for _ in range(300):
+            await asyncio.sleep(0.02)
+            if int(tbl.read_row(0)["events"]) == K:
+                break
+        assert int(tbl.read_row(0)["events"]) == K
+
+        n_items = 6
+        t0 = time.perf_counter()
+        await provider.produce(stream, [
+            {"keys": keys, "args_rounds": {"v": rounds}}
+            for _ in range(n_items)])
+        target = K * (1 + n_items)
+        while int(tbl.read_row(0)["events"]) < target:
+            await asyncio.sleep(0.01)
+            assert time.perf_counter() - t0 < 30
+        elapsed = time.perf_counter() - t0
+        events = n_items * K * N
+        rate = events / elapsed
+        assert rate >= 1_000_000, f"{rate:.0f} events/sec through provider"
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_multi_silo_bulk_delivery_respects_ring_ownership():
+    """Bulk items pulled by one silo's agent must land on each key's ring
+    owner (the single-owner invariant of vector routing)."""
+    adapter = MemoryQueueAdapter(n_queues=2)
+    fabric, silos = _build_silos(2, adapter, n_dense=64)
+    for s in silos:
+        await s.start()
+    client = await ClusterClient(fabric).connect()
+    try:
+        provider = silos[0].stream_providers["queue"]
+        stream = StreamId("queue", "telemetry", "ms")
+        keys = np.arange(64)
+        vals = np.ones(64, dtype=np.float32)
+        await provider.produce(stream, [
+            {"keys": keys, "args": {"v": vals}}])
+        # wait for every key to be delivered exactly once, on SOME silo
+        def events_of(k):
+            total = 0
+            for s in silos:
+                tbl = s.vector.table(SensorVec)
+                if tbl.dense_active[k]:
+                    total += int(tbl.read_row(k)["events"])
+            return total
+        for _ in range(200):
+            await asyncio.sleep(0.02)
+            if all(events_of(k) == 1 for k in (0, 13, 37, 63)):
+                break
+        assert all(events_of(k) == 1 for k in range(64))
+        # and on the RIGHT silo: each key's row lives on its ring owner
+        from orleans_tpu.core.ids import GrainId, GrainType
+        ct = GrainType.of("SensorVec")
+        misplaced = 0
+        for k in range(64):
+            owner = silos[0].locator.ring.owner(
+                GrainId.for_grain(ct, int(k)).uniform_hash)
+            for s in silos:
+                if s.vector.table(SensorVec).dense_active[k] and \
+                        int(s.vector.table(SensorVec).read_row(k)["events"]):
+                    if s.silo_address != owner:
+                        misplaced += 1
+        assert misplaced == 0
+    finally:
+        await client.close_async()
+        for s in silos:
+            await s.stop()
