@@ -101,10 +101,13 @@ class DistributedLocator:
         self.cache_size = silo.config.directory_cache_size
         self.placement = PlacementManager(load_of=self._load_of)
         from ..versions import VersionManager
+        from ..versions.manager import TYPE_MANAGER_TARGET
         self.versions = VersionManager(silo)
         self.target = DirectoryTarget(self)
         self.target_id = silo.register_system_target(
             self.target, DIRECTORY_TARGET)
+        silo.register_system_target(self.versions.target,
+                                    TYPE_MANAGER_TARGET)
 
     # ------------------------------------------------------------------
     def _load_of(self, silo: SiloAddress) -> int:
@@ -253,18 +256,19 @@ class DistributedLocator:
         director = self.placement.director_by_name(placement_name)
         candidates = self._alive()
         if interface_name is not None:
-            # version gate at addressing time (Dispatcher.cs:725-732)
+            # version gate at addressing time (Dispatcher.cs:725-732).
+            # Cross-process silos are covered by the exchanged type map
+            # (TypeManager); a silo whose map has not arrived is simply
+            # not a candidate — gating never silently passes.
             compat = self.versions.compatible_silos(
                 interface_name, requested_version, candidates)
             if compat:
                 candidates = compat
-            elif any(self.versions.available_version(s, interface_name)
-                     is not None for s in candidates):
+            else:
                 from ..core.errors import OrleansError
                 raise OrleansError(
                     f"no silo hosts a version of {interface_name} compatible "
                     f"with requested v{requested_version}")
-            # else: no version info reachable (cross-process) — don't gate
         silo = director.place(grain_id, requester, candidates)
         return silo, True
 
@@ -319,6 +323,13 @@ class DistributedLocator:
         alive = set(silos)
         self.alive_set = alive
         self.alive_list = self.ring.silos
+        # type-map exchange bookkeeping (TypeManager refresh on change)
+        for d in dead:
+            self.versions.forget(d)
+        for s in silos:
+            if s != self.silo.silo_address and \
+                    s not in self.versions.remote_maps:
+                self.versions.schedule_fetch(s)
         # drop directory entries for activations on dead silos: the next
         # call re-creates the grain elsewhere (virtual-actor guarantee)
         for gid, addr in list(self.partition.items()):

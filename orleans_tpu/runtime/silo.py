@@ -356,6 +356,10 @@ class Silo:
             # pulling agents route owner-partitioned sub-batches here
             from ..streams.pubsub import install_vector_stream_target
             install_vector_stream_target(self)
+        start_exchange = getattr(
+            getattr(self.locator, "versions", None), "start_exchange", None)
+        if start_exchange is not None:
+            start_exchange()  # cluster type-map refresh (TypeManager)
         self.fabric.register_silo(self)
         for stage, start, _ in sorted(self._lifecycle, key=lambda x: x[0]):
             r = start()
@@ -400,6 +404,10 @@ class Silo:
         # background notification/retry tasks must not outlive the runtime
         for t in list(getattr(self, "_journal_notify_tasks", ())):
             t.cancel()
+        stop_exchange = getattr(
+            getattr(self.locator, "versions", None), "stop_exchange", None)
+        if stop_exchange is not None:
+            stop_exchange()
         self.message_center.stop()
         self.runtime_client.close()
         self.fabric.unregister_silo(self, dead=not graceful)

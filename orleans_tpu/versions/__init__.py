@@ -1,9 +1,11 @@
 """Interface versioning (reference src/Orleans.Runtime/Versions/)."""
 
 from .manager import (
+    TypeManagerTarget,
     VersionManager,
     grain_version,
     version_of,
 )
 
-__all__ = ["grain_version", "version_of", "VersionManager"]
+__all__ = ["grain_version", "version_of", "VersionManager",
+           "TypeManagerTarget"]

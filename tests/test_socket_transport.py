@@ -301,3 +301,82 @@ async def test_undecodable_payload_is_rejected_not_hung(tmp_path):
         if client is not None:
             await client.close_async()
         await silo.stop()
+
+
+# ---------------------------------------------------------------------------
+# Cross-process version-map exchange (TypeManager.cs:15 over the wire)
+# ---------------------------------------------------------------------------
+
+from orleans_tpu.versions import grain_version
+
+
+@grain_version(1)
+class _WireApiV1(Grain):
+    async def ping(self):
+        return ("v1", self.runtime_identity)
+
+
+@grain_version(2)
+class _WireApiV2(Grain):
+    async def ping(self):
+        return ("v2", self.runtime_identity)
+
+
+# one interface name, two versions — the rolling-upgrade shape
+_WireApiV1.__name__ = "WireApi"
+_WireApiV2.__name__ = "WireApi"
+
+
+async def test_version_map_exchanged_across_fabrics(tmp_path):
+    """Two silos in separate socket fabrics (the process-boundary shape):
+    the type maps ride the wire, and a v2-only call is routed away from
+    the v1 silo — the gating that used to be silently skipped when no
+    version info was reachable cross-process."""
+    table = FileMembershipTable(str(tmp_path / "mbr.json"))
+    fabric1, silo1 = await _start_socket_silo("v1silo", table,
+                                              grains=(_WireApiV1,))
+    fabric2, silo2 = await _start_socket_silo("v2silo", table,
+                                              grains=(_WireApiV2,))
+    client = None
+    try:
+        async def converged():
+            while True:
+                views = [set(s.membership.active) for s in (silo1, silo2)]
+                if all(len(v) == 2 for v in views) and views[0] == views[1]:
+                    return
+                await asyncio.sleep(0.05)
+        await asyncio.wait_for(converged(), timeout=10.0)
+
+        # maps exchanged over the wire (refresh loop / membership hook)
+        async def maps_arrived():
+            while not (
+                silo1.locator.versions.remote_maps.get(
+                    silo2.silo_address, {}).get("WireApi") == 2
+                and silo2.locator.versions.remote_maps.get(
+                    silo1.silo_address, {}).get("WireApi") == 1
+            ):
+                await asyncio.sleep(0.05)
+        await asyncio.wait_for(maps_arrived(), timeout=10.0)
+
+        # a v2-compiled caller entering through the v1 silo's gateway must
+        # still land on the v2 silo, for every key
+        client = await GatewayClient(
+            [silo1.silo_address.endpoint], response_timeout=5.0).connect()
+        for k in range(12):
+            v, where = await client.get_grain(_WireApiV2, k).ping()
+            assert v == "v2", f"key {k} served by {where}"
+
+        # strict compat cluster-wide: with only a v1 silo hosting the
+        # directory range... v2 calls with no exact-version host are
+        # rejected at addressing (gating runs on the directory owner)
+        silo1.locator.versions.set_strategy(compat="strict")
+        silo2.locator.versions.set_strategy(compat="strict")
+        await silo2.stop()  # v2 host gone: nothing can serve v2 strictly
+        with pytest.raises(Exception):
+            await asyncio.wait_for(
+                silo1.grain_factory.get_grain(_WireApiV2, 999).ping(), 6.0)
+    finally:
+        if client is not None:
+            await client.close_async()
+        await silo1.stop()
+        await silo2.stop()
