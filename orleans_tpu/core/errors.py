@@ -21,6 +21,13 @@ class OrleansError(Exception):
     """Base for all framework errors (``OrleansException``)."""
 
 
+class TransientPlacementError(OrleansError):
+    """Addressing failed for a reason expected to heal shortly (e.g. a
+    joining silo's type map has not arrived yet): surfaced to callers as
+    a TRANSIENT rejection so the resend machinery retries, instead of a
+    hard error."""
+
+
 class ConfigurationError(OrleansError):
     """Invalid options rejected by a validator
     (``OrleansConfigurationException``, Core/Configuration/Validators/)."""

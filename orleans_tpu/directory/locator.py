@@ -264,6 +264,17 @@ class DistributedLocator:
                 interface_name, requested_version, candidates)
             if compat:
                 candidates = compat
+            elif any(s != self.silo.silo_address
+                     and s not in self.versions.remote_maps
+                     and getattr(self.silo.fabric, "silos", {}).get(s) is None
+                     for s in candidates):
+                # some candidate's type map hasn't arrived yet (startup /
+                # join window): transient — the caller's resend retries
+                # after the exchange lands, rather than failing hard
+                from ..core.errors import TransientPlacementError
+                raise TransientPlacementError(
+                    f"type maps still exchanging for {interface_name}; "
+                    "retry")
             else:
                 from ..core.errors import OrleansError
                 raise OrleansError(

@@ -3,14 +3,20 @@
 Re-design of /root/reference/src/Orleans.Runtime/MultiClusterNetwork/
 MultiClusterOracle.cs:12 + MultiClusterGossipChannelFactory.cs: each cluster
 periodically merges its local view (its own gateways, stamped) with one or
-more gossip channels (Azure-table-backed in the reference; an in-memory
-shared object here) — last-writer-wins per cluster key.
+more gossip channels — last-writer-wins per cluster key. Channels are
+pluggable (the factory's job): in-memory for tests, JSON-file and sqlite
+for real multi-process deployments (the Azure-table channel stand-ins,
+mirroring the membership-table backend split).
 """
 
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
+import os
+import sqlite3
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING
@@ -22,7 +28,8 @@ if TYPE_CHECKING:
 
 log = logging.getLogger("orleans.multicluster")
 
-__all__ = ["MultiClusterData", "InMemoryGossipChannel", "MultiClusterOracle"]
+__all__ = ["MultiClusterData", "InMemoryGossipChannel", "FileGossipChannel",
+           "SqliteGossipChannel", "MultiClusterOracle"]
 
 
 @dataclass
@@ -68,6 +75,106 @@ class InMemoryGossipChannel(GossipChannel):
 
     async def read(self) -> MultiClusterData:
         return self._data.copy()
+
+
+def _data_to_json(data: MultiClusterData) -> dict:
+    return {cid: {"stamp": e["stamp"],
+                  "gateways": [[g.host, g.port, g.generation, g.mesh_index]
+                               for g in e["gateways"]]}
+            for cid, e in data.clusters.items()}
+
+
+def _data_from_json(raw: dict) -> MultiClusterData:
+    return MultiClusterData({
+        cid: {"stamp": e["stamp"],
+              "gateways": [SiloAddress(h, p, g, m)
+                           for h, p, g, m in e["gateways"]]}
+        for cid, e in raw.items()})
+
+
+class FileGossipChannel(GossipChannel):
+    """Cross-process channel: one JSON file shared by all clusters.
+    Publish is read-merge-write with an atomic replace; the merge is
+    commutative and idempotent (per-cluster last-writer-wins), so a lost
+    race between two processes heals on the next gossip round."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._lock = asyncio.Lock()
+
+    def _load(self) -> MultiClusterData:
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                return _data_from_json(json.load(f))
+        except (FileNotFoundError, json.JSONDecodeError):
+            return MultiClusterData()
+
+    async def publish(self, data: MultiClusterData) -> None:
+        def write() -> None:
+            merged = self._load()
+            merged.merge(data)
+            tmp = self.path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(_data_to_json(merged), f)
+            os.replace(tmp, self.path)
+
+        async with self._lock:  # file I/O off the loop (like the sqlite
+            # sibling): a slow/network filesystem must not stall turns
+            await asyncio.get_running_loop().run_in_executor(None, write)
+
+    async def read(self) -> MultiClusterData:
+        async with self._lock:
+            return await asyncio.get_running_loop().run_in_executor(
+                None, self._load)
+
+
+class SqliteGossipChannel(GossipChannel):
+    """Cross-process channel over sqlite (real database locking): one row
+    per cluster id, last-writer-wins by stamp."""
+
+    def __init__(self, path: str) -> None:
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._dblock = threading.Lock()
+        with self._dblock:
+            self._db.execute("PRAGMA journal_mode=WAL")
+            self._db.execute(
+                "CREATE TABLE IF NOT EXISTS gossip ("
+                " cluster TEXT PRIMARY KEY, stamp REAL, gateways TEXT)")
+            self._db.commit()
+
+    def close(self) -> None:
+        with self._dblock:
+            self._db.close()
+
+    async def publish(self, data: MultiClusterData) -> None:
+        def write() -> None:
+            with self._dblock:
+                for cid, e in data.clusters.items():
+                    row = self._db.execute(
+                        "SELECT stamp FROM gossip WHERE cluster=?",
+                        (cid,)).fetchone()
+                    if row is None or e["stamp"] > row[0]:
+                        self._db.execute(
+                            "INSERT OR REPLACE INTO gossip VALUES (?,?,?)",
+                            (cid, e["stamp"], json.dumps(
+                                [[g.host, g.port, g.generation, g.mesh_index]
+                                 for g in e["gateways"]])))
+                self._db.commit()
+
+        await asyncio.get_running_loop().run_in_executor(None, write)
+
+    async def read(self) -> MultiClusterData:
+        def load() -> MultiClusterData:
+            with self._dblock:
+                rows = self._db.execute(
+                    "SELECT cluster, stamp, gateways FROM gossip").fetchall()
+            return MultiClusterData({
+                cid: {"stamp": stamp,
+                      "gateways": [SiloAddress(h, p, g, m)
+                                   for h, p, g, m in json.loads(gws)]}
+                for cid, stamp, gws in rows})
+
+        return await asyncio.get_running_loop().run_in_executor(None, load)
 
 
 class MultiClusterOracle:
@@ -120,8 +227,15 @@ class MultiClusterOracle:
 
 
 def add_multicluster(builder, cluster_id: str, channels: list,
-                     gossip_period: float = 1.0):
-    """Install a gossip oracle on a SiloBuilder (silo.multicluster)."""
+                     gossip_period: float = 1.0, gsi: bool = True,
+                     maintainer_period: float = 1.0):
+    """Install a gossip oracle on a SiloBuilder (silo.multicluster), plus —
+    unless ``gsi=False`` — the Global-Single-Instance runtime: the
+    per-cluster directory grain, the cross-cluster gateway bridge, and the
+    Doubtful-retry maintainer (silo.gsi)."""
+    if gsi:
+        from .gsi import cluster_directory_grain_class
+        builder.add_grains(cluster_directory_grain_class())
 
     def install(silo) -> None:
         oracle = MultiClusterOracle(silo, cluster_id, channels, gossip_period)
@@ -130,5 +244,13 @@ def add_multicluster(builder, cluster_id: str, channels: list,
         silo.subscribe_lifecycle(
             ServiceLifecycleStage.RUNTIME_GRAIN_SERVICES,
             oracle.start, oracle.stop)
+        if gsi:
+            from .gsi import GsiRuntime
+            runtime = GsiRuntime(silo, oracle,
+                                 maintainer_period=maintainer_period)
+            silo.gsi = runtime
+            silo.subscribe_lifecycle(
+                ServiceLifecycleStage.RUNTIME_GRAIN_SERVICES,
+                runtime.start, runtime.stop)
 
     return builder.configure(install)

@@ -101,13 +101,218 @@ class GlobalSingleInstanceRegistrar:
         entry.owner_cluster = self.cluster_id
         return entry
 
-    async def retry_doubtful(self) -> None:
+    async def retry_doubtful(self) -> list[GrainId]:
         """GlobalSingleInstanceActivationMaintainer: re-run the protocol for
-        Doubtful and RaceLoser entries."""
+        Doubtful and RaceLoser entries. Returns the grain ids that ceded
+        ownership (became CACHED) — their local activations must die."""
+        ceded: list[GrainId] = []
         for gid, e in list(self.entries.items()):
             if e.state in (GsiState.DOUBTFUL, GsiState.RACE_LOSER):
                 del self.entries[gid]
-                await self.register(gid)
+                new = await self.register(gid)
+                if new.state == GsiState.CACHED:
+                    ceded.append(gid)
+        return ceded
 
     def unregister(self, grain_id: GrainId) -> None:
         self.entries.pop(grain_id, None)
+
+
+# ---------------------------------------------------------------------------
+# Cluster-level wiring: the directory grain, the cross-cluster bridge, the
+# Doubtful-retry maintainer, and incoming-call forwarding
+# ---------------------------------------------------------------------------
+
+def global_single_instance(cls: type) -> type:
+    """Class decorator: one activation of each key across ALL clusters
+    ([GlobalSingleInstance]). Calls arriving in a non-owner cluster are
+    forwarded to the owner cluster's gateway (return-to-origin forwarding,
+    Dispatcher.cs:534-546)."""
+    cls.__orleans_global_single_instance__ = True
+    return cls
+
+
+def _make_grain_base():
+    """Build the per-cluster directory grain (one activation, key="gsi"):
+    authoritative GSI ownership state + the grain-call surface remote
+    clusters query (ClusterGrainDirectory.cs:86-140). Built lazily to
+    avoid a module import cycle with the runtime.
+
+    The ownership map is the protocol's truth, so it must not vanish with
+    an idle sweep or a host-silo death: the grain is pinned against idle
+    collection AND persists its entries (StatefulGrain) — a reactivation
+    anywhere rebuilds the registrar from storage before answering."""
+    from ..runtime.grain import StatefulGrain, collection_age
+
+    @collection_age(10 * 365 * 24 * 3600.0)   # pinned: never idle-collect
+    class _ClusterDirectoryGrain(StatefulGrain):
+        def _registrar_ref(self) -> GlobalSingleInstanceRegistrar:
+            reg = getattr(self, "_registrar", None)
+            if reg is None:
+                gsi = self._activation.runtime.gsi
+                reg = self._registrar = GlobalSingleInstanceRegistrar(
+                    gsi.cluster_id, gsi.known_clusters, gsi.peer_query)
+                for gid, state, owner in self.state.get("entries", []):
+                    reg.entries[gid] = GsiEntry(gid, GsiState(state), owner)
+            return reg
+
+        async def _persist(self) -> None:
+            reg = self._registrar_ref()
+            self.state["entries"] = [
+                (gid, e.state.value, e.owner_cluster)
+                for gid, e in reg.entries.items()]
+            try:
+                await self.write_state()
+            except Exception:  # noqa: BLE001 — best-effort durability;
+                # in-memory state still serves until the next mutation
+                log.exception("GSI directory persist failed")
+
+        async def acquire(self, grain_id: GrainId) -> tuple[str, str]:
+            reg = self._registrar_ref()
+            before = reg.entries.get(grain_id)
+            e = await reg.register(grain_id)
+            if before is None or before.state != e.state:
+                await self._persist()
+            return (e.state.value, e.owner_cluster)
+
+        async def status(self, grain_id: GrainId
+                         ) -> tuple[str | None, str | None]:
+            state, owner = self._registrar_ref().status_of(grain_id)
+            return (state.value if state else None, owner)
+
+        async def release(self, grain_id: GrainId) -> None:
+            self._registrar_ref().unregister(grain_id)
+            await self._persist()
+
+        async def retry_doubtful(self) -> list:
+            reg = self._registrar_ref()
+            had_doubt = any(e.state in (GsiState.DOUBTFUL,
+                                        GsiState.RACE_LOSER)
+                            for e in reg.entries.values())
+            ceded = await reg.retry_doubtful()
+            if had_doubt:
+                await self._persist()
+            return ceded
+
+        async def cached_grains(self) -> list:
+            """Grain ids this cluster holds as CACHED (owned elsewhere) —
+            the maintainer's duplicate-deactivation sweep input."""
+            return [gid for gid, e in self._registrar_ref().entries.items()
+                    if e.state == GsiState.CACHED]
+
+    _ClusterDirectoryGrain.__name__ = "ClusterDirectoryGrain"
+    return _ClusterDirectoryGrain
+
+
+_grain_cls_cache: list = []
+
+
+def cluster_directory_grain_class() -> type:
+    if not _grain_cls_cache:
+        _grain_cls_cache.append(_make_grain_base())
+    return _grain_cls_cache[0]
+
+
+class GsiRuntime:
+    """Per-silo GSI services (installed as ``silo.gsi``): the cross-cluster
+    peer-query bridge over cluster gateways, an incoming-call decision
+    cache, and the Doubtful-retry maintainer
+    (GlobalSingleInstanceActivationMaintainer)."""
+
+    def __init__(self, silo, oracle, maintainer_period: float = 1.0):
+        self.silo = silo
+        self.oracle = oracle
+        self.cluster_id = oracle.cluster_id
+        self.maintainer_period = maintainer_period
+        self._clients: dict[str, object] = {}   # cluster_id -> GatewayClient
+        self._maintainer: asyncio.Task | None = None
+        self._tasks: set[asyncio.Task] = set()
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> None:
+        if self._maintainer is None:
+            self._maintainer = asyncio.get_running_loop().create_task(
+                self._maintainer_loop())
+
+    async def stop(self) -> None:
+        if self._maintainer is not None:
+            self._maintainer.cancel()
+            self._maintainer = None
+        for t in list(self._tasks):
+            t.cancel()
+        for c in self._clients.values():
+            try:
+                # close_async tears down the reconnect loop + sockets;
+                # the sync close() only breaks pending callbacks
+                await c.close_async()
+            except Exception:  # noqa: BLE001
+                pass
+        self._clients.clear()
+
+    def known_clusters(self) -> list[str]:
+        return self.oracle.known_clusters()
+
+    # -- local directory surface -----------------------------------------
+    def _directory(self):
+        return self.silo.grain_factory.get_grain(
+            cluster_directory_grain_class(), "gsi")
+
+    async def acquire(self, grain_id: GrainId) -> tuple[str, str]:
+        return tuple(await self._directory().acquire(grain_id))
+
+    async def status(self, grain_id: GrainId):
+        return tuple(await self._directory().status(grain_id))
+
+    # -- cross-cluster bridge --------------------------------------------
+    async def _client_for(self, cluster_id: str):
+        client = self._clients.get(cluster_id)
+        if client is not None and getattr(client, "connected", False):
+            return client
+        gateways = self.oracle.gateways_of(cluster_id)
+        if not gateways:
+            raise ConnectionError(f"no known gateways for {cluster_id}")
+        from ..runtime.socket_fabric import GatewayClient
+        client = GatewayClient([g.endpoint for g in gateways],
+                               response_timeout=5.0)
+        await client.connect()
+        self._clients[cluster_id] = client
+        return client
+
+    async def peer_query(self, cluster_id: str, grain_id: GrainId
+                         ) -> tuple[GsiState | None, str | None]:
+        """Query another cluster's directory over its gateway (the
+        cross-cluster half of ClusterGrainDirectory.ProcessRequest)."""
+        client = await self._client_for(cluster_id)
+        state, owner = await client.get_grain(
+            cluster_directory_grain_class(), "gsi").status(grain_id)
+        return (GsiState(state) if state else None, owner)
+
+    async def forward_call(self, owner_cluster: str, msg) -> object:
+        """Return-to-origin forwarding: run the grain call in the owner
+        cluster via its gateway and hand back the result."""
+        client = await self._client_for(owner_cluster)
+        args, kwargs = msg.body if msg.body is not None else ((), {})
+        return await client.send_request(
+            target_grain=msg.target_grain, grain_class=None,
+            interface_name=msg.interface_name, method_name=msg.method_name,
+            args=args, kwargs=kwargs)
+
+    # -- maintainer ------------------------------------------------------
+    async def _maintainer_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.maintainer_period)
+            if self.silo.status != "Running":
+                continue
+            try:
+                await self._directory().retry_doubtful()
+                # duplicate-deactivation sweep: any LOCAL activation of a
+                # grain the cluster directory marks CACHED (owned by
+                # another cluster) lost an ownership race — it must die.
+                # Every silo sweeps its own catalog, so duplicates die
+                # wherever they live, not just on the silo whose poll
+                # triggered the cede.
+                for gid in await self._directory().cached_grains() or []:
+                    for act in list(self.silo.catalog.by_grain.get(gid, [])):
+                        self.silo.catalog.schedule_deactivation(act)
+            except Exception:  # noqa: BLE001
+                log.debug("GSI maintainer round failed", exc_info=True)

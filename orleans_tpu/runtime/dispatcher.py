@@ -19,7 +19,11 @@ import logging
 import time
 from typing import TYPE_CHECKING
 
-from ..core.errors import GrainOverloadedError, NonExistentActivationError
+from ..core.errors import (
+    GrainOverloadedError,
+    NonExistentActivationError,
+    TransientPlacementError,
+)
 from ..core.message import (
     Category,
     Direction,
@@ -104,6 +108,20 @@ class Dispatcher:
             # (concurrent requests to one class coalesce automatically)
             self._handle_vector_request(vcls, msg)
             return
+        if self.silo.gsi is not None and \
+                not self.silo.catalog.by_grain.get(msg.target_grain):
+            cls = self.silo.registry.resolve(msg.interface_name)
+            if cls is not None and getattr(
+                    cls, "__orleans_global_single_instance__", False):
+                # global-single-instance grain with no local activation:
+                # acquire cluster ownership first; calls for grains owned
+                # by another cluster forward to its gateway
+                # (GSI protocol + return-to-origin, Dispatcher.cs:534-546)
+                self._track(asyncio.ensure_future(self._gsi_route(msg)))
+                return
+        self._receive_local(msg)
+
+    def _receive_local(self, msg: Message) -> None:
         try:
             activation = self.silo.catalog.get_or_create_activation(msg)
         except NonExistentActivationError as e:
@@ -155,6 +173,38 @@ class Dispatcher:
             self._reject_or_forward(msg, "activation invalid")
             return
         self.receive_request(activation, msg)
+
+    async def _gsi_route(self, msg: Message) -> None:
+        """Resolve cluster-level ownership for a GSI grain, then either
+        handle locally (we own / own-with-doubt) or forward to the owner
+        cluster's gateway and relay the response."""
+        gsi = self.silo.gsi
+        try:
+            state, owner = await gsi.acquire(msg.target_grain)
+        except Exception as e:  # noqa: BLE001 — registrar unreachable
+            self._reject(msg, RejectionType.TRANSIENT,
+                         f"GSI ownership unresolved: {e}")
+            return
+        if owner == gsi.cluster_id:
+            self._receive_local(msg)    # we own: ordinary activation path
+            return
+        from ..core.errors import GrainCallTimeoutError, SiloUnavailableError
+        try:
+            result = await gsi.forward_call(owner, msg)
+        except (ConnectionError, OSError, SiloUnavailableError,
+                GrainCallTimeoutError) as e:
+            # transport failure: transient — the resend retries, and the
+            # maintainer may flip us to Doubtful-owner later
+            self._reject(msg, RejectionType.TRANSIENT,
+                         f"GSI forward to {owner} failed: {e}")
+            return
+        except BaseException as e:  # noqa: BLE001 — the remote grain
+            # raised: an application error, NOT retryable — relay it
+            if msg.direction == Direction.REQUEST:
+                self.send_response(msg, make_error_response(msg, e))
+            return
+        if msg.direction == Direction.REQUEST:
+            self.send_response(msg, make_response(msg, result))
 
     def _handle_vector_request(self, vcls: type, msg: Message) -> None:
         """Bridge a host-tier message onto the device tier (the
@@ -450,6 +500,9 @@ class Dispatcher:
             # without an addressing task (the common case by far)
             try:
                 target = self.silo.locator.try_locate_sync(msg, grain_class)
+            except TransientPlacementError as e:
+                self._reject(msg, RejectionType.TRANSIENT, str(e))
+                return
             except Exception as e:  # noqa: BLE001 — same contract as async
                 log.exception("addressing failed for %s", msg.target_grain)
                 if msg.direction == Direction.REQUEST:
@@ -473,6 +526,8 @@ class Dispatcher:
             target = await self.silo.locator.locate(msg, grain_class)
             msg.target_silo = target
             self.transmit(msg)
+        except TransientPlacementError as e:
+            self._reject(msg, RejectionType.TRANSIENT, str(e))
         except Exception as e:  # noqa: BLE001
             log.exception("addressing failed for %s", msg.target_grain)
             if msg.direction == Direction.REQUEST:

@@ -299,6 +299,7 @@ class Silo:
         from ..directory.locator import DistributedLocator
         self.locator: Any = DistributedLocator(self)
         self.membership: Any = None       # installed by cluster join (L6)
+        self.gsi: Any = None              # installed by add_multicluster (L12)
         self.reminders: Any = None        # installed by reminder service (L11)
         self.transactions: Any = None     # installed by add_transactions (L11)
         # device tier (installed by dispatch.add_vector_grains): interface

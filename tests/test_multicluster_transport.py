@@ -1,0 +1,200 @@
+"""Multi-cluster federation over real transports: gossip via durable
+channels between clusters in separate socket fabrics (the process-boundary
+shape), GSI ownership + return-to-origin call forwarding over cluster
+gateways, and the Doubtful-retry maintainer resolving partition-era
+conflicts. Reference: MultiClusterOracle.cs:12,
+MultiClusterGossipChannelFactory.cs, ClusterGrainDirectory.cs:86-140,
+GlobalSingleInstanceActivationMaintainer.cs."""
+
+import asyncio
+
+import pytest
+
+from orleans_tpu.core.ids import GrainId
+from orleans_tpu.membership import FileMembershipTable, join_cluster
+from orleans_tpu.multicluster import (
+    FileGossipChannel,
+    GsiState,
+    SqliteGossipChannel,
+    add_multicluster,
+    cluster_directory_grain_class,
+    global_single_instance,
+)
+from orleans_tpu.runtime import GatewayClient, Grain, SiloBuilder, SocketFabric
+from orleans_tpu.runtime.grain import grain_type_of
+
+FAST = dict(
+    membership_probe_period=0.1,
+    membership_probe_timeout=0.2,
+    membership_missed_probes_limit=2,
+    membership_votes_needed=1,
+    membership_iam_alive_period=0.5,
+    membership_refresh_period=0.2,
+    membership_vote_expiration=5.0,
+    response_timeout=5.0,
+)
+
+
+@global_single_instance
+class ProfileGrain(Grain):
+    """One activation per key across ALL clusters."""
+
+    async def set_name(self, name):
+        self._name = name
+        return self.runtime_identity
+
+    async def get_name(self):
+        return (getattr(self, "_name", None), self.runtime_identity)
+
+    async def where(self):
+        return self.runtime_identity
+
+
+async def _start_cluster(cluster_id, channel, tmp_path,
+                         maintainer_period=0.2):
+    fabric = SocketFabric()
+    table = FileMembershipTable(str(tmp_path / f"mbr-{cluster_id}.json"))
+    b = (SiloBuilder().with_name(f"{cluster_id}-s0").with_fabric(fabric)
+         .add_grains(ProfileGrain).with_config(**FAST))
+    add_multicluster(b, cluster_id, [channel], gossip_period=0.1,
+                     maintainer_period=maintainer_period)
+    silo = b.build()
+    join_cluster(silo, table)
+    await silo.start()
+    return silo
+
+
+async def _wait_gossip(silo_a, silo_b, timeout=10.0):
+    async def ready():
+        while not (set(silo_a.multicluster.known_clusters())
+                   >= {"A", "B"}
+                   and set(silo_b.multicluster.known_clusters())
+                   >= {"A", "B"}
+                   and silo_a.multicluster.gateways_of("B")
+                   and silo_b.multicluster.gateways_of("A")):
+            await asyncio.sleep(0.05)
+    await asyncio.wait_for(ready(), timeout)
+
+
+async def test_gossip_over_file_channel_between_fabrics(tmp_path):
+    channel = FileGossipChannel(str(tmp_path / "gossip.json"))
+    a = await _start_cluster("A", channel, tmp_path)
+    b = await _start_cluster("B", channel, tmp_path)
+    try:
+        await _wait_gossip(a, b)
+        assert a.multicluster.gateways_of("B")[0].endpoint == \
+            b.silo_address.endpoint
+        assert b.multicluster.gateways_of("A")[0].endpoint == \
+            a.silo_address.endpoint
+    finally:
+        await a.stop()
+        await b.stop()
+
+
+async def test_gossip_over_sqlite_channel(tmp_path):
+    channel = SqliteGossipChannel(str(tmp_path / "gossip.db"))
+    a = await _start_cluster("A", channel, tmp_path)
+    b = await _start_cluster("B", channel, tmp_path)
+    try:
+        await _wait_gossip(a, b)
+        assert a.multicluster.gateways_of("B")
+        assert b.multicluster.gateways_of("A")
+    finally:
+        await a.stop()
+        await b.stop()
+        channel.close()
+
+
+async def test_gsi_ownership_and_cross_cluster_forwarding(tmp_path):
+    """First toucher owns globally; the other cluster's calls forward to
+    the owner's gateway (return-to-origin) and see the SAME activation."""
+    channel = FileGossipChannel(str(tmp_path / "gossip.json"))
+    a = await _start_cluster("A", channel, tmp_path)
+    b = await _start_cluster("B", channel, tmp_path)
+    ca = cb = None
+    try:
+        await _wait_gossip(a, b)
+        ca = await GatewayClient([a.silo_address.endpoint],
+                                 response_timeout=5.0).connect()
+        cb = await GatewayClient([b.silo_address.endpoint],
+                                 response_timeout=5.0).connect()
+        # cluster A touches p1 first: A acquires global ownership
+        where_a = await ca.get_grain(ProfileGrain, "p1").set_name("ada")
+        assert where_a == str(a.silo_address)
+        # cluster B's call forwards to A's activation — same state
+        name, where_b = await cb.get_grain(ProfileGrain, "p1").get_name()
+        assert name == "ada"
+        assert where_b == str(a.silo_address)  # served by cluster A
+        # B's cluster directory records CACHED with owner A
+        gid = GrainId.for_grain(grain_type_of(ProfileGrain), "p1")
+        state, owner = await b.gsi.status(gid)
+        assert state == GsiState.CACHED.value and owner == "A"
+        # A's records OWNED by itself
+        state, owner = await a.gsi.status(gid)
+        assert state == GsiState.OWNED.value and owner == "A"
+    finally:
+        for c in (ca, cb):
+            if c is not None:
+                await c.close_async()
+        await a.stop()
+        await b.stop()
+
+
+async def test_doubtful_ownership_resolves_via_maintainer(tmp_path):
+    """Partition: B cannot reach A, so B doubtful-owns and serves locally;
+    after the partition heals the maintainer re-runs the protocol, B cedes
+    to A (CACHED), deactivates its duplicate, and forwards again."""
+    channel = FileGossipChannel(str(tmp_path / "gossip.json"))
+    a = await _start_cluster("A", channel, tmp_path)
+    b = await _start_cluster("B", channel, tmp_path)
+    ca = cb = None
+    try:
+        await _wait_gossip(a, b)
+        ca = await GatewayClient([a.silo_address.endpoint],
+                                 response_timeout=5.0).connect()
+        cb = await GatewayClient([b.silo_address.endpoint],
+                                 response_timeout=5.0).connect()
+        # A owns p2
+        await ca.get_grain(ProfileGrain, "p2").set_name("alice")
+        gid = GrainId.for_grain(grain_type_of(ProfileGrain), "p2")
+
+        # partition B from A: peer queries + forwards fail
+        real_client_for = b.gsi._client_for
+
+        async def cut(cluster_id):
+            if cluster_id == "A":
+                raise ConnectionError("partitioned")
+            return await real_client_for(cluster_id)
+
+        b.gsi._client_for = cut
+        # B touches p2 during the partition: peers unreachable → DOUBTFUL,
+        # B serves locally (availability over consistency, as the
+        # reference's protocol does)
+        name, where = await cb.get_grain(ProfileGrain, "p2").get_name()
+        assert name is None                  # B's own (divergent) replica
+        assert where == str(b.silo_address)
+        state, owner = await b.gsi.status(gid)
+        assert state == GsiState.DOUBTFUL.value and owner == "B"
+
+        # heal: the maintainer re-runs the protocol, B cedes to A and
+        # kills its duplicate activation
+        b.gsi._client_for = real_client_for
+
+        async def ceded():
+            while True:
+                state, owner = await b.gsi.status(gid)
+                if state == GsiState.CACHED.value and owner == "A":
+                    if not b.catalog.by_grain.get(gid):
+                        return
+                await asyncio.sleep(0.05)
+        await asyncio.wait_for(ceded(), timeout=10.0)
+
+        # and calls from B forward to A's activation again
+        name, where = await cb.get_grain(ProfileGrain, "p2").get_name()
+        assert name == "alice" and where == str(a.silo_address)
+    finally:
+        for c in (ca, cb):
+            if c is not None:
+                await c.close_async()
+        await a.stop()
+        await b.stop()
