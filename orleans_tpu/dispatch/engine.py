@@ -561,7 +561,7 @@ class VectorRuntime:
     # Device-tier actor→actor messaging (the ICI fabric as an engine API)
     # ------------------------------------------------------------------
     def route(self, dest_class: type, dest_keys, payload: dict, valid,
-              capacity: int = 256):
+              capacity: int = 256, sparse: bool = False):
         """Route per-message payloads to the shards owning ``dest_keys``
         over the tick exchange (ONE all_to_all on the silo axis —
         parallel.transport; the reference's silo-to-silo TCP fabric,
@@ -573,18 +573,47 @@ class VectorRuntime:
         [n_shards, n_shards*capacity]. Overflow beyond ``capacity`` lanes
         per (src, dst) pair is dropped and counted (overload shedding —
         the host re-routes next tick).
+
+        ``sparse=True``: dest_keys is a ``(keys_lo, keys_hi)`` int32 pair
+        (62-bit uniform hashes split via ops.hash_probe.split64) and the
+        owning shard is resolved ON DEVICE through the table's
+        DeviceDirectory64 — the on-chip directory tier in the routing
+        path (AdaptiveGrainDirectoryCache.cs:178). Unregistered keys are
+        routed invalid (dropped + countable by the caller).
         """
         from ..parallel.transport import build_exchange
 
         if "__key__" in payload:
             raise ValueError("payload field name '__key__' is reserved")
         tbl = self.table(dest_class)
-        per = max(tbl.dense_per_shard, 1)
         key = ("exchange", tbl.n_shards, capacity)
         ex = self._kernel_cache.get(key)
         if ex is None:
             ex = build_exchange(self.mesh, capacity=capacity)
             self._kernel_cache[key] = ex
+        if sparse:
+            from ..ops.hash_probe import device_lookup64
+            from .table import _LOC_STRIDE
+            keys_lo, keys_hi = dest_keys
+            tk_lo, tk_hi, tv = tbl.device_dir.device_arrays()
+            loc, found = device_lookup64(
+                tk_lo, tk_hi, tv,
+                keys_lo.reshape(-1), keys_hi.reshape(-1),
+                tbl.device_dir.max_probes)
+            loc = loc.reshape(keys_lo.shape)
+            found = found.reshape(keys_lo.shape)
+            dest_shard = (loc // _LOC_STRIDE).astype(jnp.int32)
+            routable = valid & found
+            recv, recv_valid, drops = ex(
+                dest_shard, routable,
+                {"__key__": keys_lo, "__key_hi__": keys_hi, **payload})
+            # unregistered destinations count as drops per source shard
+            # (the caller's re-route/shed accounting), like overflow
+            drops = drops + jnp.sum(valid & ~found, axis=-1)
+            recv_lo = recv.pop("__key__")
+            recv_hi = recv.pop("__key_hi__")
+            return (recv_lo, recv_hi), recv, recv_valid, drops
+        per = max(tbl.dense_per_shard, 1)
         dest_shard = (dest_keys // per).astype(jnp.int32)
         recv, recv_valid, drops = ex(
             dest_shard, valid, {"__key__": dest_keys, **payload})
@@ -592,7 +621,7 @@ class VectorRuntime:
         return recv_keys, recv, recv_valid, drops
 
     def apply_received(self, dest_class: type, method: str, recv_keys,
-                       recv_valid, args: dict):
+                       recv_valid, args: dict, sparse: bool = False):
         """Apply routed messages as invocations on ``dest_class`` — the
         receive half of a cross-shard actor call, entirely on device.
 
@@ -619,6 +648,43 @@ class VectorRuntime:
         tbl = self.table(dest_class)
         self.method_of(dest_class, method)  # validate the method exists
         per = max(tbl.dense_per_shard, 1)
+
+        if sparse:
+            from ..ops.hash_probe import device_lookup64
+            from .table import _LOC_STRIDE
+            recv_lo, recv_hi = recv_keys
+            tk_lo, tk_hi, tv = tbl.device_dir.device_arrays()
+            probes = tbl.device_dir.max_probes
+
+            def local(klo, khi, ok, dlo, dhi, dv):
+                lo, hi, v = klo[0], khi[0], ok[0]
+                loc, found = device_lookup64(dlo, dhi, dv, lo, hi, probes)
+                if tbl.n_shards > 1:
+                    myshard = jax.lax.axis_index(SILO_AXIS)
+                else:
+                    myshard = 0
+                # defensive: a lane misrouted against a stale directory
+                # must not scribble another actor's slot on this shard
+                v = v & found & ((loc // _LOC_STRIDE) == myshard)
+                slot = jnp.where(v, loc % _LOC_STRIDE, tbl.capacity)
+                first = rank_dense_keys(jnp.where(v, slot,
+                                                  tbl.capacity + 1)) == 0
+                applied = v & first
+                slot = jnp.where(applied, slot, tbl.capacity)
+                return slot[None], applied[None], lo[None]
+
+            if tbl.n_shards > 1:
+                spec = P(SILO_AXIS)
+                local = jax.shard_map(
+                    local, mesh=self.mesh,
+                    in_specs=(spec, spec, spec, P(), P(), P()),
+                    out_specs=(spec, spec, spec), check_vma=False)
+            slots, applied, khash = jax.jit(local)(
+                recv_lo, recv_hi, recv_valid, tk_lo, tk_hi, tv)
+            fresh = jnp.zeros_like(applied)
+            results = self.call_batch_device(dest_class, method, slots,
+                                             khash, fresh, applied, args)
+            return results, applied
 
         def local(keys, ok):
             k, v = keys[0], ok[0]

@@ -32,6 +32,11 @@ import numpy as np
 from ..parallel.mesh import SILO_AXIS, make_mesh, shard_spec
 from .vector_grain import VectorGrain, vector_methods
 
+# directory-value encoding stride: loc = shard * _LOC_STRIDE + slot.
+# Fixed (not the live capacity) so encoded values survive table growth;
+# bounds per-shard capacity at 2^20 slots and shards at 2^10 within int32.
+_LOC_STRIDE = 1 << 20
+
 __all__ = ["ShardedActorTable"]
 
 
@@ -53,6 +58,13 @@ class ShardedActorTable:
 
         # host bookkeeping
         self.key_to_slot: dict[int, tuple[int, int]] = {}  # key_hash → (shard, slot)
+        # device-queryable mirror of the hashed-key directory: full 62-bit
+        # key identity, value = shard * (capacity+1) ... encoded lazily per
+        # lookup as shard/slot below. Lets sparse keys ride the on-device
+        # routing path (route/apply_received sparse mode) — the on-chip
+        # directory tier (ops.hash_probe; AdaptiveGrainDirectoryCache.cs:178)
+        from ..ops.hash_probe import DeviceDirectory64
+        self.device_dir = DeviceDirectory64()
         self.free: list[list[int]] = [
             list(range(self.capacity - 1, -1, -1)) for _ in range(self.n_shards)]
         self.dense_n = 0  # keys [0, dense_n) are dense-mapped
@@ -158,7 +170,15 @@ class ShardedActorTable:
             self.grow(self.capacity * 2)
         slot = self.free[shard].pop()
         self.key_to_slot[key_hash] = (shard, slot)
+        self.device_dir.insert(key_hash, self._encode_loc(shard, slot))
         return shard, slot, True
+
+    def _encode_loc(self, shard: int, slot: int) -> int:
+        """Pack (shard, slot) into one int32 directory value. Slots are
+        encoded against a fixed 2^20 stride (not the live capacity) so
+        values survive table growth without re-encoding the directory."""
+        assert slot < _LOC_STRIDE
+        return shard * _LOC_STRIDE + slot
 
     def lookup(self, key_hash: int) -> tuple[int, int] | None:
         return self.key_to_slot.get(key_hash)
@@ -170,6 +190,7 @@ class ShardedActorTable:
         if loc is None:
             return False
         self.free[loc[0]].append(loc[1])
+        self.device_dir.remove(key_hash)
         return True
 
     # -- growth -----------------------------------------------------------

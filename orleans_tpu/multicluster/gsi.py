@@ -144,6 +144,14 @@ def _make_grain_base():
     anywhere rebuilds the registrar from storage before answering."""
     from ..runtime.grain import StatefulGrain, collection_age
 
+    from ..runtime.grain import reentrant
+
+    # Reentrant like the reference's interleaving ClusterGrainDirectory
+    # SystemTarget: acquire() awaits cross-cluster peer queries, and two
+    # clusters' simultaneous first-touches would otherwise deadlock each
+    # other's directory turns into response-timeout DOUBTFULs (duplicate
+    # owners on a healthy network).
+    @reentrant
     @collection_age(10 * 365 * 24 * 3600.0)   # pinned: never idle-collect
     class _ClusterDirectoryGrain(StatefulGrain):
         def _registrar_ref(self) -> GlobalSingleInstanceRegistrar:
@@ -225,8 +233,8 @@ class GsiRuntime:
         self.cluster_id = oracle.cluster_id
         self.maintainer_period = maintainer_period
         self._clients: dict[str, object] = {}   # cluster_id -> GatewayClient
+        self._client_locks: dict[str, asyncio.Lock] = {}
         self._maintainer: asyncio.Task | None = None
-        self._tasks: set[asyncio.Task] = set()
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> None:
@@ -238,8 +246,6 @@ class GsiRuntime:
         if self._maintainer is not None:
             self._maintainer.cancel()
             self._maintainer = None
-        for t in list(self._tasks):
-            t.cancel()
         for c in self._clients.values():
             try:
                 # close_async tears down the reconnect loop + sockets;
@@ -268,15 +274,26 @@ class GsiRuntime:
         client = self._clients.get(cluster_id)
         if client is not None and getattr(client, "connected", False):
             return client
-        gateways = self.oracle.gateways_of(cluster_id)
-        if not gateways:
-            raise ConnectionError(f"no known gateways for {cluster_id}")
-        from ..runtime.socket_fabric import GatewayClient
-        client = GatewayClient([g.endpoint for g in gateways],
-                               response_timeout=5.0)
-        await client.connect()
-        self._clients[cluster_id] = client
-        return client
+        lock = self._client_locks.setdefault(cluster_id, asyncio.Lock())
+        async with lock:  # dedup concurrent connects; one client per peer
+            client = self._clients.get(cluster_id)
+            if client is not None and getattr(client, "connected", False):
+                return client
+            if client is not None:
+                try:  # replaced stale client: tear down its reconnector
+                    await client.close_async()
+                except Exception:  # noqa: BLE001
+                    pass
+                self._clients.pop(cluster_id, None)
+            gateways = self.oracle.gateways_of(cluster_id)
+            if not gateways:
+                raise ConnectionError(f"no known gateways for {cluster_id}")
+            from ..runtime.socket_fabric import GatewayClient
+            client = GatewayClient([g.endpoint for g in gateways],
+                                   response_timeout=5.0)
+            await client.connect()
+            self._clients[cluster_id] = client
+            return client
 
     async def peer_query(self, cluster_id: str, grain_id: GrainId
                          ) -> tuple[GsiState | None, str | None]:

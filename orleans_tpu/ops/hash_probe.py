@@ -23,7 +23,8 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["EMPTY", "build_directory_arrays", "device_lookup",
-           "DeviceDirectory"]
+           "DeviceDirectory", "device_lookup64", "DeviceDirectory64",
+           "split64"]
 
 EMPTY = -1
 _MULT = np.uint32(2654435761)  # Knuth multiplicative hash
@@ -101,6 +102,156 @@ def device_lookup(tkeys: jax.Array, tvals: jax.Array, keys: jax.Array,
     first = jnp.argmax(hit, axis=1)
     vals = tvals[jnp.take_along_axis(probes, first[:, None], axis=1)[:, 0]]
     return jnp.where(found, vals, 0), found
+
+
+def split64(keys: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Split uint64-domain keys into (lo31, hi31) int32 halves — the wire
+    layout for 62-bit uniform hashes on a 32-bit device (x64 stays off)."""
+    k = np.asarray(keys, dtype=np.int64)
+    lo = (k & 0x7FFFFFFF).astype(np.int32)
+    hi = ((k >> 31) & 0x7FFFFFFF).astype(np.int32)
+    return lo, hi
+
+
+def device_lookup64(tk_lo: jax.Array, tk_hi: jax.Array, tvals: jax.Array,
+                    keys_lo: jax.Array, keys_hi: jax.Array,
+                    max_probes: int = 16):
+    """Batched lookup with FULL 62-bit key identity: (lo, hi) [B] int32
+    halves → (vals [B] int32, found [B] bool). The 31-bit probe hash comes
+    from the low half; a hit requires BOTH halves to match, so distinct
+    uniform hashes can never alias onto another actor's slot (the
+    correctness bar for routing, vs the 31-bit cache-tier lookup)."""
+    cap = tk_lo.shape[0]
+    lo = (keys_lo & 0x7FFFFFFF).astype(jnp.int32)
+    hi = (keys_hi & 0x7FFFFFFF).astype(jnp.int32)
+    h = _hash_jnp(lo, cap)
+    probes = (h[:, None] + jnp.arange(max_probes, dtype=jnp.int32)) % cap
+    plo = tk_lo[probes]                                      # [B, P]
+    phi = tk_hi[probes]
+    match = (plo == lo[:, None]) & (phi == hi[:, None])
+    before_empty = jnp.cumprod((plo != EMPTY).astype(jnp.int32),
+                               axis=1).astype(bool)
+    hit = match & before_empty
+    found = jnp.any(hit, axis=1)
+    first = jnp.argmax(hit, axis=1)
+    vals = tvals[jnp.take_along_axis(probes, first[:, None], axis=1)[:, 0]]
+    return jnp.where(found, vals, 0), found
+
+
+class DeviceDirectory64:
+    """Host-mutated, device-queried directory over full 62-bit keys:
+    (lo31, hi31) split cells, linear probing on the low half, backward-
+    shift delete. The authoritative key→slot map for sparse vector-grain
+    keys in the on-device routing path (route/apply_received sparse mode —
+    the on-chip analog of AdaptiveGrainDirectoryCache.cs:178, promoted
+    from cache to resolver because both key halves are checked)."""
+
+    def __init__(self, capacity: int = 1024, max_probes: int = 16):
+        if capacity & (capacity - 1):
+            raise ValueError("capacity must be a power of two")
+        self.capacity = capacity
+        self.max_probes = max_probes
+        self.tk_lo = np.full(capacity, EMPTY, dtype=np.int32)
+        self.tk_hi = np.zeros(capacity, dtype=np.int32)
+        self.tvals = np.zeros(capacity, dtype=np.int32)
+        self.count = 0
+        self._dev: tuple[jax.Array, jax.Array, jax.Array] | None = None
+
+    @staticmethod
+    def _split(key: int) -> tuple[int, int]:
+        if key < 0:
+            raise ValueError(f"directory keys must be non-negative: {key}")
+        return key & 0x7FFFFFFF, (key >> 31) & 0x7FFFFFFF
+
+    def _probe_host(self, lo: int, hi: int) -> int | None:
+        h = int(_hash_np(np.asarray(lo), self.capacity))
+        for p in range(self.max_probes):
+            idx = (h + p) % self.capacity
+            if self.tk_lo[idx] == EMPTY or (
+                    self.tk_lo[idx] == lo and self.tk_hi[idx] == hi):
+                return idx
+        return None
+
+    def insert(self, key: int, val: int) -> None:
+        if (self.count + 1) * 2 > self.capacity:
+            self._grow()
+        lo, hi = self._split(key)
+        idx = self._probe_host(lo, hi)
+        if idx is None:
+            self._grow()
+            idx = self._probe_host(lo, hi)
+            assert idx is not None
+        if self.tk_lo[idx] == EMPTY:
+            self.count += 1
+        self.tk_lo[idx] = lo
+        self.tk_hi[idx] = hi
+        self.tvals[idx] = val
+        self._dev = None
+
+    def remove(self, key: int) -> bool:
+        lo, hi = self._split(key)
+        h = int(_hash_np(np.asarray(lo), self.capacity))
+        idx = None
+        for p in range(self.max_probes):
+            i = (h + p) % self.capacity
+            if self.tk_lo[i] == lo and self.tk_hi[i] == hi:
+                idx = i
+                break
+            if self.tk_lo[i] == EMPTY:
+                return False
+        if idx is None:
+            return False
+        self.tk_lo[idx] = EMPTY
+        self.count -= 1
+        j = (idx + 1) % self.capacity
+        moved: list[tuple[int, int, int]] = []
+        while self.tk_lo[j] != EMPTY:
+            moved.append((int(self.tk_lo[j]), int(self.tk_hi[j]),
+                          int(self.tvals[j])))
+            self.tk_lo[j] = EMPTY
+            self.count -= 1
+            j = (j + 1) % self.capacity
+        for mlo, mhi, mv in moved:
+            i2 = self._probe_host(mlo, mhi)
+            assert i2 is not None
+            if self.tk_lo[i2] == EMPTY:
+                self.count += 1
+            self.tk_lo[i2] = mlo
+            self.tk_hi[i2] = mhi
+            self.tvals[i2] = mv
+        self._dev = None
+        return True
+
+    def _grow(self) -> None:
+        old = [(int(lo) | (int(hi) << 31), int(v))
+               for lo, hi, v in zip(self.tk_lo, self.tk_hi, self.tvals)
+               if lo != EMPTY]
+        self.capacity *= 2
+        self.tk_lo = np.full(self.capacity, EMPTY, dtype=np.int32)
+        self.tk_hi = np.zeros(self.capacity, dtype=np.int32)
+        self.tvals = np.zeros(self.capacity, dtype=np.int32)
+        self.count = 0
+        self._dev = None
+        for k, v in old:
+            self.insert(k, v)
+
+    def device_arrays(self) -> tuple[jax.Array, jax.Array, jax.Array]:
+        if self._dev is None:
+            self._dev = (jnp.asarray(self.tk_lo), jnp.asarray(self.tk_hi),
+                         jnp.asarray(self.tvals))
+        return self._dev
+
+    def lookup_batch(self, keys_lo, keys_hi) -> tuple[jax.Array, jax.Array]:
+        lo, hi, tv = self.device_arrays()
+        return device_lookup64(lo, hi, tv, jnp.asarray(keys_lo),
+                               jnp.asarray(keys_hi), self.max_probes)
+
+    def lookup(self, key: int) -> int | None:
+        lo, hi = self._split(key)
+        idx = self._probe_host(lo, hi)
+        if idx is None or self.tk_lo[idx] != lo:
+            return None
+        return int(self.tvals[idx])
 
 
 class DeviceDirectory:

@@ -191,6 +191,8 @@ class Dispatcher:
         from ..core.errors import GrainCallTimeoutError, SiloUnavailableError
         try:
             result = await gsi.forward_call(owner, msg)
+        except asyncio.CancelledError:
+            raise  # silo stop cancelled the forward: no bogus response
         except (ConnectionError, OSError, SiloUnavailableError,
                 GrainCallTimeoutError) as e:
             # transport failure: transient — the resend retries, and the

@@ -114,3 +114,73 @@ def test_reserved_payload_name_rejected():
         rt.route(BankVec, jnp.zeros((8, 2), jnp.int32),
                  {"__key__": jnp.zeros((8, 2), jnp.int32)},
                  jnp.ones((8, 2), bool))
+
+
+# ---------------------------------------------------------------------------
+# Sparse keys over the exchange: on-device directory resolution
+# (ops.hash_probe.DeviceDirectory64 in the routing path)
+# ---------------------------------------------------------------------------
+
+def test_sparse_keys_route_via_device_directory():
+    """Hashed (non-dense) keys ride the exchange: the owning shard and slot
+    resolve ON DEVICE through the table's DeviceDirectory64 — previously
+    sparse keys could not use the device routing path at all."""
+    import asyncio
+    from orleans_tpu.ops.hash_probe import split64
+
+    rt = VectorRuntime(mesh=make_mesh(8), capacity_per_shard=8)
+    tbl = rt.table(BankVec)
+    n = tbl.n_shards
+
+    # allocate sparse keys (62-bit uniform-hash domain) via the per-key path
+    hashes = [((k * 2654435761) ^ (k << 33)) & ((1 << 62) - 1) | (1 << 40)
+              for k in range(1, 17)]
+
+    async def activate():
+        await asyncio.gather(*(
+            rt.call(BankVec, h, "deposit", amount=np.int32(0))
+            for h in hashes))
+    asyncio.run(activate())
+    assert tbl.device_dir.count == len(hashes)
+
+    # every shard sends 2 messages to sparse keys spread over the set
+    B = 2
+    dest = np.zeros((n, B), np.int64)
+    amount = np.zeros((n, B), np.int32)
+    expect = {}
+    for s in range(n):
+        for i in range(B):
+            h = hashes[(s * B + i) % len(hashes)]
+            dest[s, i] = h
+            amount[s, i] = 100 + s * B + i
+            expect[h] = expect.get(h, 0) + amount[s, i]
+    lo, hi = split64(dest)
+    valid = np.ones((n, B), bool)
+
+    rkeys, rpay, rvalid, drops = rt.route(
+        BankVec, (jnp.asarray(lo), jnp.asarray(hi)),
+        {"amount": jnp.asarray(amount)}, jnp.asarray(valid),
+        capacity=16, sparse=True)
+    assert int(np.asarray(drops).sum()) == 0
+    results, applied = rt.apply_received(
+        BankVec, "deposit", rkeys, rvalid, rpay, sparse=True)
+    assert int(np.asarray(applied).sum()) == n * B
+
+    for h, total in expect.items():
+        row = tbl.read_row(h)
+        assert int(row["balance"]) == total, h
+
+    # unregistered keys are dropped at routing (found=False), not applied
+    ghost = np.full((n, B), (1 << 50) | 12345, np.int64)
+    glo, ghi = split64(ghost)
+    rkeys, rpay, rvalid, drops = rt.route(
+        BankVec, (jnp.asarray(glo), jnp.asarray(ghi)),
+        {"amount": jnp.asarray(amount)}, jnp.asarray(valid),
+        capacity=16, sparse=True)
+    results, applied = rt.apply_received(
+        BankVec, "deposit", rkeys, rvalid, rpay, sparse=True)
+    assert int(np.asarray(applied).sum()) == 0
+
+    # release removes from the device directory too
+    tbl.release(hashes[0])
+    assert tbl.device_dir.lookup(hashes[0]) is None
