@@ -19,6 +19,7 @@ from benchmarks import (
     mapreduce,
     ping,
     serialization,
+    streams_vector,
     transactions,
 )
 
@@ -31,9 +32,12 @@ def main() -> None:
     for r in serialization.run():
         print(json.dumps(r))
     print(json.dumps(asyncio.run(transactions.run(seconds=3.0))))
+    print(json.dumps(asyncio.run(transactions.run(seconds=3.0,
+                                                  concurrency=32))))
     print(json.dumps(chirper_fanout.run(seconds=5.0)))
     for r in asyncio.run(gpstracker_stream.run(seconds=2.0)):
         print(json.dumps(r))
+    print(json.dumps(asyncio.run(streams_vector.run(n_keys=50_000))))
 
 
 if __name__ == "__main__":

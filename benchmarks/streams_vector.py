@@ -1,0 +1,111 @@
+"""Device-tier stream delivery throughput — the PROVIDER path.
+
+Measures events/sec through the full persistent-stream machinery
+(produce → queue → pulling agent → pub-sub resolve → batched kernel
+delivery to a VectorGrain consumer), NOT the raw device harness. This is
+the pulling-agent pump of PersistentStreamPullingAgent.cs:141,350-368
+re-expressed as scanned kernel ticks (streams.pubsub
+deliver_to_vector_consumer).
+
+Run: python benchmarks/streams_vector.py [--keys N] [--rounds K] [--items I]
+"""
+
+import argparse
+import asyncio
+import json
+import time
+
+if __package__ in (None, ""):
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+async def run(n_keys: int = 100_000, rounds: int = 8,
+              items: int = 8) -> dict:
+    import jax.numpy as jnp
+
+    from orleans_tpu.dispatch import (
+        VectorGrain,
+        actor_method,
+        add_vector_grains,
+    )
+    from orleans_tpu.parallel import make_mesh
+    from orleans_tpu.runtime import ClusterClient, SiloBuilder
+    from orleans_tpu.streams import MemoryQueueAdapter, StreamId, \
+        add_persistent_streams
+    from orleans_tpu.streams.pubsub import implicit_stream_subscription
+
+    @implicit_stream_subscription("telemetry")
+    class SensorVec(VectorGrain):
+        STATE = {"events": (jnp.int32, ()), "total": (jnp.float32, ())}
+
+        @staticmethod
+        def initial_state(key_hash):
+            return {"events": jnp.int32(0), "total": jnp.float32(0)}
+
+        @actor_method(args={"v": (jnp.float32, ())})
+        def on_next(state, args):
+            return {"events": state["events"] + 1,
+                    "total": state["total"] + args["v"]}, state["events"]
+
+    adapter = MemoryQueueAdapter(n_queues=1)
+    b = SiloBuilder().with_name("svbench")
+    add_vector_grains(b, SensorVec, mesh=make_mesh(),
+                      capacity_per_shard=n_keys, dense={SensorVec: n_keys})
+    add_persistent_streams(b, "queue", adapter, pull_period=0.005)
+    silo = b.build()
+    await silo.start()
+    client = await ClusterClient(silo.fabric).connect()
+    try:
+        provider = silo.stream_providers["queue"]
+        stream = StreamId("queue", "telemetry", "bench")
+        keys = np.arange(n_keys)
+        payload = np.ones((rounds, n_keys), dtype=np.float32)
+        tbl = silo.vector.table(SensorVec)
+
+        def item():
+            return {"keys": keys, "args_rounds": {"v": payload}}
+
+        # warmup: activation + scan-kernel compile off the clock
+        await provider.produce(stream, [item()])
+        deadline = time.perf_counter() + 60
+        while int(tbl.read_row(0)["events"]) < rounds:
+            await asyncio.sleep(0.01)
+            assert time.perf_counter() < deadline, "warmup stalled"
+
+        t0 = time.perf_counter()
+        await provider.produce(stream, [item() for _ in range(items)])
+        target = rounds * (1 + items)
+        while int(tbl.read_row(0)["events"]) < target:
+            await asyncio.sleep(0.005)
+            assert time.perf_counter() - t0 < 120
+        elapsed = time.perf_counter() - t0
+        events = items * rounds * n_keys
+        return {
+            "metric": "streams_vector_provider_events_per_sec",
+            "value": round(events / elapsed, 1),
+            "unit": "events/sec",
+            "vs_baseline": None,
+            "extra": {"keys": n_keys, "rounds_per_item": rounds,
+                      "items": items, "events": events,
+                      "elapsed_s": round(elapsed, 3)},
+        }
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--keys", type=int, default=100_000)
+    ap.add_argument("--rounds", type=int, default=8)
+    ap.add_argument("--items", type=int, default=8)
+    a = ap.parse_args()
+    print(json.dumps(asyncio.run(run(a.keys, a.rounds, a.items))))
+
+
+if __name__ == "__main__":
+    main()
