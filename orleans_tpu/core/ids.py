@@ -100,6 +100,12 @@ _grain_id_intern: dict = {}
 _INTERN_LIMIT = 1 << 17
 
 
+def _rebuild_grain_id(category: int, type_code: int, key,
+                      key_ext, hash64: int) -> "GrainId":
+    """Wire-decode constructor for GrainId.__reduce__ (hash precomputed)."""
+    return GrainId(GrainCategory(category), type_code, key, key_ext, hash64)
+
+
 @dataclass(frozen=True)
 class GrainId:
     """Grain identity = (category, type_code, primary key [, key extension]).
@@ -183,6 +189,14 @@ class GrainId:
         # precomputed 64-bit hash beats re-hashing the field tuple per op
         return self._hash64
 
+    def __reduce__(self):
+        # compact wire form: a 5-tuple of primitives (the default frozen-
+        # dataclass pickling writes the field-name dict + the enum by
+        # reference — ~3x the bytes and time). Carrying _hash64 skips the
+        # __post_init__ re-hash on decode.
+        return (_rebuild_grain_id, (int(self.category), self.type_code,
+                                    self.key, self.key_ext, self._hash64))
+
     def is_client(self) -> bool:
         return self.category == GrainCategory.CLIENT
 
@@ -226,6 +240,10 @@ class SiloAddress:
     def __hash__(self) -> int:
         return self._uh
 
+    def __reduce__(self):
+        return (SiloAddress, (self.host, self.port, self.generation,
+                              self.mesh_index, self._uh))
+
     def same_endpoint(self, other: "SiloAddress") -> bool:
         return self.host == other.host and self.port == other.port
 
@@ -255,6 +273,9 @@ class ActivationId:
     @classmethod
     def new(cls) -> "ActivationId":
         return cls(_activation_rng.getrandbits(63))
+
+    def __reduce__(self):
+        return (ActivationId, (self.value,))
 
     def __str__(self) -> str:
         return f"act-{self.value:016x}"

@@ -183,6 +183,31 @@ def make_request(
     )
 
 
+def make_request_fast(
+    category, direction, sending_silo, sending_grain, sending_activation,
+    target_silo, target_grain, interface_name, method_name, body,
+    expires_at, call_chain, is_read_only, is_always_interleave,
+    request_context, interface_version,
+) -> Message:
+    """Positional hot-path twin of :func:`make_request` (the RPC engine
+    builds one Message per call; 28 kwargs are measurable there). The
+    field list lives here, beside the dataclass, so reordering Message
+    fields has exactly one positional construction site per shape to
+    update (this, make_request, created_response)."""
+    return Message(
+        category, direction, next(_correlation),
+        sending_silo, sending_grain, sending_activation,
+        target_silo, target_grain, None,
+        interface_name, method_name, body,
+        ResponseKind.SUCCESS, None, None,
+        0, 0, expires_at,
+        call_chain, is_read_only, is_always_interleave,
+        False, False, None,
+        request_context, False, None,
+        interface_version,
+    )
+
+
 def make_response(request: Message, result: Any) -> Message:
     return request.created_response(ResponseKind.SUCCESS, result)
 

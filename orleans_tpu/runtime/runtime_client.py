@@ -25,7 +25,7 @@ from ..core.message import (
     Direction,
     Message,
     ResponseKind,
-    make_request,
+    make_request_fast,
 )
 from ..core.serialization import copy_call_body, deep_copy
 from .context import TXN_KEY, RequestContext, current_activation
@@ -192,26 +192,21 @@ class RuntimeClient:
         # Copy-isolate arguments at send time (SerializationManager.DeepCopy
         # for in-silo calls): caller mutations after the call cannot leak into
         # the callee. Immutable-wrapped args pass by reference.
-        msg = make_request(
-            target_grain=target_grain,
-            interface_name=interface_name,
-            method_name=method_name,
+        msg = make_request_fast(
+            category if category is not None else Category.APPLICATION,
+            Direction.ONE_WAY if is_one_way else Direction.REQUEST,
+            self.silo_address,
+            sender.grain_id if sender else None,
+            sender.activation_id if sender else None,
+            target_silo, target_grain, interface_name, method_name,
             # filtered sends already copy-isolated at send_request time;
             # copying twice would double serialization on the hot path
-            body=(args, kwargs) if body_precopied
+            (args, kwargs) if body_precopied
             else copy_call_body(args, kwargs),
-            direction=Direction.ONE_WAY if is_one_way else Direction.REQUEST,
-            category=category if category is not None else Category.APPLICATION,
-            target_silo=target_silo,
-            sending_silo=self.silo_address,
-            sending_grain=sender.grain_id if sender else None,
-            sending_activation=sender.activation_id if sender else None,
-            timeout=timeout,
-            call_chain=call_chain,
-            is_read_only=is_read_only,
-            is_always_interleave=is_always_interleave,
-            request_context=RequestContext.export(),
-            interface_version=getattr(grain_class, "__orleans_version__", 0),
+            (time.monotonic() + timeout) if timeout is not None else None,
+            call_chain, is_read_only, is_always_interleave,
+            RequestContext.export(),
+            getattr(grain_class, "__orleans_version__", 0),
         )
         return self._send(msg, is_one_way, timeout)
 
