@@ -261,6 +261,18 @@ class RuntimeClient:
             # GATEWAY_TOO_BUSY is retryable: the resend re-picks a gateway
             # (the reference's client reroutes around overloaded gateways)
             if (msg.rejection_type is not None
+                    and cb.message.target_grain is not None
+                    and cb.message.target_grain.is_system_target()):
+                # system targets are silo-bound by construction: when the
+                # pinned silo is gone, re-addressing would place the id as
+                # an ordinary grain and bounce to the forward limit —
+                # break the caller instead (the reference's
+                # BreakOutstandingMessagesToDeadSilo for pinned targets)
+                asyncio.get_running_loop().call_soon(
+                    _resolve_future, cb.future, None, SiloUnavailableError(
+                        msg.rejection_info or "system target unreachable"))
+                return
+            if (msg.rejection_type is not None
                     and cb.message.resend_count < MAX_RESEND_COUNT
                     and msg.rejection_type.name in (
                         "TRANSIENT", "CACHE_INVALIDATION",

@@ -132,10 +132,27 @@ class TransactionManagerGrain(Grain):
         ``participants``: [(GrainId, interface_name)] collected by the
         caller's agent."""
         prior = self._decisions.get(txn)
-        if prior is not None:            # duplicate commit (client retry)
-            return prior[0] == "committed"
+        if prior is not None:
+            # duplicate commit (client resend — e.g. the original TM
+            # incarnation died between logging the decision and finishing
+            # the fanout): the decision stands, but the outcome must be
+            # RE-DRIVEN — participants may never have heard it, and the
+            # old incarnation's undelivered-outcome queue died with it.
+            # Deliveries are idempotent (applied txns no-op).
+            if prior[0] == "committed":
+                await self._fanout(txn, participants, "_txn_commit", txn,
+                                   prior[1])
+                return True
+            await self._fanout(txn, participants, "_txn_abort", txn)
+            return False
         if time.time() > deadline:
-            await self._decide(txn, "aborted")
+            decision, version = await self._decide(txn, "aborted")
+            if decision == "committed":
+                # a duplicate incarnation already committed this txn: the
+                # log's decision stands regardless of our local deadline
+                await self._fanout(txn, participants, "_txn_commit", txn,
+                                   version)
+                return True
             await self._fanout(txn, participants, "_txn_abort", txn)
             return False
         votes = await _collect(
@@ -218,11 +235,21 @@ class TransactionManagerGrain(Grain):
                 await self._compact_gate.wait()
             self._appends_inflight += 1
             try:
-                await self._cfg.log.append(int(self.grain_id.key), txn,
-                                           decision, version)
+                # first-decision-wins at the LOG, not just this
+                # activation's memory: a concurrent duplicate TM
+                # incarnation (membership-transition window) may have
+                # already decided this txn — its record must win or a
+                # presumed abort could race a commit onto different
+                # participants
+                rec = await self._cfg.log.decide(
+                    int(self.grain_id.key), txn, decision, version)
             finally:
                 self._appends_inflight -= 1
-            rec = (decision, version)
+            if rec[1] > (self._seq or 0):
+                # the winning record came from another incarnation ahead
+                # of us: adopt its sequence so later commits stay monotone
+                # (same shard → same residue mod n_shards, congruence holds)
+                self._seq = rec[1]
             self._decisions[txn] = rec
             fut.set_result(rec)
             return rec

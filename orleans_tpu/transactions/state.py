@@ -67,12 +67,13 @@ class TransactionalState:
         info = ambient_txn()
         if info is None:
             if self.pending_prepare is not None and self.owner is not None:
-                now = time.time()
-                if self.lock is None or self.lock[1] <= now:
-                    # an in-doubt prepared write outlived its lock: settle
-                    # it so non-transactional reads don't serve a value a
-                    # logged commit is about to replace
-                    await self.owner._resolve_in_doubt(now)
+                # an in-doubt prepared write is outstanding: ask the TM
+                # before serving a value a logged commit may be about to
+                # replace (read-your-committed-writes; force_query means
+                # a decided outcome applies NOW, while an undecided 2PC
+                # keeps its lock until expiry)
+                await self.owner._resolve_in_doubt(time.time(),
+                                                   force_query=True)
             return deep_copy(self.committed)
         ws = await self._enter(info)
         return ws["value"]

@@ -78,7 +78,20 @@ def _build(log_provider, storage=None):
     b = (TestClusterBuilder(3)
          .add_grains(Account, SlowCommitAccount, Mover)
          .with_transactions(log_provider=log_provider, shards=2)
-         .with_config(response_timeout=5.0))
+         # brisk but SAFE failure detection: sub-second probe timeouts
+         # with 1 vote false-kill healthy silos when the single-core
+         # event loop is oversubscribed (probe replies are delayed past
+         # the timeout), which turns this into a split-brain chaos test
+         # rather than a kill/recovery test. 1s probe timeout + 2 voters
+         # tolerates scheduler stalls; real-kill detection lands in ~2-3s
+         # (vs ~5s at the defaults).
+         .with_config(response_timeout=2.0,
+                      membership_probe_period=0.25,
+                      membership_probe_timeout=1.0,
+                      membership_missed_probes_limit=2,
+                      membership_votes_needed=2,
+                      membership_iam_alive_period=0.5,
+                      membership_refresh_period=0.2))
     if storage is not None:
         b.with_storage(storage)
     return b.build()
@@ -319,5 +332,29 @@ async def test_log_backends_roundtrip_and_compaction(tmp_path):
         assert seq == 5 and dec == {"t2": ("aborted", 0)}
         seq2, dec2 = await log.replay(2)   # other shard untouched
         assert seq2 == 6 and dec2 == {"t3": ("committed", 6)}
+        if hasattr(log, "close"):
+            log.close()
+
+
+async def test_decide_is_first_decision_wins_on_all_backends(tmp_path):
+    """The decision log, not any single TM activation's memory, is the
+    serialization point: a second decide() for the same txn returns the
+    existing record without overwriting — closing the duplicate-TM
+    presumed-abort-vs-commit race."""
+    from orleans_tpu.transactions import InMemoryTransactionLog
+    for make in (InMemoryTransactionLog,
+                 lambda: FileTransactionLog(str(tmp_path / "d.log")),
+                 lambda: SqliteTransactionLog(str(tmp_path / "d.db"))):
+        log = make()
+        first = await log.decide(0, "tx", "committed", 7)
+        assert first == ("committed", 7)
+        # a racing duplicate incarnation proposes abort: loses
+        second = await log.decide(0, "tx", "aborted", 0)
+        assert second == ("committed", 7), type(log).__name__
+        seq, dec = await log.replay(0)
+        assert dec["tx"] == ("committed", 7), type(log).__name__
+        # reverse order on another txn: the abort wins instead
+        assert await log.decide(0, "tx2", "aborted", 0) == ("aborted", 0)
+        assert await log.decide(0, "tx2", "committed", 9) == ("aborted", 0)
         if hasattr(log, "close"):
             log.close()
