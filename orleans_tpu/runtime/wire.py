@@ -83,26 +83,76 @@ async def read_frame(reader: asyncio.StreamReader) -> tuple[bytes, bytes]:
 _HEADER_SLOTS = tuple(s for s in Message.__slots__
                       if s not in ("body", "expires_at"))
 
+# Enum-typed header fields ride the wire as plain ints (the native codec's
+# scalar fast path; pickling an IntEnum writes a by-reference class lookup).
+from ..core import serialization as _ser  # noqa: E402
+from ..core.message import Category, Direction, RejectionType, ResponseKind  # noqa: E402
+
+_I_CATEGORY = _HEADER_SLOTS.index("category")
+_I_DIRECTION = _HEADER_SLOTS.index("direction")
+_I_RESPONSE_KIND = _HEADER_SLOTS.index("response_kind")
+_I_REJECTION_TYPE = _HEADER_SLOTS.index("rejection_type")
+
+
+# (field index, members-indexed-by-value) pairs: the single source of truth
+# for enum-typed header fields, consumed by the native decoder directly and
+# by the pickle-fallback paths below.
+_ENUM_SPEC = (
+    (_I_CATEGORY, _ser.members_by_value(Category)),
+    (_I_DIRECTION, _ser.members_by_value(Direction)),
+    (_I_RESPONSE_KIND, _ser.members_by_value(ResponseKind)),
+    (_I_REJECTION_TYPE, _ser.members_by_value(RejectionType)),
+)
+
 
 def encode_message(msg: Message) -> bytes:
     ttl = None
     if msg.expires_at is not None:
         ttl = max(0.0, msg.expires_at - time.monotonic())
-    headers = serialize(
-        (tuple(getattr(msg, s) for s in _HEADER_SLOTS), ttl))
+    headers = None
+    hw = _ser._hotwire
+    if hw is not None:
+        try:
+            # single C call: getattr walk + enum coercion + encode
+            headers = hw.pack_attrs(msg, _HEADER_SLOTS, ttl)
+        except ValueError:
+            pass  # cyclic/over-deep header payload: pickle's memo handles it
+    if headers is None:
+        fields = [getattr(msg, s) for s in _HEADER_SLOTS]
+        for i, _members in _ENUM_SPEC:
+            if fields[i] is not None:
+                fields[i] = int(fields[i])
+        headers = serialize((tuple(fields), ttl))
     body = serialize(msg.body)
     return encode_frame(headers, body)
 
 
 def decode_message(headers: bytes, body: bytes) -> Message:
+    msg = Message.__new__(Message)
     try:
-        fields, ttl = deserialize(headers)
-        values = dict(zip(_HEADER_SLOTS, fields, strict=True))
+        if headers[:1] == b"\xa7" and _ser._hotwire is not None:
+            # single C call: decode + enum restore + setattr walk
+            ttl = _ser._hotwire.unpack_attrs(
+                headers, msg, _HEADER_SLOTS, _ENUM_SPEC)
+        else:
+            fields, ttl = deserialize(headers)
+            fields = list(fields)
+            for i, members in _ENUM_SPEC:
+                v = fields[i]
+                if v is not None:
+                    # range-check before indexing: a negative value must be
+                    # rejected, not wrap to the last member (matches the C
+                    # decoder's ev < 0 guard)
+                    m = members[v] if isinstance(v, int) and \
+                        0 <= v < len(members) else None
+                    if m is None:
+                        raise ValueError(
+                            f"bad enum value {v!r} for header {_HEADER_SLOTS[i]}")
+                    fields[i] = m
+            for k, v in zip(_HEADER_SLOTS, fields, strict=True):
+                setattr(msg, k, v)
     except Exception as e:  # noqa: BLE001 — headers must decode or the msg is lost
         raise WireDecodeError(f"undecodable message headers: {e}") from e
-    msg = Message.__new__(Message)
-    for k, v in values.items():
-        setattr(msg, k, v)
     msg.expires_at = None if ttl is None else time.monotonic() + ttl
     try:
         msg.body = deserialize(body)

@@ -22,7 +22,7 @@ from typing import TYPE_CHECKING, Any
 
 from ..core.errors import InconsistentStateError
 from ..core.ids import GrainId
-from ..core.serialization import deserialize, serialize
+from ..core.serialization import deserialize, serialize, serialize_portable
 
 if TYPE_CHECKING:
     from ..runtime.activation import ActivationData
@@ -135,7 +135,8 @@ class FileStorage(GrainStorage):
         with open(tmp, "wb") as f:
             f.write(len(meta).to_bytes(4, "little"))
             f.write(meta)
-            f.write(serialize(state))
+            # durable blobs outlive the process: always-portable encoding
+            f.write(serialize_portable(state))
         os.replace(tmp, p)
         return new_etag
 

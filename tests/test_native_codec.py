@@ -1,0 +1,191 @@
+"""Native hotwire codec (orleans_tpu/native/hotwire.c).
+
+Covers: value roundtrips for every supported tag, id-type fidelity
+(precomputed hashes survive, no re-hash on decode), wire interop with the
+pickle fallback, the restricted-pickle escape hatch (allowlist still
+enforced), and decoder robustness against malformed/truncated/hostile
+buffers (must raise ValueError, never crash).
+"""
+
+import pickle
+
+import pytest
+
+import orleans_tpu.core.serialization as ser
+from orleans_tpu.core.ids import (ActivationAddress, ActivationId,
+                                  GrainCategory, GrainId, GrainType,
+                                  SiloAddress)
+from orleans_tpu.core.message import Category, Direction, make_request
+from orleans_tpu.runtime.wire import decode_message, encode_message
+
+hw = ser._hotwire
+pytestmark = pytest.mark.skipif(
+    hw is None, reason="native toolchain unavailable in this environment")
+
+
+GT = GrainType.of("native.Echo")
+GID = GrainId.for_grain(GT, 42)
+SILO = SiloAddress("10.0.0.7", 11111, 1703, 3)
+AID = ActivationId.new()
+
+
+CORPUS = [
+    None, True, False,
+    0, 1, -1, 255, -256, 2**31, -(2**31), 2**62, -(2**62),
+    2**100, -(2**100),          # bignum -> pickle escape
+    0.0, -1.5, 3.141592653589793, float("inf"),
+    "", "ascii", "héllo wörld", "日本語", "x" * 5000,
+    b"", b"raw\x00bytes", b"\xff" * 1000,
+    (), (1,), (1, "a", None, (2, (3,))),
+    [], [1, [2, [3, [4]]]],
+    {}, {"k": 1, 2: "v", (1, 2): [3]},
+    set(), {1, 2, 3}, frozenset({("a", 1)}),
+    GID, GrainId.for_grain(GT, "string-key", "with-ext"),
+    GrainId.for_guid(GT, __import__("uuid").uuid4()),
+    GrainId.client("client-7"), GrainId.system_target(99, SILO),
+    SILO, SiloAddress("::1", 0, 0), AID,
+    ActivationAddress(SILO, GID, AID),
+    {"addr": ActivationAddress(SILO, GID, AID), "chain": (GID, GID)},
+]
+
+
+@pytest.mark.parametrize("value", CORPUS, ids=lambda v: repr(v)[:40])
+def test_roundtrip(value):
+    out = hw.loads(hw.dumps(value))
+    assert out == value
+    assert type(out) is type(value)
+
+
+def test_id_hashes_survive_without_rehash():
+    for gid in [GID, GrainId.for_grain(GT, "k", "e"), GrainId.client("c")]:
+        out = hw.loads(hw.dumps(gid))
+        assert out.uniform_hash == gid.uniform_hash
+        assert hash(out) == hash(gid)
+        assert out.category is gid.category  # enum member, not int
+    s2 = hw.loads(hw.dumps(SILO))
+    assert s2.uniform_hash == SILO.uniform_hash
+    assert s2.endpoint == SILO.endpoint and s2.mesh_index == SILO.mesh_index
+
+
+def test_frames_are_smaller_than_pickle():
+    header_ish = (GID, SILO, AID, "method", 123, None, (), True)
+    assert len(hw.dumps(header_ish)) < len(pickle.dumps(header_ish))
+
+
+def test_serialize_dispatch_and_pickle_interop():
+    # serialize() rides hotwire; deserialize() dispatches on the magic byte
+    blob = ser.serialize({"x": (GID, 1.5)})
+    assert blob[:1] == b"\xa7"
+    assert ser.deserialize(blob) == {"x": (GID, 1.5)}
+    # frames from a non-native peer (plain pickle) still decode
+    legacy = pickle.dumps({"x": (GID, 1.5)}, protocol=pickle.HIGHEST_PROTOCOL)
+    assert ser.deserialize(legacy) == {"x": (GID, 1.5)}
+
+
+class _Foreign:
+    """Module-level so pickle can serialize it; 'tests' is not on the wire
+    allowlist, so decode must reject it."""
+
+    def __eq__(self, other):
+        return isinstance(other, _Foreign)
+
+
+def test_escape_hatch_keeps_allowlist():
+    # values outside the codec's native set escape through the RESTRICTED
+    # pickler on decode: non-allowlisted types must still be rejected
+    blob = hw.dumps((1, _Foreign()))
+    with pytest.raises(Exception, match="allowlist"):
+        hw.loads(blob)
+
+
+def test_enum_values_escape_as_pickled_enums():
+    # enums in *bodies* (not header positions) keep their type via escape
+    out = hw.loads(hw.dumps((Category.SYSTEM, Direction.ONE_WAY)))
+    assert out[0] is Category.SYSTEM and out[1] is Direction.ONE_WAY
+
+
+@pytest.mark.parametrize("bad", [
+    b"",
+    b"\xa7",
+    b"\xa7\x01",                    # magic only, no value
+    b"\xa7\x02\x00",                # wrong version
+    b"\x00\x01\x00",                # wrong magic
+    b"\xa7\x01\x99",                # unknown tag
+    b"\xa7\x01\x06\xff\xff\xff\xff\x0f",  # str length >> buffer
+    b"\xa7\x01\x08\xff\xff\xff\xff\x0f",  # tuple count >> buffer
+    b"\xa7\x01\x03\x80\x80\x80\x80\x80\x80\x80\x80\x80\x80\x01",  # varint >64bit
+    b"\xa7\x01\x05\x00\x00",        # truncated float
+    b"\xa7\x01\x06" + b"\x80" * 9 + b"\x01",  # str length = 2^63 (Py_ssize_t overflow)
+    b"\xa7\x01\x08" + b"\x80" * 9 + b"\x01",  # tuple count = 2^63
+    b"\xa7\x01\x03" + b"\x80" * 9 + b"\x02",  # varint payload bits past bit 63
+    b"\xa7\x01\x0d\x02",            # truncated GrainId
+    b"\xa7\x01\x00\x00",            # trailing garbage
+], ids=lambda b: b.hex()[:24] or "empty")
+def test_malformed_input_raises(bad):
+    with pytest.raises(ValueError):
+        hw.loads(bad)
+
+
+def test_truncations_of_real_frames_raise_not_crash():
+    blob = hw.dumps({"k": (GID, SILO, [1.5, "x", b"y"], AID)})
+    for cut in range(2, len(blob)):
+        try:
+            hw.loads(blob[:cut])
+        except ValueError:
+            pass
+        except Exception:
+            pass  # escape-pickle truncation raises pickle errors: fine
+
+
+def test_cyclic_payloads_fall_back_to_pickle():
+    d: dict = {}
+    d["self"] = d
+    blob = ser.serialize(d)
+    assert blob[:1] != b"\xa7"  # rode the pickle fallback
+    out = ser.deserialize(blob)
+    assert out["self"] is out
+
+
+def test_nesting_depth_capped():
+    deep = None
+    for _ in range(500):
+        deep = (deep,)
+    with pytest.raises((ValueError, RecursionError)):
+        hw.dumps(deep)
+    # hostile hand-built deep buffer on the decode side
+    bad = b"\xa7\x01" + b"\x08\x01" * 500 + b"\x00"
+    with pytest.raises(ValueError, match="deep"):
+        hw.loads(bad)
+
+
+def test_wire_message_roundtrip_native_and_fallback(monkeypatch):
+    msg = make_request(
+        target_grain=GID, interface_name="native.IEcho", method_name="echo",
+        body=("payload", 1, {"a": b"b"}), sending_silo=SILO, target_silo=SILO,
+        call_chain=(GID,), request_context={"trace": "t-1"})
+
+    def roundtrip():
+        frame = encode_message(msg)
+        hlen = int.from_bytes(frame[:4], "little")
+        return decode_message(frame[8:8 + hlen], frame[8 + hlen:])
+
+    for use_native in (True, False):
+        monkeypatch.setattr(ser, "_hotwire", hw if use_native else None)
+        out = roundtrip()
+        assert out.category is Category.APPLICATION
+        assert out.direction is Direction.REQUEST
+        assert out.rejection_type is None
+        assert out.target_grain == GID and out.sending_silo == SILO
+        assert out.call_chain == (GID,)
+        assert out.request_context == {"trace": "t-1"}
+        assert out.body == ("payload", 1, {"a": b"b"})
+
+    # native-encoded headers decodable by the fallback too? No — that needs
+    # the extension; but fallback-encoded headers MUST decode when native is
+    # active (mixed-build cluster, old silo -> new silo):
+    monkeypatch.setattr(ser, "_hotwire", None)
+    frame = encode_message(msg)
+    hlen = int.from_bytes(frame[:4], "little")
+    monkeypatch.setattr(ser, "_hotwire", hw)
+    out = decode_message(frame[8:8 + hlen], frame[8 + hlen:])
+    assert out.method_name == "echo" and out.category is Category.APPLICATION
