@@ -100,3 +100,11 @@ class TransactionError(OrleansError):
 
 class TransactionAbortedError(TransactionError):
     pass
+
+
+class TransactionConflictError(TransactionAbortedError):
+    """Wound-wait entry conflict: this transaction gave way — wounded by an
+    older transaction, or timed out waiting — before running any doomed
+    2PC work. Always retryable — the root @transactional scope retries with
+    the transaction's original priority timestamp so it ages into the
+    winner (livelock-free)."""

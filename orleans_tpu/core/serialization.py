@@ -204,6 +204,18 @@ def deserialize(data: bytes) -> Any:
     return _restricted_pickle_loads(data)
 
 
+# -- id types are immutable: deep-copy isolation passes them by reference ----
+def _register_id_copiers() -> None:
+    from .ids import (ActivationAddress, ActivationId, GrainId, GrainType,
+                      SiloAddress)
+    for _t in (GrainId, GrainType, SiloAddress, ActivationId,
+               ActivationAddress):
+        _copiers[_t] = lambda x: x
+
+
+_register_id_copiers()
+
+
 # -- native codec bootstrap --------------------------------------------------
 # Imported late so orleans_tpu.core.ids is fully defined; configure hands the
 # codec the id types plus the restricted pickle hooks for escape values.

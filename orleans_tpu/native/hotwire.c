@@ -137,6 +137,10 @@ static int enc_value(W *w, PyObject *obj, int depth);
 
 /* Escape one value through the configured restricted pickler. */
 static int enc_pickle(W *w, PyObject *obj) {
+    if (!g_state.configured) {
+        PyErr_SetString(PyExc_RuntimeError, "hotwire: not configured");
+        return -1;
+    }
     PyObject *data = PyObject_CallOneArg(g_state.pickle_dumps, obj);
     if (!data) return -1;
     char *p; Py_ssize_t n;
@@ -227,27 +231,58 @@ static int enc_value(W *w, PyObject *obj, int depth) {
         if (w_byte(w, T_BYTES) < 0 || w_varint(w, (uint64_t)n) < 0) return -1;
         return w_raw(w, p, n);
     }
-    if (t == &PyTuple_Type || t == &PyList_Type) {
-        Py_ssize_t n = t == &PyTuple_Type ? PyTuple_GET_SIZE(obj)
-                                          : PyList_GET_SIZE(obj);
-        if (w_byte(w, t == &PyTuple_Type ? T_TUPLE : T_LIST) < 0) return -1;
+    if (t == &PyTuple_Type) {
+        Py_ssize_t n = PyTuple_GET_SIZE(obj);
+        if (w_byte(w, T_TUPLE) < 0) return -1;
         if (w_varint(w, (uint64_t)n) < 0) return -1;
         for (Py_ssize_t i = 0; i < n; i++) {
-            PyObject *it = t == &PyTuple_Type ? PyTuple_GET_ITEM(obj, i)
-                                              : PyList_GET_ITEM(obj, i);
-            if (enc_value(w, it, depth + 1) < 0) return -1;
+            /* tuples are immutable: items cannot move under us */
+            if (enc_value(w, PyTuple_GET_ITEM(obj, i), depth + 1) < 0)
+                return -1;
+        }
+        return 0;
+    }
+    if (t == &PyList_Type) {
+        /* a nested pickle escape can run arbitrary __reduce__ code that
+           mutates this list mid-encode: hold each item and re-check the
+           size every step so we never read out of bounds, and reject the
+           frame on mutation (the emitted count is already committed) */
+        Py_ssize_t n = PyList_GET_SIZE(obj);
+        if (w_byte(w, T_LIST) < 0) return -1;
+        if (w_varint(w, (uint64_t)n) < 0) return -1;
+        for (Py_ssize_t i = 0; i < n; i++) {
+            if (PyList_GET_SIZE(obj) != n) {
+                PyErr_SetString(PyExc_ValueError,
+                                "hotwire: list mutated during encode");
+                return -1;
+            }
+            PyObject *it = PyList_GET_ITEM(obj, i);
+            Py_INCREF(it);
+            int rc = enc_value(w, it, depth + 1);
+            Py_DECREF(it);
+            if (rc < 0) return -1;
         }
         return 0;
     }
     if (t == &PyDict_Type) {
-        if (w_byte(w, T_DICT) < 0) return -1;
-        if (w_varint(w, (uint64_t)PyDict_GET_SIZE(obj)) < 0) return -1;
-        Py_ssize_t pos = 0;
-        PyObject *k, *v;
-        while (PyDict_Next(obj, &pos, &k, &v)) {
-            if (enc_value(w, k, depth + 1) < 0) return -1;
-            if (enc_value(w, v, depth + 1) < 0) return -1;
+        /* snapshot: PyDict_Next over a dict that a nested pickle escape
+           resizes is undefined behavior */
+        PyObject *items = PyDict_Items(obj);
+        if (!items) return -1;
+        Py_ssize_t n = PyList_GET_SIZE(items);
+        if (w_byte(w, T_DICT) < 0 || w_varint(w, (uint64_t)n) < 0) {
+            Py_DECREF(items);
+            return -1;
         }
+        for (Py_ssize_t i = 0; i < n; i++) {
+            PyObject *pair = PyList_GET_ITEM(items, i);
+            if (enc_value(w, PyTuple_GET_ITEM(pair, 0), depth + 1) < 0 ||
+                enc_value(w, PyTuple_GET_ITEM(pair, 1), depth + 1) < 0) {
+                Py_DECREF(items);
+                return -1;
+            }
+        }
+        Py_DECREF(items);
         return 0;
     }
     if (t == &PySet_Type || t == &PyFrozenSet_Type) {

@@ -43,6 +43,8 @@ if TYPE_CHECKING:
 
 log = logging.getLogger("orleans.dispatcher")
 
+
+
 MAX_FORWARD_COUNT = 2  # SiloMessagingOptions.MaxForwardCount default
 
 
@@ -335,7 +337,16 @@ class Dispatcher:
             activation.waiting.append(msg)  # EnqueueRequest:431
 
     def _handle_incoming(self, activation: ActivationData, msg: Message) -> None:
-        """HandleIncomingRequest:399 → schedule the turn."""
+        """HandleIncomingRequest:399 → schedule the turn.
+
+        With the eager task factory (silo.py) the turn's first steps run
+        inline INSIDE a properly-constructed Task — a non-suspending grain
+        method completes here without a loop round-trip, while
+        current_task()-dependent code in user methods (asyncio.timeout,
+        wait_for) still sees the turn's own task. (A hand-rolled inline
+        first step without a Task was measured ~2µs cheaper and reverted:
+        it breaks exactly that contract — wait_for during the inline step
+        armed its timeout against the CALLER's task.)"""
         activation.record_running(msg)
         self._track(asyncio.get_running_loop().create_task(
             self._run_turn(activation, msg)))

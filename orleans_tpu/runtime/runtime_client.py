@@ -77,6 +77,14 @@ class RuntimeClient:
         self.outgoing_call_filters: list = []
         self._filter_tasks: set[asyncio.Task] = set()
 
+    def try_direct_interleave(self, grain_id, method_name: str,
+                              args: tuple, kwargs: dict):
+        """In-silo fast path for always-interleave calls to a local, valid
+        activation; None when not applicable (take the messaging path).
+        Overridden by InsideRuntimeClient — external clients always
+        message."""
+        return None
+
     # -- to be provided by subclass -------------------------------------
     @property
     def silo_address(self) -> SiloAddress | None:  # pragma: no cover
@@ -225,7 +233,24 @@ class RuntimeClient:
         except BaseException:
             self.callbacks.pop(msg.id, None)
             raise
-        return future
+        return self._await_response(future)
+
+    async def _await_response(self, future: asyncio.Future):
+        """Await the response with a once-per-RPC fairness yield.
+
+        Responses resolve synchronously (receive_response), so with inline
+        delivery + eager turns a whole RPC can complete before the caller
+        first awaits and an await on a done future never suspends — tight
+        call loops would then starve every background task (membership
+        refresh, reminder ticks). Yielding here when the future is already
+        done guarantees each RPC crosses the event loop exactly once, like
+        a real wire hop — and exactly once, not twice, which is what the
+        previous call_soon-deferred resolution cost (resolve callback +
+        waiter wakeup were two separate loop iterations per call)."""
+        if future.done():
+            await asyncio.sleep(0)
+            return future.result()
+        return await future
 
     # -- response path (ReceiveResponse:569-627) --------------------------
     def receive_response(self, msg: Message) -> None:
@@ -243,20 +268,14 @@ class RuntimeClient:
             if tid == cb.txn_info.id:
                 cb.txn_info.merge(participants)
         if msg.response_kind == ResponseKind.SUCCESS:
-            # resolve via call_soon, not synchronously: with inline
-            # delivery + eager turns a whole RPC can complete before the
-            # caller first awaits, and a caller awaiting an already-done
-            # future never suspends — tight call loops would then starve
-            # every background task (membership refresh, reminder ticks).
-            # One deferred resolution per call guarantees each RPC yields
-            # at least once, like a real wire hop does.
-            asyncio.get_running_loop().call_soon(
-                _resolve_future, cb.future, msg.body, None)
+            # synchronous resolve: the once-per-RPC fairness yield lives in
+            # _await_response, so resolution itself need not burn an extra
+            # event-loop iteration per call
+            _resolve_future(cb.future, msg.body, None)
         elif msg.response_kind == ResponseKind.ERROR:
             exc = msg.body if isinstance(msg.body, BaseException) else \
                 RejectionError(str(msg.body))
-            asyncio.get_running_loop().call_soon(
-                _resolve_future, cb.future, None, exc)
+            _resolve_future(cb.future, None, exc)
         else:  # rejection — transparently resend transient rejections
             # GATEWAY_TOO_BUSY is retryable: the resend re-picks a gateway
             # (the reference's client reroutes around overloaded gateways)
@@ -268,9 +287,8 @@ class RuntimeClient:
                 # an ordinary grain and bounce to the forward limit —
                 # break the caller instead (the reference's
                 # BreakOutstandingMessagesToDeadSilo for pinned targets)
-                asyncio.get_running_loop().call_soon(
-                    _resolve_future, cb.future, None, SiloUnavailableError(
-                        msg.rejection_info or "system target unreachable"))
+                _resolve_future(cb.future, None, SiloUnavailableError(
+                    msg.rejection_info or "system target unreachable"))
                 return
             if (msg.rejection_type is not None
                     and cb.message.resend_count < MAX_RESEND_COUNT
@@ -298,13 +316,11 @@ class RuntimeClient:
             if msg.rejection_type is not None and \
                     msg.rejection_type.name == "GATEWAY_TOO_BUSY":
                 from ..core.errors import GatewayTooBusyError
-                asyncio.get_running_loop().call_soon(
-                    _resolve_future, cb.future, None, GatewayTooBusyError(
-                        msg.rejection_info or "gateway overloaded"))
+                _resolve_future(cb.future, None, GatewayTooBusyError(
+                    msg.rejection_info or "gateway overloaded"))
                 return
-            asyncio.get_running_loop().call_soon(
-                _resolve_future, cb.future, None,
-                RejectionError(msg.rejection_info or "rejected"))
+            _resolve_future(cb.future, None,
+                            RejectionError(msg.rejection_info or "rejected"))
 
     def break_outstanding_to_dead_silo(self, silo: SiloAddress) -> None:
         """``BreakOutstandingMessagesToDeadSilo:726``."""

@@ -16,12 +16,15 @@ either.
 from __future__ import annotations
 
 import asyncio
+import itertools
 import logging
+import time
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Any, Callable
 
 from ..core.ids import ActivationAddress, GrainId, SiloAddress
 from ..core.message import Category, Direction, Message
+from ..core.serialization import copy_call_body, copy_result
 from ..observability.stats import StatsRegistry
 from ..storage.core import StorageManager
 from .catalog import Catalog
@@ -263,6 +266,25 @@ class MessageCenter:
         self.silo.fabric.deliver(msg)
 
 
+# negative ids: can never collide with wire message ids in an
+# activation's running_since map
+_direct_call_counter = itertools.count(1)
+
+
+class _DirectCallMarker:
+    """Stand-in for a Message in ActivationData.running while a
+    direct-interleave call executes: enough surface for the reentrancy
+    gate (is_read_only), chain building (call_chain), and the
+    stuck-activation probe (id keyed into running_since)."""
+
+    __slots__ = ("id", "call_chain")
+    is_read_only = False
+
+    def __init__(self, id: int, call_chain: tuple):
+        self.id = id
+        self.call_chain = call_chain
+
+
 class InsideRuntimeClient(RuntimeClient):
     """Silo-interior RPC engine (InsideRuntimeClient.cs:28)."""
 
@@ -276,6 +298,56 @@ class InsideRuntimeClient(RuntimeClient):
 
     def transmit(self, msg: Message) -> None:
         self.silo.dispatcher.send_message(msg)
+
+    def try_direct_interleave(self, grain_id, method_name: str,
+                              args: tuple, kwargs: dict):
+        """Direct-coroutine fast path for ALWAYS-INTERLEAVE methods (and
+        the transaction protocol's reentrant-TM internals) on a local
+        activation. Sound because the mailbox gate would admit such a
+        message unconditionally, so queue semantics carry nothing — only
+        the invoke remains, minus per-message machinery. Copy isolation
+        is preserved (args/result copied exactly as the messaging path
+        does); incoming call filters and per-call timeout are
+        intentionally skipped (the turn-length watchdog still observes
+        via the running marker). The call IS visible to activation
+        bookkeeping: a running marker keeps deactivation/idle-collection
+        from tearing the activation down mid-call, and nested sends from
+        inside the callee carry the caller's extended call chain and
+        attribute to the callee activation."""
+        if self.outgoing_call_filters:
+            return None
+        acts = self.silo.catalog.by_grain.get(grain_id)
+        if not acts or len(acts) != 1:
+            return None
+        act = acts[0]
+        from .activation import ActivationState
+        if act.state != ActivationState.VALID:
+            return None
+        fn = getattr(act.grain_instance, method_name, None)
+        if fn is None:
+            return None
+        return self._direct_interleave_call(act, fn, args, kwargs)
+
+    async def _direct_interleave_call(self, act, fn, args: tuple,
+                                      kwargs: dict):
+        args, kwargs = copy_call_body(args, kwargs)
+        caller = current_activation.get()
+        chain: tuple = ()
+        if caller is not None:
+            running = caller.running[-1] if caller.running else None
+            parent = running.call_chain if running is not None else ()
+            chain = (*parent, caller.grain_id)
+        marker = _DirectCallMarker(-next(_direct_call_counter), chain)
+        act.record_running(marker)
+        token = current_activation.set(act)
+        try:
+            return copy_result(await fn(*args, **kwargs))
+        finally:
+            current_activation.reset(token)
+            act.reset_running(marker)
+            # regular messages that arrived during the call queued behind
+            # the running marker; nothing else pumps them for a direct call
+            self.silo.dispatcher.run_message_pump(act)
 
 
 class Silo:

@@ -38,15 +38,23 @@ _txn_counter = itertools.count(1)
 class TransactionInfo:
     """One transaction's identity + collected participant set."""
 
-    __slots__ = ("id", "deadline", "participants")
+    __slots__ = ("id", "deadline", "participants", "ts")
 
     def __init__(self, id: str | None = None,
                  deadline: float | None = None,
-                 participants: dict | None = None):
+                 participants: dict | None = None,
+                 ts: tuple | None = None):
         self.id = id or (f"{random.getrandbits(32):08x}"
                          f"{_proc_tag}{next(_txn_counter):x}")
         self.deadline = deadline if deadline is not None else \
             time.time() + 10.0
+        # wound-wait priority timestamp: totally ordered cluster-wide
+        # (wall clock, then process tag, then sequence breaks ties).
+        # Conflict retries REUSE the original ts (manager.transactional)
+        # so a repeatedly-dying transaction ages into the oldest — and
+        # therefore winning — one: livelock-free by construction.
+        self.ts: tuple = ts if ts is not None else \
+            (time.time(), _proc_tag, next(_txn_counter))
         # str(grain_id) -> (GrainId, interface_name)
         self.participants: dict[str, tuple["GrainId", str]] = \
             participants or {}
@@ -63,7 +71,7 @@ class TransactionInfo:
     # pickled into response headers for the cross-process merge
     def __reduce__(self):
         return (TransactionInfo, (self.id, self.deadline,
-                                  dict(self.participants)))
+                                  dict(self.participants), self.ts))
 
     def __repr__(self) -> str:
         return (f"TransactionInfo({self.id[:8]}, "

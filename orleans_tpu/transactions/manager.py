@@ -37,7 +37,8 @@ import random
 import time
 from typing import TYPE_CHECKING
 
-from ..core.errors import TransactionAbortedError, TransactionError
+from ..core.errors import (TransactionAbortedError, TransactionConflictError,
+                           TransactionError)
 from ..core.ids import GrainId
 from ..runtime.grain import Grain, reentrant
 from .context import (
@@ -332,7 +333,8 @@ class TransactionManagerGrain(Grain):
 
     def _call(self, grain_id: GrainId, iface: str, method: str, *args):
         silo = self._activation.runtime
-        direct = _local_always_interleave_call(silo, grain_id, method, args)
+        direct = silo.runtime_client.try_direct_interleave(
+            grain_id, method, args, {})
         if direct is not None:
             return direct
         cls = silo.registry.resolve(iface)
@@ -369,29 +371,6 @@ async def _collect(calls: list) -> list:
     return out
 
 
-def _local_always_interleave_call(silo, grain_id: GrainId, method: str,
-                                  args: tuple):
-    """In-silo fast path for the transaction protocol's internal calls
-    (TM→participant 2PC rounds, agent→TM commits): the target methods are
-    always-interleave (participants) or on a reentrant grain (the TM), so
-    the mailbox gate would admit them unconditionally — invoking the local
-    activation's coroutine directly preserves turn semantics while
-    skipping the per-message machinery. The reference's agent reaches its
-    in-silo TM the same way (TransactionAgent.cs — direct component
-    calls, not remote messages). Args here are ids/ints (immutables), so
-    deep-copy isolation is preserved trivially. Returns None when the
-    activation is not local (the ordinary messaging path applies)."""
-    acts = silo.catalog.by_grain.get(grain_id)
-    if not acts or len(acts) != 1:
-        return None
-    act = acts[0]
-    from ..runtime.activation import ActivationState
-    if act.state != ActivationState.VALID:
-        return None
-    act.last_busy = time.monotonic()   # keep the idle collector away
-    return getattr(act.grain_instance, method)(*args)
-
-
 class TransactionAgent:
     """Per-silo agent (TransactionAgent.cs:98): creates transaction scopes
     locally and routes commits to the txn's TM shard; installed as
@@ -410,17 +389,22 @@ class TransactionAgent:
         shard = int(txn_id[:8], 16) % self.shards
         gid = GrainId.for_grain(grain_type_of(TransactionManagerGrain),
                                 shard)
-        direct = _local_always_interleave_call(self.silo, gid, method, args)
+        direct = self.silo.runtime_client.try_direct_interleave(
+            gid, method, args, {})
         if direct is not None:
             return direct
         ref = self.silo.grain_factory.get_grain(
             TransactionManagerGrain, shard)
         return getattr(ref, method)(*args)
 
-    def start(self, timeout: float = DEFAULT_TXN_TIMEOUT) -> TransactionInfo:
-        """Silo-local: no TM round trip (the agent-collected design)."""
+    def start(self, timeout: float = DEFAULT_TXN_TIMEOUT,
+              priority_ts: tuple | None = None) -> TransactionInfo:
+        """Silo-local: no TM round trip (the agent-collected design).
+        ``priority_ts`` carries a retrying transaction's original wound-wait
+        priority so it ages instead of rejuvenating."""
         self.silo.stats.increment("transactions.started")
-        return TransactionInfo(deadline=time.time() + timeout)
+        return TransactionInfo(deadline=time.time() + timeout,
+                               ts=priority_ts)
 
     async def commit(self, info: TransactionInfo) -> bool:
         ok = await self._tm_call(info.id, "commit_transaction", info.id,
@@ -466,18 +450,33 @@ def transactional(fn=None, *, option: str = "required"):
             if agent is None:
                 raise TransactionError(
                     "no transaction agent installed (add_transactions)")
-            # Root scope: optimistic-conflict aborts retry with fresh
-            # reads until the original deadline (the standard OCC retry
-            # loop; the reference's TransactionalState resolves the same
-            # conflicts by queueing on locks). Application exceptions
-            # abort once and propagate — only validation conflicts retry.
+            # Root scope: conflicts retry until the original deadline.
+            # Wait-die entry (state.TransactionalState._enter) makes
+            # conflicts surface EARLY as TransactionConflictError —
+            # before any doomed prepare/commit round — and retries reuse
+            # the original priority ts so the transaction ages into the
+            # winner. Validation aborts at commit (the read-version
+            # safety net) retry the same way. Application exceptions
+            # abort once and propagate.
             retry_deadline = time.time() + DEFAULT_TXN_TIMEOUT
             attempt = 0
+            priority_ts = None
             while True:
-                info = agent.start()
+                info = agent.start(priority_ts=priority_ts)
+                priority_ts = info.ts
                 set_ambient_txn(info)
                 try:
                     result = await fn(self, *args, **kwargs)
+                except TransactionConflictError:
+                    clear_ambient_txn()
+                    await agent.abort(info)  # release everything we hold
+                    attempt += 1
+                    if time.time() >= retry_deadline:
+                        raise
+                    # brief jittered pause: the older holder we died
+                    # against is typically mid-2PC; let it finish
+                    await asyncio.sleep(0.0003 * (0.5 + random.random()))
+                    continue
                 except BaseException:
                     clear_ambient_txn()
                     await agent.abort(info)
@@ -496,6 +495,14 @@ def transactional(fn=None, *, option: str = "required"):
                     * (0.5 + random.random()))
 
         wrapper.__orleans_transaction__ = option
+        # Transactional calls interleave (the reference marks transactional
+        # methods interleavable for exactly this reason): a lock wait inside
+        # TransactionalState._enter must suspend only ITS transaction, not
+        # the activation's whole mailbox — otherwise waits-for edges form
+        # through turn queues where wound-wait cannot see or break them.
+        # Isolation is the transactional states' job (workspace exclusivity
+        # + read-version validation), not the turn gate's.
+        wrapper.__orleans_always_interleave__ = True
         return wrapper
 
     return deco(fn) if fn is not None else deco

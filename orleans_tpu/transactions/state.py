@@ -27,7 +27,7 @@ import asyncio
 import time
 from typing import Any
 
-from ..core.errors import TransactionAbortedError
+from ..core.errors import TransactionAbortedError, TransactionConflictError
 from ..core.serialization import deep_copy
 from ..runtime.grain import Grain, always_interleave
 from .context import ambient_txn
@@ -35,7 +35,30 @@ from .context import ambient_txn
 __all__ = ["TransactionalState", "TransactionalGrain"]
 
 PREPARE_LOCK_TTL = 10.0  # steal an expired lock: TM died mid-2PC
-COMMIT_WAIT = 0.05       # max wait for an in-flight commit before reading
+# A workspace blocks other transactions' entry (wound-wait) only this long
+# after first touch. Entry blocking is a conflict-avoidance optimization —
+# the read-version check at prepare is what guarantees serializability —
+# so a root that died without aborting (silo kill) stalls waiters for at
+# most this window instead of its full transaction deadline.
+INTENT_TTL = 1.0
+
+# Wound registry (wound-wait deadlock avoidance): an OLDER transaction
+# arriving at a state held by a YOUNGER one marks the younger txn wounded;
+# the wounded txn aborts at its next entry/prepare checkpoint and retries
+# at the root with its original priority. Silo-local by design — a wound
+# that fails to reach a remote participant merely downgrades that conflict
+# to the optimistic read-version abort at prepare (safety is never the
+# wound's job). Entries are pruned by age; retries use fresh txn ids, so
+# stale wounds can never hit a live transaction.
+_wounded: dict[str, float] = {}
+_WOUND_TTL = 5.0
+
+
+def _prune_wounds(now: float) -> None:
+    if len(_wounded) > 256:
+        for tid, t in list(_wounded.items()):
+            if now - t > _WOUND_TTL:
+                _wounded.pop(tid, None)
 
 
 class TransactionalState:
@@ -88,51 +111,100 @@ class TransactionalState:
         ws["value"] = value
         ws["written"] = True
 
-    def _busy_for(self, txn: str) -> bool:
-        """Another transaction holds a prepare lock (mid-commit — settles
-        within a 2PC round trip). Write INTENT deliberately does not
-        block entry: intents are held for a whole root-call span, so
-        waiting on them convoys opposite-order acquisitions into
-        COMMIT_WAIT stalls (measured 5× throughput loss); stale reads
-        against an intent settle cheaply via prepare-abort + retry."""
-        return self.lock is not None and self.lock[0] != txn
-
     def _signal_release(self) -> None:
         ev = self._release_event
         if ev is not None:
             ev.set()
 
+    def _entry_blocked(self, info, now: float) -> bool:
+        """Wound-wait entry gate. Returns True while ``info`` must wait:
+        a fresh prepare lock (mid-2PC, settles within a round) or another
+        transaction's live workspace blocks entry. An OLDER arrival wounds
+        every younger holder on its way into the wait — the wounded txn
+        aborts at its next checkpoint and retries — so every wait edge
+        that survives points young→old and cycles are impossible.
+        Workspaces past their deadline are abandoned debris (crashed or
+        timed-out root) and are swept; intents older than INTENT_TTL stop
+        blocking (dead-root bound) — the read-version check at prepare
+        remains the safety net for both relaxations."""
+        if self.lock is not None and self.lock[0] != info.id and \
+                self.lock[1] > now:
+            return True
+        blocked = False
+        for oid, ows in list(self.workspace.items()):
+            if oid == info.id:
+                continue
+            if ows["deadline"] <= now:
+                # past its deadline: wound rather than delete. Deleting
+                # would let a prepare that races the deadline see "no
+                # workspace → vote True" and commit with this write
+                # silently dropped; wounding forces its prepare to vote
+                # False. The workspace itself is only reaped well past
+                # the deadline (TM deadline checks make a commit
+                # impossible by then).
+                _wounded.setdefault(oid, now)
+                if ows["deadline"] <= now - _WOUND_TTL:
+                    self.workspace.pop(oid, None)
+                continue
+            if now - ows["entered"] >= INTENT_TTL:
+                continue  # stale intent (dead root?): enter optimistically
+            if oid in _wounded:
+                continue  # dying txn: never wait on it (it cannot commit)
+            if info.ts < ows["ts"]:
+                # older wounds younger holder AND proceeds immediately —
+                # the wounded txn can no longer pass prepare(), so entering
+                # alongside its doomed workspace is safe (read-version
+                # validation is the formal guarantee) and keeps the wound's
+                # ≤poll-interval discovery latency off OUR critical path
+                _wounded.setdefault(oid, now)
+                continue
+            blocked = True
+        return blocked
+
     async def _enter(self, info) -> dict:
         ws = self.workspace.get(info.id)
         if ws is None:
-            if self._busy_for(info.id):
-                # another transaction is mid-commit (prepare lock) or has
-                # an uncommitted write on this state: wait briefly for it
-                # to settle instead of snapshotting a version that is
-                # about to be replaced — a read now is doomed at prepare.
-                # This is the lock-queue behavior of the reference's
-                # TransactionalState (State/TransactionalState.cs:611);
-                # the read-version check at prepare remains the safety
-                # net, and the COMMIT_WAIT bound prevents opposite-order
-                # acquisition deadlocks.
-                deadline = time.time() + COMMIT_WAIT
-                while self._busy_for(info.id):
-                    remaining = deadline - time.time()
-                    if remaining <= 0:
-                        break
-                    ev = self._release_event
-                    if ev is None:
-                        ev = self._release_event = asyncio.Event()
-                    ev.clear()
-                    try:
-                        await asyncio.wait_for(ev.wait(), remaining)
-                    except asyncio.TimeoutError:
-                        break
+            # Pessimistic entry with wound-wait deadlock avoidance (the
+            # lock-queue role of the reference's TransactionalState,
+            # State/TransactionalState.cs:611): one transaction owns a
+            # state's workspace at a time; requesters WAIT for release
+            # (young waiting for old is always safe; old waiting for
+            # young first wounds it, see _entry_blocked), and wounded
+            # transactions abort at this checkpoint to retry at the root
+            # with their original priority (context.TransactionInfo.ts) —
+            # the oldest transaction is never wounded and never waits on
+            # a cycle, so the system always makes progress. (Round 2's
+            # optimistic entry measured ~6.5 attempts per commit at
+            # concurrency 32 on the contended bank workload; pessimistic
+            # entry converts those doomed 2PC rounds into short waits.)
+            while True:
+                now = time.time()
+                if info.id in _wounded:
+                    raise TransactionConflictError(
+                        f"transaction {info.id} wounded by an older "
+                        f"transaction at state {self.name!r}")
+                if not self._entry_blocked(info, now):
+                    break
+                if now >= info.deadline:
+                    raise TransactionConflictError(
+                        f"transaction {info.id} deadline passed waiting "
+                        f"for state {self.name!r}")
+                ev = self._release_event
+                if ev is None or ev.is_set():
+                    ev = self._release_event = asyncio.Event()
+                try:
+                    await asyncio.wait_for(
+                        ev.wait(), min(0.05, info.deadline - now))
+                except asyncio.TimeoutError:
+                    pass  # re-check: TTL expiry / debris sweep
             self.owner._txn_join(info)
             ws = self.workspace[info.id] = {
                 "value": deep_copy(self.committed),
                 "read_version": self.committed_version,
                 "written": False,
+                "ts": info.ts,
+                "deadline": info.deadline,
+                "entered": time.time(),
             }
         return ws
 
@@ -141,6 +213,8 @@ class TransactionalState:
         ws = self.workspace.get(txn)
         if ws is None:
             return True  # joined via another state of the same grain
+        if txn in _wounded:
+            return False  # wounded by an older transaction: give way
         if self.lock is not None and self.lock[1] > now and \
                 self.lock[0] != txn:
             return False  # another transaction is mid-commit on this state
@@ -177,6 +251,9 @@ class TransactionalState:
 
     def abort(self, txn: str) -> None:
         self.workspace.pop(txn, None)
+        now = time.time()
+        _wounded.pop(txn, None)
+        _prune_wounds(now)
         if self.lock is not None and self.lock[0] == txn:
             self.lock = None
         if self.pending_prepare is not None and \
