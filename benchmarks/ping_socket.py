@@ -1,0 +1,173 @@
+"""Socket ping benchmark — RPC throughput over the real TCP wire path.
+
+The loopback benchmark (`benchmarks/ping.py`) never serializes; this one
+exercises the full L2 stack per call: client → gateway socket → wire
+framing + native hotwire codec → dispatcher → grain turn → response back
+over the socket. Two shapes:
+
+* **gateway**: external client to a silo over TCP (the reference's
+  client-to-cluster shape, ClientMessageCenter → GatewayAcceptor);
+* **cross-silo**: a relay grain on silo 1 calls echo grains placed on
+  silo 2, so every hop crosses the silo-to-silo TCP fabric
+  (`SocketManager`-shape traffic).
+
+Prints one JSON line per shape. Single-host/single-core: both silos and
+the client share this process's event loop, so figures are a lower bound
+on a real deployment where each side has its own core.
+"""
+
+import argparse
+import asyncio
+import json
+import time
+
+if __package__ in (None, ""):
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from orleans_tpu.membership import FileMembershipTable, join_cluster
+from orleans_tpu.runtime import Grain, SiloBuilder
+from orleans_tpu.runtime.socket_fabric import GatewayClient, SocketFabric
+
+
+class EchoGrain(Grain):
+    async def ping(self, x: int) -> int:
+        return x
+
+    async def where(self) -> str:
+        return self._activation.runtime.silo_address.endpoint
+
+
+class RelayGrain(Grain):
+    """Forces a cross-silo hop: prefer-local placement pins the relay to
+    its caller's silo; the echo grains it calls may live elsewhere."""
+
+    async def relay(self, key: int, x: int) -> int:
+        return await self.get_grain(EchoGrain, key).ping(x)
+
+
+async def bench_gateway(silo_endpoint: str, concurrency: int,
+                        seconds: float, n_grains: int) -> dict:
+    client = await GatewayClient([silo_endpoint],
+                                 response_timeout=30.0).connect()
+    grains = [client.get_grain(EchoGrain, k) for k in range(n_grains)]
+    await asyncio.gather(*(g.ping(0) for g in grains))
+
+    stop_at = time.perf_counter() + seconds
+    calls = 0
+
+    async def worker(wid: int) -> None:
+        nonlocal calls
+        i = wid
+        while time.perf_counter() < stop_at:
+            await grains[i % n_grains].ping(i)
+            i += concurrency
+            calls += 1
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*(worker(w) for w in range(concurrency)))
+    elapsed = time.perf_counter() - t0
+    await client.close_async()
+    return {
+        "metric": "ping_socket_gateway_calls_per_sec",
+        "value": round(calls / elapsed, 1),
+        "unit": "calls/sec",
+        "vs_baseline": None,
+        "extra": {"concurrency": concurrency, "n_grains": n_grains,
+                  "calls": calls},
+    }
+
+
+async def bench_cross_silo(client, silo1, silo2, concurrency: int,
+                           seconds: float, n_grains: int) -> dict:
+    # echo grains that landed on silo 2: relaying to them crosses the wire
+    grains = [client.get_grain(EchoGrain, k) for k in range(n_grains)]
+    wheres = await asyncio.gather(*(g.ping(0) for g in grains))
+    del wheres
+    s2 = silo2.silo_address.endpoint
+    remote_keys = [k for k in range(n_grains)
+                   if (await client.get_grain(EchoGrain, k).where()) == s2]
+    if not remote_keys:
+        raise RuntimeError("placement put no echo grains on silo 2")
+    relays = [client.get_grain(RelayGrain, f"r{w}")
+              for w in range(concurrency)]
+    await asyncio.gather(*(r.relay(remote_keys[0], 0) for r in relays))
+
+    stop_at = time.perf_counter() + seconds
+    calls = 0
+
+    async def worker(wid: int) -> None:
+        nonlocal calls
+        i = wid
+        r = relays[wid]
+        while time.perf_counter() < stop_at:
+            await r.relay(remote_keys[i % len(remote_keys)], i)
+            i += 1
+            calls += 1
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*(worker(w) for w in range(concurrency)))
+    elapsed = time.perf_counter() - t0
+    return {
+        "metric": "ping_socket_cross_silo_calls_per_sec",
+        "value": round(calls / elapsed, 1),
+        "unit": "calls/sec",
+        "vs_baseline": None,
+        "extra": {"concurrency": concurrency,
+                  "remote_echo_grains": len(remote_keys), "calls": calls},
+    }
+
+
+async def run(concurrency: int, seconds: float, n_grains: int,
+              tmpdir: str) -> None:
+    import os
+    table = FileMembershipTable(os.path.join(tmpdir, "mbr.json"))
+    fabric1, fabric2 = SocketFabric(), SocketFabric()
+    silo1 = (SiloBuilder().with_name("bench-s1").with_fabric(fabric1)
+             .add_grains(EchoGrain, RelayGrain).build())
+    silo2 = (SiloBuilder().with_name("bench-s2").with_fabric(fabric2)
+             .add_grains(EchoGrain, RelayGrain).build())
+    join_cluster(silo1, table)
+    join_cluster(silo2, table)
+    await silo1.start()
+    await silo2.start()
+    client = None
+    try:
+        async def converged():
+            while True:
+                views = [set(s.membership.active) for s in (silo1, silo2)]
+                if all(len(v) == 2 for v in views) and views[0] == views[1]:
+                    return
+                await asyncio.sleep(0.05)
+        await asyncio.wait_for(converged(), timeout=15.0)
+
+        print(json.dumps(await bench_gateway(
+            silo1.silo_address.endpoint, concurrency, seconds, n_grains)),
+            flush=True)
+
+        client = await GatewayClient(
+            [silo1.silo_address.endpoint], response_timeout=30.0).connect()
+        print(json.dumps(await bench_cross_silo(
+            client, silo1, silo2, concurrency, seconds, n_grains)),
+            flush=True)
+    finally:
+        if client is not None:
+            await client.close_async()
+        await silo1.stop()
+        await silo2.stop()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--concurrency", type=int, default=64)
+    p.add_argument("--seconds", type=float, default=5.0)
+    p.add_argument("--grains", type=int, default=200)
+    args = p.parse_args()
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        asyncio.run(run(args.concurrency, args.seconds, args.grains, td))
+
+
+if __name__ == "__main__":
+    main()

@@ -18,6 +18,7 @@ from benchmarks import (
     gpstracker_stream,
     mapreduce,
     ping,
+    ping_socket,
     serialization,
     streams_vector,
     transactions,
@@ -34,6 +35,10 @@ def main() -> None:
     print(json.dumps(asyncio.run(transactions.run(seconds=3.0))))
     print(json.dumps(asyncio.run(transactions.run(seconds=3.0,
                                                   concurrency=32))))
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        asyncio.run(ping_socket.run(concurrency=64, seconds=3.0,
+                                    n_grains=200, tmpdir=td))
     print(json.dumps(chirper_fanout.run(seconds=5.0)))
     for r in asyncio.run(gpstracker_stream.run(seconds=2.0)):
         print(json.dumps(r))

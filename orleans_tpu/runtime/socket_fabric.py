@@ -53,6 +53,7 @@ from .wire import (
     decode_message,
     encode_handshake,
     encode_message,
+    frame_stream,
     read_frame,
 )
 
@@ -65,6 +66,34 @@ __all__ = ["SocketFabric", "GatewayClient"]
 
 _CONNECT_RETRIES = 3
 _CONNECT_BACKOFF = 0.2
+# greedy sender batching: everything queued when the writer wakes rides
+# one socket write (bounded so one slow peer cannot hold a huge buffer)
+_SEND_BATCH_MAX = 256
+
+
+def _drain_batch(queue: "asyncio.Queue[Message]", first: Message) -> list:
+    """Greedy drain: everything already queued rides one write + one
+    drain (the reference's sender batches the same way — SiloMessageSender
+    drains its queue per send turn)."""
+    batch = [first]
+    while len(batch) < _SEND_BATCH_MAX:
+        try:
+            batch.append(queue.get_nowait())
+        except asyncio.QueueEmpty:
+            break
+    return batch
+
+
+def _encode_batch(batch: list, bounce) -> list:
+    """Encode each message, routing per-message failures to ``bounce``
+    (encode errors are scoped to one message, never the connection)."""
+    chunks = []
+    for m in batch:
+        try:
+            chunks.append(encode_message(m))
+        except Exception as e:  # noqa: BLE001 — per-message, not the link
+            bounce(m, e)
+    return chunks
 
 
 def _fresh_generation() -> int:
@@ -111,17 +140,16 @@ class _Sender:
     async def _run(self) -> None:
         while True:
             msg = await self.queue.get()
+            batch = _drain_batch(self.queue, msg)
             if self.fabric.is_endpoint_dead(self.endpoint):
                 continue  # dead-silo drop (MessageCenter SiloDeadOracle)
-            try:
-                data = encode_message(msg)
-            except Exception as e:  # noqa: BLE001 — per-message, not the link
-                self.fabric.bounce_unencodable(msg, e)
+            chunks = _encode_batch(batch, self.fabric.bounce_unencodable)
+            if not chunks:
                 continue
             try:
                 if self.writer is None or self.writer.is_closing():
                     self.writer = await self._connect()
-                self.writer.write(data)
+                self.writer.write(b"".join(chunks))
                 await self.writer.drain()
             except (SiloUnavailableError, OSError, FrameError) as e:
                 log.warning("send to %s failed: %s", self.endpoint, e)
@@ -333,8 +361,7 @@ class SocketFabric:
                 # records gateway routes; here route == live connection)
                 self.client_routes[peer_addr] = writer
                 self._route_owner[peer_addr] = silo.silo_address
-            while True:
-                headers, body = await read_frame(reader)
+            async for headers, body in frame_stream(reader):
                 try:
                     msg = decode_message(headers, body)
                 except _BodyDecodeError as e:
@@ -454,8 +481,7 @@ class _GatewayConnection:
     async def _pump(self, reader: asyncio.StreamReader) -> None:
         """Client message pump (OutsideRuntimeClient.RunClientMessagePump:235)."""
         try:
-            while True:
-                headers, body = await read_frame(reader)
+            async for headers, body in frame_stream(reader):
                 try:
                     msg = decode_message(headers, body)
                 except _BodyDecodeError as e:
@@ -480,33 +506,37 @@ class _GatewayConnection:
             if self.writer is not None:
                 self.writer.close()
 
+    def _bounce_unencodable(self, m: Message, e: Exception) -> None:
+        if m.direction != Direction.RESPONSE:
+            from ..core.message import make_error_response
+            self.client.deliver(make_error_response(
+                m, SiloUnavailableError(
+                    f"wire encode failed for "
+                    f"{m.interface_name}.{m.method_name}: {e}")))
+
     async def _send_loop(self) -> None:
         while True:
             msg = await self.queue.get()
-            try:
-                data = encode_message(msg)
-            except Exception as e:  # noqa: BLE001 — unpicklable payload
-                if msg.direction != Direction.RESPONSE:
-                    from ..core.message import make_error_response
-                    self.client.deliver(make_error_response(
-                        msg, SiloUnavailableError(
-                            f"wire encode failed for "
-                            f"{msg.interface_name}.{msg.method_name}: {e}")))
+            batch = _drain_batch(self.queue, msg)
+            chunks = _encode_batch(batch, self._bounce_unencodable)
+            if not chunks:
                 continue
             try:
                 assert self.writer is not None
-                self.writer.write(data)
+                self.writer.write(b"".join(chunks))
                 await self.writer.drain()
             except (OSError, AssertionError) as e:
                 self.live = False
                 log.warning("gateway %s send failed: %s", self.endpoint, e)
-                # the connection is known-dead: fail the call promptly
-                # instead of letting it wait out the response timeout
-                if msg.direction != Direction.RESPONSE:
-                    from ..core.message import make_error_response
-                    self.client.deliver(make_error_response(
-                        msg, SiloUnavailableError(
-                            f"gateway {self.endpoint} connection lost")))
+                # the connection is known-dead: fail EVERY batched call
+                # promptly instead of letting any wait out the response
+                # timeout
+                from ..core.message import make_error_response
+                for m in batch:
+                    if m.direction != Direction.RESPONSE:
+                        self.client.deliver(make_error_response(
+                            m, SiloUnavailableError(
+                                f"gateway {self.endpoint} connection lost")))
 
     def close(self) -> None:
         self.live = False

@@ -34,7 +34,7 @@ from ..core.serialization import deserialize, serialize
 
 __all__ = [
     "MAX_FRAME_SEGMENT", "FrameError", "WireDecodeError",
-    "encode_frame", "read_frame",
+    "encode_frame", "read_frame", "frame_stream",
     "encode_message", "decode_message",
     "encode_handshake", "decode_handshake",
 ]
@@ -72,6 +72,40 @@ async def read_frame(reader: asyncio.StreamReader) -> tuple[bytes, bytes]:
     headers = await reader.readexactly(hlen) if hlen else b""
     body = await reader.readexactly(blen) if blen else b""
     return headers, body
+
+
+async def frame_stream(reader: asyncio.StreamReader, chunk_size: int = 1 << 16):
+    """Yield (headers, body) frames from a buffered chunk reader.
+
+    The per-frame path (`read_frame`) costs three readexactly awaits per
+    message; under load this reads a socket chunk once and parses every
+    complete frame out of it (the IncomingMessageBuffer batching,
+    IncomingMessageBuffer.cs:125). Ends cleanly at EOF on a frame
+    boundary; raises IncompleteReadError for a mid-frame EOF and
+    FrameError for an oversized announcement (connection must drop)."""
+    buf = bytearray()
+    pos = 0
+    while True:
+        end = len(buf)
+        while end - pos >= 8:
+            hlen, blen = _LEN.unpack_from(buf, pos)
+            if hlen > MAX_FRAME_SEGMENT or blen > MAX_FRAME_SEGMENT:
+                raise FrameError(f"oversized frame announced: {hlen}+{blen}")
+            total = 8 + hlen + blen
+            if end - pos < total:
+                break
+            h0 = pos + 8
+            yield bytes(buf[h0:h0 + hlen]), bytes(buf[h0 + hlen:pos + total])
+            pos += total
+        if pos:
+            del buf[:pos]
+            pos = 0
+        chunk = await reader.read(chunk_size)
+        if not chunk:
+            if buf:
+                raise asyncio.IncompleteReadError(bytes(buf), None)
+            return
+        buf += chunk
 
 
 # ---------------------------------------------------------------------------

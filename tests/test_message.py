@@ -153,3 +153,60 @@ def test_stack_overflow_guard():
     sch = ArraySchema.of(x=(np.float32, ()))
     with pytest.raises(ValueError, match="exceeds pad_to"):
         sch.stack([{"x": 0.0}] * 10, pad_to=8)
+
+
+# ---------------------------------------------------------------------------
+# frame_stream: buffered chunked frame parsing (wire.py)
+# ---------------------------------------------------------------------------
+
+class _ChunkReader:
+    """StreamReader stand-in feeding preset chunks."""
+
+    def __init__(self, chunks):
+        self.chunks = list(chunks)
+
+    async def read(self, n):
+        return self.chunks.pop(0) if self.chunks else b""
+
+
+async def _collect_frames(chunks):
+    from orleans_tpu.runtime.wire import frame_stream
+    out = []
+    async for h, b in frame_stream(_ChunkReader(chunks)):
+        out.append((h, b))
+    return out
+
+
+def test_frame_stream_parses_frames_across_chunk_boundaries():
+    import asyncio
+    from orleans_tpu.runtime.wire import encode_frame
+    frames = [(f"h{i}".encode(), f"body-{i}".encode() * i) for i in range(5)]
+    blob = b"".join(encode_frame(h, b) for h, b in frames)
+    # all at once, byte-by-byte, and ragged 7-byte chunks
+    for chunking in ([blob],
+                     [blob[i:i + 1] for i in range(len(blob))],
+                     [blob[i:i + 7] for i in range(0, len(blob), 7)]):
+        got = asyncio.get_event_loop_policy().new_event_loop()\
+            .run_until_complete(_collect_frames(chunking))
+        assert got == frames, chunking
+
+
+def test_frame_stream_mid_frame_eof_raises():
+    import asyncio
+    import pytest
+    from orleans_tpu.runtime.wire import encode_frame
+    blob = encode_frame(b"hh", b"bb")[:-1]
+    loop = asyncio.get_event_loop_policy().new_event_loop()
+    with pytest.raises(asyncio.IncompleteReadError):
+        loop.run_until_complete(_collect_frames([blob]))
+
+
+def test_frame_stream_oversized_announcement_raises():
+    import asyncio
+    import struct
+    import pytest
+    from orleans_tpu.runtime.wire import MAX_FRAME_SEGMENT, FrameError
+    bad = struct.pack("<II", MAX_FRAME_SEGMENT + 1, 0) + b"x" * 16
+    loop = asyncio.get_event_loop_policy().new_event_loop()
+    with pytest.raises(FrameError):
+        loop.run_until_complete(_collect_frames([bad]))
