@@ -28,7 +28,8 @@ if __package__ in (None, ""):
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from orleans_tpu.runtime import ClusterClient, Grain, SiloBuilder
-from orleans_tpu.streams import MemoryQueueAdapter, add_persistent_streams
+from orleans_tpu.streams import (MemoryQueueAdapter, add_persistent_streams,
+                                 batch_consumer)
 
 NS = "position"
 
@@ -53,10 +54,14 @@ class PushNotifierGrain(Grain):
 
     async def join(self, device_key: int) -> None:
         stream = self.get_stream_provider("queue").get_stream(NS, device_key)
-        await stream.subscribe(self.on_fix)
+        await stream.subscribe(self.on_fixes)
 
-    async def on_fix(self, fix, token) -> None:
-        self.seen += 1
+    @batch_consumer
+    async def on_fixes(self, fixes: list, first_token: int) -> None:
+        # IAsyncBatchObserver-style web-push boundary: one notification
+        # flush per delivered batch (the reference's notifier batches the
+        # same way)
+        self.seen += len(fixes)
 
     async def count(self) -> int:
         return self.seen

@@ -10,7 +10,8 @@ from .balancer import (
     QueueBalancer,
 )
 from .cache import PooledQueueCache, QueueCacheCursor
-from .core import StreamId, StreamProvider, StreamRef, SubscriptionHandle
+from .core import (StreamId, StreamProvider, StreamRef,
+                   SubscriptionHandle, batch_consumer)
 from .persistent import (
     MemoryQueueAdapter,
     PersistentStreamProvider,
@@ -24,6 +25,7 @@ from .sms import SMSStreamProvider, add_sms_streams
 
 __all__ = [
     "StreamId", "StreamRef", "SubscriptionHandle", "StreamProvider",
+    "batch_consumer",
     "SMSStreamProvider", "add_sms_streams",
     "QueueAdapter", "QueueReceiver", "QueueBatch", "MemoryQueueAdapter",
     "PersistentStreamProvider", "add_persistent_streams",

@@ -46,6 +46,13 @@ class PooledQueueCache:
         self._items: collections.deque[CachedBatch] = collections.deque()
         self._next_token = 0
         self.cursors: dict[Any, QueueCacheCursor] = {}
+        # streams whose consumer view the agent has resolved (with or
+        # without consumers). Batches of an UNRESOLVED stream pin the
+        # eviction floor: their pump/cursor may simply not exist yet, and
+        # evicting them would silently drop events (the bug class this
+        # guards: pressure-branch purge racing the first consumer
+        # refresh). Maintained by the pulling agent.
+        self.resolved_streams: set = set()
 
     # -- write side --------------------------------------------------------
     def add(self, batch: Any) -> CachedBatch:
@@ -101,12 +108,21 @@ class PooledQueueCache:
     # -- eviction ----------------------------------------------------------
     def purge(self) -> list[Any]:
         """Evict batches every live cursor has passed; returns the evicted
-        batches (the agent acks them upstream). With no cursors the cache
-        drains fully — no consumers means nothing to wait for."""
+        batches (the agent acks them upstream). A stream the agent has not
+        yet resolved consumers for pins the floor at its oldest batch —
+        see ``resolved_streams``. With no cursors and everything resolved
+        the cache drains fully — no consumers means nothing to wait for."""
         if self.cursors:
             low = min(c.next_token for c in self.cursors.values())
         else:
             low = self._next_token
+        for cb in self._items:
+            if cb.token >= low:
+                break
+            if cb.batch.stream not in self.resolved_streams:
+                # tokens are ordered: the first unresolved batch is the floor
+                low = cb.token
+                break
         evicted = []
         while self._items and self._items[0].token < low:
             evicted.append(self._items.popleft().batch)

@@ -49,6 +49,10 @@ class SubscriptionHandle:
     grain_id: GrainId
     interface_name: str
     method_name: str
+    # batch consumer (IAsyncBatchObserver<T>): deliveries arrive as ONE
+    # call per queue batch — method(items, first_token) — instead of a
+    # grain call per event
+    batch: bool = False
 
 
 def consumer_of(handler: Callable) -> tuple[GrainId, str, str]:
@@ -61,6 +65,18 @@ def consumer_of(handler: Callable) -> tuple[GrainId, str, str]:
             "stream handlers must be bound methods of a grain "
             "(e.g. stream.subscribe(self.on_event))")
     return owner.grain_id, type(owner).__name__, handler.__name__
+
+
+def batch_consumer(fn: Callable) -> Callable:
+    """Mark a stream handler as a BATCH consumer (the
+    ``IAsyncBatchObserver<T>`` role): it receives
+    ``(items: list, first_token: int)`` once per delivered batch instead
+    of one grain call per event. Subscribing such a method picks batch
+    delivery automatically; redelivery after a failure re-sends the
+    not-yet-acknowledged remainder of the batch (at-least-once, dedup by
+    token as usual)."""
+    fn.__orleans_stream_batch__ = True
+    return fn
 
 
 class StreamRef:
@@ -82,11 +98,15 @@ class StreamRef:
         await self.provider.complete(self.stream_id)
 
     # -- consumer side (StreamImpl.Subscribe :60) -----------------------
-    async def subscribe(self, handler: Callable) -> SubscriptionHandle:
+    async def subscribe(self, handler: Callable,
+                        batch: bool | None = None) -> SubscriptionHandle:
         grain_id, iface, method = consumer_of(handler)
+        if batch is None:
+            batch = bool(getattr(handler, "__orleans_stream_batch__", False))
         handle = SubscriptionHandle(
             stream=self.stream_id, handle_id=uuid.uuid4().hex,
-            grain_id=grain_id, interface_name=iface, method_name=method)
+            grain_id=grain_id, interface_name=iface, method_name=method,
+            batch=batch)
         await self.provider.register_consumer(handle)
         return handle
 

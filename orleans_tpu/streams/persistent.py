@@ -256,6 +256,9 @@ class PullingAgent:
                     if idle and not has_pump and stream not in cached_streams:
                         self._streams_seen.pop(stream, None)
                         self._stream_activity.pop(stream, None)
+                        # a reappearing stream must re-pin eviction until
+                        # its consumer view is re-resolved
+                        self.cache.resolved_streams.discard(stream)
                     else:
                         streams.add(stream)
             for stream in streams:
@@ -283,6 +286,10 @@ class PullingAgent:
             if key not in self.pumps:
                 self.pumps[key] = _ConsumerPump(self, stream, h)
                 self.pumps[key].wake.set()
+        # consumer view now known: cached batches for this stream may be
+        # evicted once cursors pass (or immediately, if no consumers) —
+        # until this point they pin the cache's eviction floor
+        self.cache.resolved_streams.add(stream)
 
     async def evict_and_ack(self) -> None:
         """Evict fully-consumed batches and ack them upstream — at-least-once

@@ -77,7 +77,9 @@ def implicit_consumers(silo: "Silo", stream: StreamId) -> list[SubscriptionHandl
             out.append(SubscriptionHandle(
                 stream=stream, handle_id=f"implicit:{cls.__name__}",
                 grain_id=gid, interface_name=cls.__name__,
-                method_name="on_next"))
+                method_name="on_next",
+                batch=bool(getattr(getattr(cls, "on_next", None),
+                                   "__orleans_stream_batch__", False))))
     return out
 
 
@@ -116,7 +118,18 @@ async def deliver_to_consumer(silo: "Silo", handle: SubscriptionHandle,
     if cls is None:
         raise LookupError(
             f"stream consumer class {handle.interface_name} not registered")
-    for i in range(progress.get("done", 0), len(items)):
+    done = progress.get("done", 0)
+    if getattr(handle, "batch", False):
+        # batch consumer (IAsyncBatchObserver): one call per queue batch;
+        # a retry re-sends the unacknowledged remainder
+        await silo.runtime_client.send_request(
+            target_grain=handle.grain_id, grain_class=cls,
+            interface_name=handle.interface_name,
+            method_name=handle.method_name,
+            args=(list(items[done:]), first_token + done), kwargs={})
+        progress["done"] = len(items)
+        return
+    for i in range(done, len(items)):
         fut = silo.runtime_client.send_request(
             target_grain=handle.grain_id, grain_class=cls,
             interface_name=handle.interface_name,

@@ -115,6 +115,7 @@ def _batch(stream_name: str, seq: int):
 
 def test_cache_cursors_are_independent():
     c = PooledQueueCache(capacity=16)
+    c.resolved_streams.add(StreamId("mem", "ns", "a"))  # view known
     for i in range(4):
         c.add(_batch("a", i))
     fast = c.new_cursor("fast")
@@ -137,7 +138,11 @@ def test_cache_pressure_and_purge_without_cursors():
     for i in range(3):
         c.add(_batch("a", i))
     assert c.under_pressure
-    # no cursors: everything is evictable
+    # consumer view not yet resolved: batches are pinned, NOT evictable
+    # (evicting here silently drops events — the round-3 eviction bug)
+    assert c.purge() == []
+    # once resolved with no cursors: everything drains
+    c.resolved_streams.add(StreamId("mem", "ns", "a"))
     assert len(c.purge()) == 3
     assert not c.under_pressure
 
