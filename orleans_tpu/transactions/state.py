@@ -35,6 +35,18 @@ from .context import ambient_txn
 __all__ = ["TransactionalState", "TransactionalGrain"]
 
 PREPARE_LOCK_TTL = 10.0  # steal an expired lock: TM died mid-2PC
+# how long a non-transactional read keeps retrying resolution of an
+# in-doubt prepared write (TM failover window) before serving the last
+# committed value
+IN_DOUBT_READ_TIMEOUT = 5.0
+# a prepare lock held this long is queried against the TM's decision log
+# from the entry wait loop (the outcome may be logged but undelivered);
+# past IN_DOUBT_FORCE_AFTER the query forces a durable presumed-abort for
+# an unknown txn — without this, every waiter sits out the full
+# PREPARE_LOCK_TTL after a TM dies mid-2PC, and those 10s stalls are long
+# enough to false-kill healthy silos via missed liveness probes
+IN_DOUBT_QUERY_AFTER = 0.25
+IN_DOUBT_FORCE_AFTER = 1.0
 # A workspace blocks other transactions' entry (wound-wait) only this long
 # after first touch. Entry blocking is a conflict-avoidance optimization —
 # the read-version check at prepare is what guarantees serializability —
@@ -90,13 +102,25 @@ class TransactionalState:
         info = ambient_txn()
         if info is None:
             if self.pending_prepare is not None and self.owner is not None:
-                # an in-doubt prepared write is outstanding: ask the TM
-                # before serving a value a logged commit may be about to
-                # replace (read-your-committed-writes; force_query means
-                # a decided outcome applies NOW, while an undecided 2PC
-                # keeps its lock until expiry)
-                await self.owner._resolve_in_doubt(time.time(),
-                                                   force_query=True)
+                # An in-doubt prepared write is outstanding: the value a
+                # logged commit may be about to replace must not be
+                # served. One resolution attempt is not enough right
+                # after a failover — the TM shard may still be
+                # reactivating (its first decision_of can fail on stale
+                # directory routes) — so retry briefly; the loop ends the
+                # moment the decision applies (or the prepare is dropped
+                # as aborted). After sustained TM unreachability we fall
+                # through to the committed value: availability over
+                # blocking forever, and the prepare stays held for the
+                # next reader/prepare to resolve.
+                deadline = time.time() + IN_DOUBT_READ_TIMEOUT
+                while self.pending_prepare is not None:
+                    await self.owner._resolve_in_doubt(time.time(),
+                                                       force_query=True)
+                    if self.pending_prepare is None or \
+                            time.time() >= deadline:
+                        break
+                    await asyncio.sleep(0.05)
             return deep_copy(self.committed)
         ws = await self._enter(info)
         return ws["value"]
@@ -189,6 +213,22 @@ class TransactionalState:
                     raise TransactionConflictError(
                         f"transaction {info.id} deadline passed waiting "
                         f"for state {self.name!r}")
+                if self.lock is not None and self.lock[0] != info.id and \
+                        self.pending_prepare is not None and \
+                        self.owner is not None:
+                    # blocked on a mid-2PC prepare: the decision may be
+                    # logged but undelivered (TM died / slow fan-out) —
+                    # resolve through the decision log instead of sitting
+                    # out the lock TTL
+                    lock_age = PREPARE_LOCK_TTL - (self.lock[1] - now)
+                    if lock_age > IN_DOUBT_QUERY_AFTER:
+                        await self.owner._resolve_in_doubt(
+                            now, force_query=True,
+                            resolve_fresh=lock_age > IN_DOUBT_FORCE_AFTER)
+                        if not self._entry_blocked(info, time.time()) \
+                                and info.id not in _wounded:
+                            break  # settled: enter now
+                        # else fall through to the paced wait
                 ev = self._release_event
                 if ev is None or ev.is_set():
                     ev = self._release_event = asyncio.Event()
@@ -316,7 +356,8 @@ class TransactionalGrain(Grain):
         return f"txnprep:{type(self).__name__}:{st.name}"
 
     async def _resolve_in_doubt(self, now: float,
-                                force_query: bool = False) -> None:
+                                force_query: bool = False,
+                                resolve_fresh: bool = False) -> None:
         """Resolve held prepares whose outcome never arrived by asking
         the transaction's TM shard (``decision_of`` against the durable
         decision log) — committed → apply the prepared write; aborted →
@@ -325,7 +366,12 @@ class TransactionalGrain(Grain):
         without a fresh prepare round). ``force_query=True`` (reactivation)
         queries even while the lock is fresh, so a decision the previous
         incarnation missed applies immediately; an unknown outcome is then
-        held until expiry in case the 2PC is still in flight."""
+        held until expiry in case the 2PC is still in flight.
+        ``resolve_fresh=True`` escalates: an unknown txn is durably
+        presumed-aborted even while the lock is fresh — used by the entry
+        wait loop once a lock has been in-doubt past IN_DOUBT_FORCE_AFTER
+        (first-decision-wins at the log makes this safe: a late commit
+        attempt for that txn finds the abort already decided)."""
         silo = self._activation.runtime
         agent = getattr(silo, "transactions", None)
         for st in self._txn_states():
@@ -344,7 +390,7 @@ class TransactionalGrain(Grain):
                     # presumed-abort for an unknown txn, so a slow 2PC
                     # can no longer commit after we drop the prepare
                     decision = await agent.decision_of(
-                        pending["txn"], resolve=expired)
+                        pending["txn"], resolve=expired or resolve_fresh)
                     reachable = True
                 except Exception:  # noqa: BLE001 — TM unreachable: leave
                     # the prepare held; the next prepare/retry re-asks
@@ -356,7 +402,7 @@ class TransactionalGrain(Grain):
             elif decision is not None:
                 st.abort(pending["txn"])
                 await self._clear_prepare(st, silo)
-            elif reachable and expired:
+            elif reachable and (expired or resolve_fresh):
                 # the authoritative shard has no record: presumed abort
                 st.abort(pending["txn"])
                 await self._clear_prepare(st, silo)
@@ -399,6 +445,17 @@ class TransactionalGrain(Grain):
     @always_interleave
     async def _txn_prepare(self, txn: str) -> bool:
         now = time.time()
+        if txn not in self._txn_joined:
+            # No trace of this transaction on this activation. The
+            # per-state "ws is None → vote True" below is for multi-state
+            # grains where the txn touched a sibling state — but a
+            # participant that CRASHED after entering its workspace
+            # reactivates with no workspace at all, and voting True here
+            # lets the TM commit a transfer whose write evaporated with
+            # the old activation (measured: one unmatched transfer leg
+            # per ~10 kill runs before this guard). No join trace → the
+            # write is gone → the transaction must abort and retry.
+            return False
         states = self._txn_states()
         if any(st.pending_prepare is not None
                and (st.lock is None or st.lock[1] <= now
