@@ -342,7 +342,9 @@ class InsideRuntimeClient(RuntimeClient):
         act.record_running(marker)
         token = current_activation.set(act)
         try:
-            result = await fn(*args, **kwargs)
+            # snapshot BEFORE the pump below runs queued turns: a result
+            # aliasing grain-internal state must not pick up later writes
+            result = copy_result(await fn(*args, **kwargs))
         finally:
             current_activation.reset(token)
             act.reset_running(marker)
@@ -361,7 +363,7 @@ class InsideRuntimeClient(RuntimeClient):
         if self._direct_calls_since_yield >= _DIRECT_YIELD_EVERY:
             self._direct_calls_since_yield = 0
             await asyncio.sleep(0)
-        return copy_result(result)
+        return result
 
 
 class Silo:

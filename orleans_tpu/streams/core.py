@@ -53,6 +53,12 @@ class SubscriptionHandle:
     # call per queue batch — method(items, first_token) — instead of a
     # grain call per event
     batch: bool = False
+    # rewound subscription (StreamSequenceToken resume): deliver only
+    # events with token >= from_token, replaying older ones from the
+    # pulling agent's cache where still present (events already purged
+    # are clamped to the oldest cached — the reference's cache-window
+    # replay contract). None = from now/oldest-cached as usual.
+    from_token: int | None = None
 
 
 def consumer_of(handler: Callable) -> tuple[GrainId, str, str]:
@@ -99,14 +105,19 @@ class StreamRef:
 
     # -- consumer side (StreamImpl.Subscribe :60) -----------------------
     async def subscribe(self, handler: Callable,
-                        batch: bool | None = None) -> SubscriptionHandle:
+                        batch: bool | None = None,
+                        from_token: int | None = None) -> SubscriptionHandle:
+        """Subscribe a bound grain method. ``batch`` (or the
+        ``@batch_consumer`` marker) selects whole-batch delivery;
+        ``from_token`` resumes a rewindable (persistent) stream from a
+        sequence token, replaying from the provider's cache window."""
         grain_id, iface, method = consumer_of(handler)
         if batch is None:
             batch = bool(getattr(handler, "__orleans_stream_batch__", False))
         handle = SubscriptionHandle(
             stream=self.stream_id, handle_id=uuid.uuid4().hex,
             grain_id=grain_id, interface_name=iface, method_name=method,
-            batch=batch)
+            batch=batch, from_token=from_token)
         await self.provider.register_consumer(handle)
         return handle
 

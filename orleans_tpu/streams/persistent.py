@@ -88,8 +88,14 @@ class MemoryQueueAdapter(QueueAdapter):
         self._seq = 0
 
     async def queue_message_batch(self, queue_id, stream, items) -> None:
-        self._seq += 1
-        self._queues[queue_id].append(QueueBatch(stream, list(items), self._seq))
+        # item-cumulative sequence: batch.seq is the token of the batch's
+        # FIRST item, so per-item tokens (seq + i) are unique and ordered
+        # across batches — the EventSequenceToken contract consumers dedup
+        # and rewind by (per-batch numbering made tokens of adjacent
+        # multi-item batches overlap)
+        seq = self._seq
+        self._seq += len(items)
+        self._queues[queue_id].append(QueueBatch(stream, list(items), seq))
 
     def create_receiver(self, queue_id: int) -> "QueueReceiver":
         return _MemoryReceiver(self._queues[queue_id])

@@ -110,6 +110,16 @@ async def deliver_to_consumer(silo: "Silo", handle: SubscriptionHandle,
     reference's stream redelivery contract (consumers dedup by token)."""
     if progress is None:
         progress = {}
+    ft = getattr(handle, "from_token", None)
+    if ft is not None:
+        # rewound subscription: trim below the resume token (batches
+        # fully before it skip entirely)
+        if first_token + len(items) <= ft:
+            progress["done"] = len(items)
+            return
+        if first_token < ft:
+            items = items[ft - first_token:]
+            first_token = ft
     vcls = silo.vector_interfaces.get(handle.interface_name)
     if vcls is not None and getattr(silo, "vector", None) is not None:
         return await deliver_to_vector_consumer(silo, vcls, handle, items,

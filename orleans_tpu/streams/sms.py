@@ -9,10 +9,10 @@ publish time; optional fire-and-forget delivery.
 from __future__ import annotations
 
 import asyncio
-import itertools
 import logging
 from typing import TYPE_CHECKING
 
+from ..core.errors import StreamError
 from .core import StreamId, StreamProvider, SubscriptionHandle
 from .pubsub import (
     PubSubRendezvousGrain,
@@ -35,11 +35,14 @@ class SMSStreamProvider(StreamProvider):
                  fire_and_forget: bool = False):
         super().__init__(silo, name)
         self.fire_and_forget = fire_and_forget
-        self._seq = itertools.count()
+        self._seq = 0
 
     async def produce(self, stream: StreamId, items: list) -> None:
         consumers = await resolve_consumers(self.silo, stream)
-        token = next(self._seq)
+        # item-cumulative: per-item tokens (token + i) stay unique across
+        # batches (consumers dedup by token — see deliver_to_consumer)
+        token = self._seq
+        self._seq += len(items)
         self.silo.stats.increment("streams.sms.produced", len(items))
         deliveries = [
             deliver_to_consumer(self.silo, h, items, token)
@@ -56,6 +59,11 @@ class SMSStreamProvider(StreamProvider):
                 raise errors[0]
 
     async def register_consumer(self, handle: SubscriptionHandle) -> None:
+        if getattr(handle, "from_token", None) is not None:
+            raise StreamError(
+                "SMS streams are not rewindable (no cache to replay "
+                "from) — use a persistent (queue-backed) provider for "
+                "from_token subscriptions")
         await self._rendezvous(handle.stream).register_consumer(handle)
 
     async def unregister_consumer(self, handle: SubscriptionHandle) -> None:
