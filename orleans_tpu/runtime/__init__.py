@@ -2,6 +2,10 @@
 L3/L4/L8/L9/L10)."""
 
 from .activation import ActivationData, ActivationState  # noqa: F401
+from .cancellation import (  # noqa: F401
+    GrainCancellationToken,
+    GrainCancellationTokenSource,
+)
 from .cluster import ClusterClient, InProcFabric  # noqa: F401
 from .socket_fabric import GatewayClient, SocketFabric  # noqa: F401
 from .context import RequestContext  # noqa: F401

@@ -33,6 +33,7 @@ from ..core.message import (
     make_rejection,
     make_response,
 )
+from .cancellation import CANCEL_METHOD, maybe_intern_tokens
 from .context import TXN_KEY
 from ..core.serialization import copy_result
 from .activation import ActivationData, ActivationState
@@ -427,6 +428,12 @@ class Dispatcher:
                 if done is not None and not done.done():
                     done.set_exception(e)
                 raise
+        if msg.method_name == CANCEL_METHOD:
+            # grain cancellation fan-in (GrainCancellationTokenRuntime →
+            # CancellationSourcesExtension.CancelRemoteToken): fire the
+            # silo's interned twin for this token id
+            self.silo.cancellation_tokens.fire(msg.body[0][0])
+            return None
         if msg.method_name == "on_incoming_call":
             # the filter hook is not a remote method: invoking it directly
             # would run the gate with a caller-controlled context object
@@ -439,7 +446,7 @@ class Dispatcher:
             raise AttributeError(
                 f"{activation.grain_class.__name__} has no method "
                 f"{msg.method_name!r}")
-        args, kwargs = msg.body
+        args, kwargs = maybe_intern_tokens(self.silo, *msg.body)
         # incoming call filter chain (InsideRuntimeClient.cs:362 →
         # GrainMethodInvoker): silo filters first, then the grain's own
         # on_incoming_call (grain-implements-the-filter form) last.

@@ -28,6 +28,7 @@ from ..core.message import (
     make_request_fast,
 )
 from ..core.serialization import copy_call_body, deep_copy
+from .cancellation import register_outgoing_tokens
 from .context import TXN_KEY, RequestContext, current_activation
 
 if TYPE_CHECKING:
@@ -197,6 +198,11 @@ class RuntimeClient:
             running = sender.running[-1] if sender.running else None
             parent_chain = running.call_chain if running is not None else ()
             call_chain = (*parent_chain, sender.grain_id)
+        # record call targets on any cancellation-token argument so
+        # source.cancel() can reach remote twins (the reference's
+        # _targetGrainReferences bookkeeping)
+        register_outgoing_tokens(self, target_grain, grain_class,
+                                 args, kwargs)
         # Copy-isolate arguments at send time (SerializationManager.DeepCopy
         # for in-silo calls): caller mutations after the call cannot leak into
         # the callee. Immutable-wrapped args pass by reference.

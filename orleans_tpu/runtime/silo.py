@@ -26,6 +26,7 @@ from ..core.message import Category, Direction, Message
 from ..core.serialization import copy_call_body, copy_result
 from ..observability.stats import StatsRegistry
 from ..storage.core import StorageManager
+from .cancellation import TokenInterner
 from .catalog import Catalog
 from .context import current_activation
 from .dispatcher import Dispatcher
@@ -377,6 +378,8 @@ class Silo:
         self.storage_manager = storage
         self.silo_address = fabric.allocate_address(config.name)
         self.stats = StatsRegistry()
+        # grain cancellation twins (CancellationSourcesExtension)
+        self.cancellation_tokens = TokenInterner(self)
 
         # ctor wiring order mirrors Silo.cs:124-260
         self.runtime_client = InsideRuntimeClient(self)
