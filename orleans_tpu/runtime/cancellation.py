@@ -100,11 +100,14 @@ class GrainCancellationTokenSource:
         self.token._fire()
         notifies = []
         for gid, (client, cls) in list(self.token._targets.items()):
-            fut = client.send_request(
-                target_grain=gid, grain_class=cls,
-                interface_name=cls.__name__ if cls else "",
-                method_name=CANCEL_METHOD, args=(self.token.id,), kwargs={},
-                is_always_interleave=True)
+            try:
+                fut = client.send_request(
+                    target_grain=gid, grain_class=cls,
+                    interface_name=cls.__name__ if cls else "",
+                    method_name=CANCEL_METHOD, args=(self.token.id,),
+                    kwargs={}, is_always_interleave=True)
+            except Exception:  # noqa: BLE001 — best effort per target: a
+                continue       # raising transmit must not skip the rest
             if fut is not None:
                 notifies.append(fut)
         if notifies:
@@ -147,15 +150,9 @@ class TokenInterner:
             if token.is_cancelled:
                 self.fire(token.id)
             return twin
-        pre = self._precancelled.get(token.id)
-        if pre is not None:
+        if self._precancelled.pop(token.id, None) is not None:
             token._fire()  # cancel raced ahead of the call
         self._twins[token.id] = token
-        if token.is_cancelled:
-            # arrived already-cancelled: targets recorded on THIS twin
-            # later still need the cascade when fire() is re-entered, but
-            # nothing to do now (no targets yet)
-            pass
         return token
 
     def fire(self, token_id: str) -> bool:
@@ -168,6 +165,12 @@ class TokenInterner:
                 now = time.monotonic()
                 if len(self._precancelled) >= _PRECANCELLED_CAP:
                     self._sweep(now)
+                    while len(self._precancelled) >= _PRECANCELLED_CAP:
+                        # TTL freed nothing (cancel-first flood inside the
+                        # window): evict oldest — the cap is a hard bound
+                        oldest = min(self._precancelled,
+                                     key=lambda t: self._precancelled[t][1])
+                        self._precancelled.pop(oldest)
                 self._precancelled[token_id] = (
                     GrainCancellationToken(token_id, cancelled=True), now)
             return False

@@ -125,6 +125,17 @@ class Dispatcher:
         self._receive_local(msg)
 
     def _receive_local(self, msg: Message) -> None:
+        if msg.method_name == CANCEL_METHOD and \
+                msg.direction != Direction.RESPONSE:
+            # grain cancellation fan-in (GrainCancellationTokenRuntime →
+            # CancellationSourcesExtension.CancelRemoteToken): per-silo,
+            # handled BEFORE activation lookup — a cancel for a grain
+            # whose activation aged out must not resurrect it just to
+            # touch the silo interner
+            self.silo.cancellation_tokens.fire(msg.body[0][0])
+            if msg.direction == Direction.REQUEST:
+                self.send_response(msg, make_response(msg, None))
+            return
         try:
             activation = self.silo.catalog.get_or_create_activation(msg)
         except NonExistentActivationError as e:
@@ -428,12 +439,6 @@ class Dispatcher:
                 if done is not None and not done.done():
                     done.set_exception(e)
                 raise
-        if msg.method_name == CANCEL_METHOD:
-            # grain cancellation fan-in (GrainCancellationTokenRuntime →
-            # CancellationSourcesExtension.CancelRemoteToken): fire the
-            # silo's interned twin for this token id
-            self.silo.cancellation_tokens.fire(msg.body[0][0])
-            return None
         if msg.method_name == "on_incoming_call":
             # the filter hook is not a remote method: invoking it directly
             # would run the gate with a caller-controlled context object
