@@ -1,0 +1,104 @@
+"""Property-based fuzz of the native hotwire codec (hypothesis).
+
+The hand-written corpus in test_native_codec.py covers known shapes;
+this drives randomized nested structures through serialize/deserialize
+(which dispatch to the C codec when built) and asserts exact roundtrip
+equality plus type fidelity — the contract every wire frame and durable
+blob depends on.
+"""
+
+import pytest
+
+try:
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+except ImportError:  # pragma: no cover — hypothesis is baked into this env
+    pytest.skip("hypothesis unavailable", allow_module_level=True)
+
+import orleans_tpu.core.serialization as ser
+from orleans_tpu.core.ids import GrainId, GrainType, SiloAddress
+
+pytestmark = pytest.mark.skipif(
+    ser._hotwire is None, reason="native toolchain unavailable")
+
+
+_GT = GrainType.of("fuzz.Grain")
+
+_scalars = st.one_of(
+    st.none(),
+    st.booleans(),
+    st.integers(min_value=-(2**63), max_value=2**63 - 1),
+    st.integers(min_value=-(2**200), max_value=2**200),  # bignum escape
+    st.floats(allow_nan=False),  # NaN != NaN breaks equality, not codec
+    st.text(max_size=60),
+    st.binary(max_size=60),
+    st.builds(lambda k: GrainId.for_grain(_GT, k),
+              st.integers(min_value=0, max_value=2**40)),
+    st.builds(SiloAddress,
+              st.text(min_size=1, max_size=20), st.integers(0, 65535),
+              st.integers(0, 2**40)),
+)
+
+_values = st.recursive(
+    _scalars,
+    lambda children: st.one_of(
+        st.lists(children, max_size=5),
+        st.lists(children, max_size=5).map(tuple),
+        st.dictionaries(st.text(max_size=8), children, max_size=4),
+        st.dictionaries(st.integers(), children, max_size=4),
+    ),
+    max_leaves=25,
+)
+
+
+def _assert_same(a, b):
+    """Recursive equality + type fidelity: Python's == treats True == 1
+    and 1.0 == 1, so a nested tag-confusion regression (bool decoded as
+    int) would pass a plain equality check."""
+    assert type(a) is type(b), (a, b)
+    if isinstance(a, (list, tuple)):
+        assert len(a) == len(b)
+        for x, y in zip(a, b):
+            _assert_same(x, y)
+    elif isinstance(a, dict):
+        assert set(a) == set(b)
+        for k in a:
+            _assert_same(a[k], b[k])
+    else:
+        assert a == b, (a, b)
+
+
+@settings(max_examples=300, deadline=None)
+@given(_values)
+def test_roundtrip_equality_and_type_fidelity(value):
+    blob = ser.serialize(value)
+    out = ser.deserialize(blob)
+    _assert_same(out, value)
+
+
+@pytest.mark.parametrize("edge", [
+    -(2**63), 2**63 - 1, -(2**63) - 1, 2**63,  # int64 boundaries + just past
+])
+def test_int64_boundaries(edge):
+    assert ser.deserialize(ser.serialize(edge)) == edge
+
+
+@settings(max_examples=300, deadline=None)
+@given(st.binary(min_size=0, max_size=200))
+def test_random_bytes_never_crash_the_decoder(data):
+    """Any buffer must either decode or raise a Python exception — never
+    crash the process (the codec's bounds-check contract)."""
+    try:
+        ser.deserialize(b"\xa7\x01" + data)
+    except Exception:  # noqa: BLE001 — any clean Python error is fine
+        pass
+
+
+@settings(max_examples=150, deadline=None)
+@given(_values, st.integers(min_value=2, max_value=40))
+def test_truncations_never_crash(value, cut):
+    blob = ser.serialize(value)
+    try:
+        ser.deserialize(blob[:max(2, len(blob) - cut)])
+    except Exception:  # noqa: BLE001
+        pass
