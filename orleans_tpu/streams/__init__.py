@@ -13,6 +13,7 @@ from .cache import PooledQueueCache, QueueCacheCursor
 from .core import (StreamId, StreamProvider, StreamRef,
                    SubscriptionHandle, batch_consumer)
 from .persistent import (
+    GeneratorQueueAdapter,
     MemoryQueueAdapter,
     PersistentStreamProvider,
     QueueAdapter,
@@ -28,6 +29,7 @@ __all__ = [
     "batch_consumer",
     "SMSStreamProvider", "add_sms_streams",
     "QueueAdapter", "QueueReceiver", "QueueBatch", "MemoryQueueAdapter",
+    "GeneratorQueueAdapter",
     "PersistentStreamProvider", "add_persistent_streams",
     "PubSubRendezvousGrain", "implicit_stream_subscription",
     "QueueBalancer", "DeploymentBasedBalancer", "BestFitBalancer",

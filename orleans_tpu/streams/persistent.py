@@ -20,6 +20,7 @@ from dataclasses import dataclass
 from typing import TYPE_CHECKING, Callable
 
 from ..core.asyncs import ExponentialBackoff, retry
+from ..core.errors import StreamError
 from .balancer import DeploymentBasedBalancer, QueueBalancer
 from .cache import PooledQueueCache
 from .core import StreamId, StreamProvider, SubscriptionHandle
@@ -32,6 +33,7 @@ log = logging.getLogger("orleans.streams.persistent")
 
 __all__ = [
     "QueueBatch", "QueueAdapter", "QueueReceiver", "MemoryQueueAdapter",
+    "GeneratorQueueAdapter",
     "PersistentStreamProvider", "PullingManager", "PullingAgent",
     "add_persistent_streams",
 ]
@@ -125,6 +127,62 @@ class _MemoryReceiver(QueueReceiver):
         for batch in reversed(self._inflight):
             self._queue.appendleft(batch)
         self._inflight.clear()
+
+
+class GeneratorQueueAdapter(QueueAdapter):
+    """Self-generating adapter — the reference's Generator stream provider
+    (OrleansProviders/Streams/Generator/GeneratorAdapter.cs: streams
+    synthesized inside the receiver, no external queue), used for load
+    and failure-injection testing of the pulling machinery.
+
+    ``generate(queue_id, poll_index)`` returns ``(StreamId, items)`` for
+    the next batch, or ``None`` when that queue is exhausted. Sequence
+    tokens are item-cumulative per queue and namespaced by a per-queue
+    stride, so tokens from different queues can never collide (a
+    generator that emits one StreamId from several queues still gets
+    distinct tokens; keep a stream on one queue if rewind offsets should
+    be contiguous). A regenerated receiver (queue-ownership handoff)
+    restarts its sequence — deterministic regeneration is the adapter's
+    purpose, matching the reference's Generator provider. Producing into
+    this adapter is an error — the generator is the only source."""
+
+    def __init__(self, generate, n_queues: int = 4, name: str = "generator"):
+        self.name = name
+        self.n_queues = n_queues
+        self._generate = generate
+
+    async def queue_message_batch(self, queue_id, stream, items) -> None:
+        raise StreamError(
+            "GeneratorQueueAdapter synthesizes its own batches; "
+            "on_next/on_next_batch cannot produce into it")
+
+    def create_receiver(self, queue_id: int) -> "QueueReceiver":
+        return _GeneratorReceiver(self._generate, queue_id)
+
+
+_GENERATOR_TOKEN_STRIDE = 1 << 32
+
+
+class _GeneratorReceiver(QueueReceiver):
+    def __init__(self, generate, queue_id: int):
+        self._generate = generate
+        self._queue_id = queue_id
+        self._poll = 0
+        self._seq = queue_id * _GENERATOR_TOKEN_STRIDE
+        self._done = False
+
+    async def get_messages(self, max_count: int) -> list[QueueBatch]:
+        out: list[QueueBatch] = []
+        while not self._done and len(out) < max_count:
+            produced = self._generate(self._queue_id, self._poll)
+            self._poll += 1
+            if produced is None:
+                self._done = True
+                break
+            stream, items = produced
+            out.append(QueueBatch(stream, list(items), self._seq))
+            self._seq += len(items)
+        return out
 
 
 class _ConsumerPump:
@@ -389,8 +447,10 @@ class PersistentStreamProvider(StreamProvider):
 
     async def produce(self, stream: StreamId, items: list) -> None:
         queue_id = stream.uniform_hash % self.adapter.n_queues
-        self.silo.stats.increment("streams.persistent.produced", len(items))
         await self.adapter.queue_message_batch(queue_id, stream, items)
+        # count AFTER the adapter accepts: a rejecting adapter (e.g. the
+        # generator provider) must not inflate the produced counter
+        self.silo.stats.increment("streams.persistent.produced", len(items))
 
     async def register_consumer(self, handle: SubscriptionHandle) -> None:
         await self._rendezvous(handle.stream).register_consumer(handle)

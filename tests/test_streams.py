@@ -369,3 +369,57 @@ async def test_sms_rejects_rewind():
     finally:
         await client.close_async()
         await silo.stop()
+
+
+async def test_generator_adapter_synthesizes_streams():
+    """GeneratorQueueAdapter (the reference's Generator stream provider):
+    batches come from the generator function, not from producers — the
+    pulling agents, pub-sub, and delivery machinery run unchanged."""
+    from orleans_tpu.core.errors import StreamError
+    from orleans_tpu.streams import (GeneratorQueueAdapter,
+                                     add_persistent_streams)
+    from orleans_tpu.streams.core import StreamId
+
+    def generate(queue_id, poll):
+        if poll >= 3:
+            return None  # 3 batches per queue, then dry
+        sid = StreamId("gen", "load", f"q{queue_id}")
+        return sid, [f"q{queue_id}-b{poll}-i{j}" for j in range(4)]
+
+    got = {}
+
+    class Sink(Grain):
+        async def join(self, key):
+            stream = self.get_stream_provider("gen").get_stream("load", key)
+            await stream.subscribe(self.on_event)
+
+        async def on_event(self, item, token):
+            got.setdefault(self.primary_key, []).append(item)
+
+    adapter = GeneratorQueueAdapter(generate, n_queues=2)
+    b = SiloBuilder().with_name("gen").add_grains(Sink)
+    add_persistent_streams(b, "gen", adapter, pull_period=0.01)
+    silo = b.build()
+    await silo.start()
+    client = await ClusterClient(silo.fabric).connect()
+    try:
+        await client.get_grain(Sink, "q0").join("q0")
+        await client.get_grain(Sink, "q1").join("q1")
+        for _ in range(300):
+            if sum(len(v) for v in got.values()) >= 24:
+                break
+            await asyncio.sleep(0.02)
+        assert got.get("q0") == [f"q0-b{p}-i{j}"
+                                 for p in range(3) for j in range(4)]
+        assert got.get("q1") == [f"q1-b{p}-i{j}"
+                                 for p in range(3) for j in range(4)]
+        # producing into a generator adapter is rejected
+        stream = silo.stream_providers["gen"].get_stream("load", "q0")
+        try:
+            await stream.on_next("x")
+            raise AssertionError("expected StreamError")
+        except StreamError:
+            pass
+    finally:
+        await client.close_async()
+        await silo.stop()
