@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import bisect
 from dataclasses import dataclass
-from typing import Callable, Iterable
+from typing import Iterable
 
 from ..core.ids import SiloAddress, stable_hash64
 

@@ -23,7 +23,6 @@ Two key regimes:
 
 from __future__ import annotations
 
-from typing import Any
 
 import jax
 import jax.numpy as jnp

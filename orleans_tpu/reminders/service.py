@@ -24,7 +24,7 @@ from dataclasses import dataclass
 from typing import TYPE_CHECKING
 
 from ..core.errors import ReminderError
-from ..core.ids import GrainId, SiloAddress, type_code_of
+from ..core.ids import GrainId, type_code_of
 from ..core.message import Category
 from ..directory.ring import VirtualBucketRing
 from .table import ReminderEntry, ReminderTable

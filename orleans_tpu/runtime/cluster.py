@@ -13,7 +13,6 @@ alive silos; responses route back via the client's pseudo silo address.
 
 from __future__ import annotations
 
-import asyncio
 import itertools
 import logging
 from typing import Any

@@ -28,7 +28,7 @@ from dataclasses import dataclass, field
 from typing import Any
 
 from ..core.ids import GrainId, GrainType, SiloAddress
-from ..core.message import Direction, Message
+from ..core.message import Message
 
 log = logging.getLogger("orleans.observers")
 

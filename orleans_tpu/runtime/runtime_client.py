@@ -12,7 +12,7 @@ import asyncio
 import random
 import logging
 import time
-from typing import TYPE_CHECKING, Any
+from typing import TYPE_CHECKING
 
 from ..core.errors import (
     GrainCallTimeoutError,

@@ -18,10 +18,10 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import TYPE_CHECKING, Any, Callable
 
-from ..core.ids import ActivationAddress, GrainId, SiloAddress
+from ..core.ids import GrainId, SiloAddress
 from ..core.message import Category, Direction, Message
 from ..core.serialization import copy_call_body, copy_result
 from ..observability.stats import StatsRegistry
