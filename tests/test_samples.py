@@ -99,3 +99,10 @@ async def test_chirper_fan_out_and_graph_updates():
         assert delivered == 19
         assert len(await followers[0].timeline()) == 1  # no new delivery
         assert len(await followers[1].timeline()) == 2
+
+
+async def test_bank_sample_end_to_end():
+    """samples/bank.py: atomic audited transfers, over-draw rollback,
+    cancellable sweep, batch audit ledger — run the sample's own main."""
+    import bank
+    await bank.main()
