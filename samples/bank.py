@@ -52,8 +52,11 @@ class Teller(TransactionalGrain):
 
     @transactional
     async def transfer(self, src: int, dst: int, amount: int) -> None:
-        await self.get_grain(Account, src).withdraw(amount)
+        # deposit first ON PURPOSE: an over-draw then aborts a transaction
+        # that already staged a write, so the rollback demo below is
+        # load-bearing (withdraw-first would fail before staging anything)
         await self.get_grain(Account, dst).deposit(amount)
+        await self.get_grain(Account, src).withdraw(amount)
 
     async def transfer_audited(self, src: int, dst: int, amount: int) -> None:
         await self.transfer(src, dst, amount)
@@ -120,7 +123,8 @@ async def main() -> None:
     print(f"balances after 20 transfers: {balances} "
           f"(conserved: {sum(balances)})")
 
-    # an over-draw aborts atomically: neither leg applies
+    # an over-draw aborts atomically: the already-STAGED deposit on
+    # account 1 must be discarded by the 2PC abort, not applied
     rich_before = await client.get_grain(Account, 1).get_balance()
     try:
         await teller.transfer(3, 1, 10**9)

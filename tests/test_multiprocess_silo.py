@@ -1,0 +1,149 @@
+"""A real multi-OS-process cluster: a silo in a child process, joined via
+the shared file membership table, talking TCP to a silo in this process.
+
+Everything else in the suite exercises the cross-process CODE PATHS
+(separate fabrics, real sockets) within one interpreter; this proves the
+actual process boundary: separate GILs, separate interners, wire frames
+decoded by a process that never saw the sender's objects, and real
+SIGKILL death detected by probes.
+"""
+
+import asyncio
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from orleans_tpu.membership import FileMembershipTable, join_cluster
+from orleans_tpu.runtime import Grain, SiloBuilder
+from orleans_tpu.runtime.socket_fabric import GatewayClient, SocketFabric
+
+pytestmark = pytest.mark.skipif(sys.platform == "win32", reason="posix only")
+
+
+class EchoGrain(Grain):
+    async def echo(self, x):
+        return f"{self.primary_key}:{x}"
+
+    async def where(self) -> str:
+        return self._activation.runtime.silo_address.endpoint
+
+
+# one source of truth for liveness tuning — asymmetric probe timings
+# between the two processes would make kill detection flaky
+LIVENESS = dict(membership_probe_period=0.25,
+                membership_probe_timeout=1.0,
+                membership_missed_probes_limit=2,
+                membership_votes_needed=1,
+                membership_iam_alive_period=0.5,
+                membership_refresh_period=0.2)
+
+
+CHILD = textwrap.dedent("""
+    import asyncio, sys
+    sys.path.insert(0, {repo!r})
+    from orleans_tpu.membership import FileMembershipTable, join_cluster
+    from orleans_tpu.runtime import Grain, SiloBuilder
+    from orleans_tpu.runtime.socket_fabric import SocketFabric
+
+    class EchoGrain(Grain):
+        async def echo(self, x):
+            return f"{{self.primary_key}}:{{x}}"
+
+        async def where(self) -> str:
+            return self._activation.runtime.silo_address.endpoint
+
+    async def main():
+        table = FileMembershipTable({table!r})
+        silo = (SiloBuilder().with_name("child").with_fabric(SocketFabric())
+                .add_grains(EchoGrain)
+                .with_config(**{cfg!r})).build()
+        join_cluster(silo, table)
+        await silo.start()
+        print("CHILD-READY", silo.silo_address.endpoint, flush=True)
+        await asyncio.sleep(3600)
+
+    asyncio.run(main())
+""")
+
+
+async def test_cross_os_process_cluster_and_kill(tmp_path):
+    table_path = str(tmp_path / "mbr.json")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    child = subprocess.Popen(
+        [sys.executable, "-c",
+         CHILD.format(repo=repo, table=table_path, cfg=LIVENESS)],
+        stdout=subprocess.PIPE, stderr=open(tmp_path / "child.err", "w"),
+        text=True, env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    silo = None
+    client = None
+    try:
+        # wait for the child silo to come up
+        loop = asyncio.get_running_loop()
+        line = await asyncio.wait_for(
+            loop.run_in_executor(None, child.stdout.readline), timeout=60)
+        assert line.startswith("CHILD-READY"), (
+            line, (tmp_path / "child.err").read_text()[-2000:])
+
+        table = FileMembershipTable(table_path)
+        silo = (SiloBuilder().with_name("parent").with_fabric(SocketFabric())
+                .add_grains(EchoGrain)
+                .with_config(**LIVENESS)).build()
+        join_cluster(silo, table)
+        await silo.start()
+
+        async def converged(n):
+            while len(silo.membership.active) != n:
+                await asyncio.sleep(0.05)
+        await asyncio.wait_for(converged(2), timeout=15)
+
+        client = await GatewayClient(
+            [silo.silo_address.endpoint], response_timeout=10.0).connect()
+
+        # touch many grains; placement must land some IN THE CHILD PROCESS
+        wheres = await asyncio.gather(
+            *(client.get_grain(EchoGrain, k).where() for k in range(32)))
+        endpoints = set(wheres)
+        assert len(endpoints) == 2, f"all activations in one process: {endpoints}"
+        child_ep = next(e for e in endpoints
+                        if e != silo.silo_address.endpoint)
+
+        # calls to child-hosted grains cross the OS-process boundary
+        child_keys = [k for k, w in enumerate(wheres) if w == child_ep]
+        outs = await asyncio.gather(
+            *(client.get_grain(EchoGrain, k).echo("hi") for k in child_keys))
+        assert outs == [f"{k}:hi" for k in child_keys]
+
+        # SIGKILL the child: probes must declare it dead, and its grains
+        # must re-place onto the survivor and answer again
+        child.send_signal(signal.SIGKILL)
+        child.wait(timeout=10)
+
+        async def declared_dead():
+            while not silo.membership.dead:
+                await asyncio.sleep(0.1)
+        await asyncio.wait_for(declared_dead(), timeout=20)
+
+        k = child_keys[0]
+        out = await asyncio.wait_for(
+            client.get_grain(EchoGrain, k).echo("back"), timeout=15)
+        assert out == f"{k}:back"
+        assert (await client.get_grain(EchoGrain, k).where()) == \
+            silo.silo_address.endpoint
+    finally:
+        # reap the child FIRST: a hanging client/silo teardown must not
+        # leak a process holding the port + membership file
+        try:
+            if child.poll() is None:
+                child.kill()
+            child.wait(timeout=10)
+        finally:
+            try:
+                if client is not None:
+                    await client.close_async()
+            finally:
+                if silo is not None:
+                    await silo.stop()
