@@ -309,14 +309,19 @@ class InsideRuntimeClient(RuntimeClient):
         message unconditionally, so queue semantics carry nothing — only
         the invoke remains, minus per-message machinery. Copy isolation
         is preserved (args/result copied exactly as the messaging path
-        does); incoming call filters and per-call timeout are
-        intentionally skipped (the turn-length watchdog still observes
-        via the running marker). The call IS visible to activation
+        does); the per-call timeout is intentionally skipped (the
+        turn-length watchdog still observes via the running marker).
+        Call filters are NOT skipped: when any filter would run on the
+        messaging path — outgoing filters, silo incoming filters, or a
+        grain-level ``on_incoming_call`` hook — this path declines and
+        the call takes the messaging path, so filtered deployments see
+        identical interception regardless of placement (mirrors the
+        gating in dispatcher._invoke). The call IS visible to activation
         bookkeeping: a running marker keeps deactivation/idle-collection
         from tearing the activation down mid-call, and nested sends from
         inside the callee carry the caller's extended call chain and
         attribute to the callee activation."""
-        if self.outgoing_call_filters:
+        if self.outgoing_call_filters or self.silo.incoming_call_filters:
             return None
         acts = self.silo.catalog.by_grain.get(grain_id)
         if not acts or len(acts) != 1:
@@ -324,6 +329,8 @@ class InsideRuntimeClient(RuntimeClient):
         act = acts[0]
         from .activation import ActivationState
         if act.state != ActivationState.VALID:
+            return None
+        if getattr(act.grain_instance, "on_incoming_call", None) is not None:
             return None
         fn = getattr(act.grain_instance, method_name, None)
         if fn is None:

@@ -84,16 +84,39 @@ def _drain_batch(queue: "asyncio.Queue[Message]", first: Message) -> list:
     return batch
 
 
-def _encode_batch(batch: list, bounce) -> list:
+def _encode_batch(batch: list, bounce, native: bool = True) -> list:
     """Encode each message, routing per-message failures to ``bounce``
-    (encode errors are scoped to one message, never the connection)."""
+    (encode errors are scoped to one message, never the connection).
+    ``native`` is the negotiated per-connection codec level."""
     chunks = []
     for m in batch:
         try:
-            chunks.append(encode_message(m))
+            chunks.append(encode_message(m, native=native))
         except Exception as e:  # noqa: BLE001 — per-message, not the link
             bounce(m, e)
     return chunks
+
+
+# a peer that accepts TCP but never sends its handshake reply is wedged:
+# bound the negotiation read so the dial fails into the retry/backoff path
+_NEGOTIATE_TIMEOUT = 5.0
+
+
+async def _read_peer_codec(reader: asyncio.StreamReader) -> bool:
+    """Read the acceptor's handshake reply; True iff the peer advertises
+    hotwire decode support. Garbled, undecodable, or truncated replies fall
+    back to the universally-decodable pickle form (never fail the dial over
+    negotiation); an unresponsive peer raises TimeoutError — an OSError —
+    into the caller's dial-retry path."""
+    try:
+        headers, _ = await asyncio.wait_for(
+            read_frame(reader), _NEGOTIATE_TIMEOUT)
+    except (FrameError, asyncio.IncompleteReadError):
+        return False
+    try:
+        return bool(decode_handshake(headers).get("hotwire", False))
+    except Exception:  # noqa: BLE001 — any undecodable reply → pickle
+        return False
 
 
 def _fresh_generation() -> int:
@@ -115,15 +138,21 @@ class _Sender:
         self.queue: asyncio.Queue[Message] = asyncio.Queue()
         self.task = asyncio.get_running_loop().create_task(self._run())
         self.writer: asyncio.StreamWriter | None = None
+        # negotiated per-link codec: True only once the acceptor's
+        # handshake reply advertises hotwire support
+        self.peer_native = False
 
     async def _connect(self) -> asyncio.StreamWriter:
         host, port = self.endpoint.rsplit(":", 1)
 
         async def dial() -> asyncio.StreamWriter:
-            _, writer = await asyncio.open_connection(host, int(port))
+            reader, writer = await asyncio.open_connection(host, int(port))
             writer.write(encode_handshake(
                 "silo", self.fabric.local_address()))
             await writer.drain()
+            # codec negotiation: the acceptor replies with its own
+            # handshake; encode at the peer's level from here on
+            self.peer_native = await _read_peer_codec(reader)
             return writer
 
         try:
@@ -143,12 +172,14 @@ class _Sender:
             batch = _drain_batch(self.queue, msg)
             if self.fabric.is_endpoint_dead(self.endpoint):
                 continue  # dead-silo drop (MessageCenter SiloDeadOracle)
-            chunks = _encode_batch(batch, self.fabric.bounce_unencodable)
-            if not chunks:
-                continue
             try:
                 if self.writer is None or self.writer.is_closing():
                     self.writer = await self._connect()
+                # encode AFTER the (re)connect: peer_native is per-link
+                chunks = _encode_batch(batch, self.fabric.bounce_unencodable,
+                                       native=self.peer_native)
+                if not chunks:
+                    continue
                 self.writer.write(b"".join(chunks))
                 await self.writer.drain()
             except (SiloUnavailableError, OSError, FrameError) as e:
@@ -180,6 +211,8 @@ class SocketFabric:
         self._senders: dict[str, _Sender] = {}
         # client pseudo-address -> writer for clients connected to our gateway
         self.client_routes: dict[SiloAddress, asyncio.StreamWriter] = {}
+        # negotiated codec per client route (handshake-advertised)
+        self._client_native: dict[SiloAddress, bool] = {}
         # which local silo's gateway each client route belongs to
         self._route_owner: dict[SiloAddress, SiloAddress] = {}
         self._conn_tasks: set[asyncio.Task] = set()
@@ -240,6 +273,7 @@ class SocketFabric:
         for caddr, owner in list(self._route_owner.items()):
             if owner == addr:
                 self._route_owner.pop(caddr, None)
+                self._client_native.pop(caddr, None)
                 w = self.client_routes.pop(caddr, None)
                 if w is not None:
                     w.close()
@@ -319,8 +353,9 @@ class SocketFabric:
 
     def _write_to_client(self, addr: SiloAddress,
                          writer: asyncio.StreamWriter, msg: Message) -> None:
+        native = self._client_native.get(addr, False)
         try:
-            data = encode_message(msg)
+            data = encode_message(msg, native=native)
         except Exception as e:  # noqa: BLE001 — encode failure: the route is
             # healthy, only this payload is bad. Fail the call promptly
             # instead of letting the client time out.
@@ -335,7 +370,7 @@ class SocketFabric:
                     f"response to {msg.interface_name}.{msg.method_name} "
                     f"could not cross the wire: {e}")
                 try:
-                    writer.write(encode_message(fallback))
+                    writer.write(encode_message(fallback, native=native))
                 except Exception:  # noqa: BLE001
                     log.exception("error-response fallback failed")
             return
@@ -345,6 +380,7 @@ class SocketFabric:
             log.info("dropping message to disconnected client %s", addr)
             self.client_routes.pop(addr, None)
             self._route_owner.pop(addr, None)
+            self._client_native.pop(addr, None)
 
     # -- inbound connections ----------------------------------------------
     async def _handle_conn(self, silo: "Silo", reader: asyncio.StreamReader,
@@ -356,11 +392,18 @@ class SocketFabric:
             hs = decode_handshake(headers)
             peer_addr = hs["address"]
             is_client = hs["kind"] == "client"
+            # codec negotiation: reply with OUR handshake so the dialer
+            # learns whether this process can decode hotwire frames; from
+            # here on each side encodes at the peer's advertised level
+            writer.write(encode_handshake("silo", silo.silo_address))
+            await writer.drain()
             if is_client:
                 # Gateway: record the client route (ClientObserverRegistrar
                 # records gateway routes; here route == live connection)
                 self.client_routes[peer_addr] = writer
                 self._route_owner[peer_addr] = silo.silo_address
+                self._client_native[peer_addr] = bool(
+                    hs.get("hotwire", False))
             async for headers, body in frame_stream(reader):
                 try:
                     msg = decode_message(headers, body)
@@ -388,6 +431,7 @@ class SocketFabric:
                     self.client_routes.get(peer_addr) is writer:
                 self.client_routes.pop(peer_addr, None)
                 self._route_owner.pop(peer_addr, None)
+                self._client_native.pop(peer_addr, None)
             writer.close()
 
     def _route_inbound(self, silo: "Silo", msg: Message) -> None:
@@ -466,12 +510,15 @@ class _GatewayConnection:
         self.queue: asyncio.Queue[Message] = asyncio.Queue()
         self.sender_task: asyncio.Task | None = None
         self.live = False
+        self.peer_native = False  # negotiated from the gateway's reply
 
     async def connect(self) -> None:
         host, port = self.endpoint.rsplit(":", 1)
         reader, writer = await asyncio.open_connection(host, int(port))
         writer.write(encode_handshake("client", self.pseudo_address))
         await writer.drain()
+        # codec negotiation: the gateway replies with its own handshake
+        self.peer_native = await _read_peer_codec(reader)
         self.writer = writer
         self.live = True
         loop = asyncio.get_running_loop()
@@ -518,7 +565,8 @@ class _GatewayConnection:
         while True:
             msg = await self.queue.get()
             batch = _drain_batch(self.queue, msg)
-            chunks = _encode_batch(batch, self._bounce_unencodable)
+            chunks = _encode_batch(batch, self._bounce_unencodable,
+                                   native=self.peer_native)
             if not chunks:
                 continue
             try:

@@ -30,7 +30,7 @@ from typing import Any
 
 from ..core.ids import SiloAddress
 from ..core.message import Message
-from ..core.serialization import deserialize, serialize
+from ..core.serialization import deserialize, serialize, serialize_portable
 
 __all__ = [
     "MAX_FRAME_SEGMENT", "FrameError", "WireDecodeError",
@@ -139,12 +139,17 @@ _ENUM_SPEC = (
 )
 
 
-def encode_message(msg: Message) -> bytes:
+def encode_message(msg: Message, native: bool = True) -> bytes:
+    """Encode one message frame. ``native=False`` forces the pickle wire
+    form — used per-connection when the peer's handshake did not advertise
+    hotwire support (mixed-build cluster: a silo whose native build failed
+    must still receive decodable frames; SerializationManager.cs:173-201
+    negotiates serializers per registered type, we negotiate per link)."""
     ttl = None
     if msg.expires_at is not None:
         ttl = max(0.0, msg.expires_at - time.monotonic())
     headers = None
-    hw = _ser._hotwire
+    hw = _ser._hotwire if native else None
     if hw is not None:
         try:
             # single C call: getattr walk + enum coercion + encode
@@ -156,8 +161,9 @@ def encode_message(msg: Message) -> bytes:
         for i, _members in _ENUM_SPEC:
             if fields[i] is not None:
                 fields[i] = int(fields[i])
-        headers = serialize((tuple(fields), ttl))
-    body = serialize(msg.body)
+        headers = serialize((tuple(fields), ttl)) if native \
+            else serialize_portable((tuple(fields), ttl))
+    body = serialize(msg.body) if native else serialize_portable(msg.body)
     return encode_frame(headers, body)
 
 
@@ -211,8 +217,16 @@ class _BodyDecodeError(WireDecodeError):
 
 def encode_handshake(kind: str, address: SiloAddress,
                      extra: dict[str, Any] | None = None) -> bytes:
-    return encode_frame(
-        serialize({"kind": kind, "address": address, **(extra or {})}), b"")
+    """Handshake frames are ALWAYS pickle-encoded: the handshake is where
+    codec support is negotiated, so it must be decodable by every build —
+    a hotwire-encoded handshake would be unreadable to exactly the peers
+    the negotiation exists for. Advertises this process's codec support
+    (``hotwire``); each side then encodes per-connection at the peer's
+    level (the connection-preamble negotiation the reference does for
+    serializer registration, SerializationManager.cs:173-201)."""
+    payload = {"kind": kind, "address": address,
+               "hotwire": _ser._hotwire is not None, **(extra or {})}
+    return encode_frame(serialize_portable(payload), b"")
 
 
 def decode_handshake(headers: bytes) -> dict[str, Any]:

@@ -267,6 +267,74 @@ async def test_system_traffic_bypasses_filters():
         await cluster.stop_all()
 
 
+async def test_direct_interleave_path_still_runs_incoming_filters():
+    """Always-interleave calls to a co-located activation take the direct
+    fast path (InsideRuntimeClient.try_direct_interleave) — which must
+    decline whenever incoming filters are registered, so interception is
+    identical regardless of grain placement."""
+    from orleans_tpu.runtime.grain import always_interleave
+
+    seen = []
+
+    async def audit(ctx):
+        seen.append(ctx.method_name)
+        await ctx.invoke()
+
+    class Inter(Grain):
+        @always_interleave
+        async def fast(self, x: int) -> int:
+            return x + 1
+
+    class Caller(Grain):
+        async def relay(self, x: int) -> int:
+            return await self.get_grain(Inter, 7).fast(x)
+
+    silo, client = await _cluster(
+        SiloBuilder().add_grains(Inter, Caller)
+        .add_incoming_call_filter(audit))
+    try:
+        # warm the target activation so the direct path is eligible
+        assert await client.get_grain(Caller, 1).relay(1) == 2
+        seen.clear()
+        assert await client.get_grain(Caller, 1).relay(10) == 11
+        assert "fast" in seen  # the co-located interleave leg was filtered
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_direct_interleave_path_still_runs_grain_level_filter():
+    """A grain that implements on_incoming_call keeps its gate even for
+    co-located always-interleave callers (direct path must decline)."""
+    from orleans_tpu.runtime.grain import always_interleave
+
+    class GatedInter(Grain):
+        async def on_incoming_call(self, ctx):
+            if ctx.kwargs.pop("secret", None) == "ok":
+                await ctx.invoke()
+            else:
+                ctx.result = "denied"
+
+        @always_interleave
+        async def fast(self, **kwargs) -> str:
+            return "granted"
+
+    class Caller2(Grain):
+        async def relay(self, **kwargs) -> str:
+            return await self.get_grain(GatedInter, 7).fast(**kwargs)
+
+    silo, client = await _cluster(
+        SiloBuilder().add_grains(GatedInter, Caller2))
+    try:
+        g = client.get_grain(Caller2, 1)
+        assert await g.relay(secret="ok") == "granted"
+        assert await g.relay(secret="nope") == "denied"
+        assert await g.relay() == "denied"
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
 async def test_silo_outgoing_filter_wraps_grain_to_grain_calls():
     order = []
 

@@ -70,6 +70,77 @@ CHILD = textwrap.dedent("""
 """)
 
 
+async def test_mixed_build_cluster_negotiates_codec(tmp_path):
+    """A silo whose native hotwire build is unavailable must interoperate
+    with native-enabled peers: the handshake advertises codec support and
+    each link falls back to pickle toward a pickle-only peer. Without the
+    negotiation, every parent→child frame is 0xA7-hotwire and the child
+    drops it (calls time out)."""
+    from orleans_tpu.core import serialization as ser
+    if ser._hotwire is None:
+        pytest.skip("native codec unavailable in this build")
+
+    table_path = str(tmp_path / "mbr.json")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    child = subprocess.Popen(
+        [sys.executable, "-c",
+         CHILD.format(repo=repo, table=table_path, cfg=LIVENESS)],
+        stdout=subprocess.PIPE, stderr=open(tmp_path / "child.err", "w"),
+        text=True, env={**os.environ, "JAX_PLATFORMS": "cpu",
+                        "ORLEANS_TPU_NATIVE": "0"})
+    silo = None
+    client = None
+    try:
+        loop = asyncio.get_running_loop()
+        line = await asyncio.wait_for(
+            loop.run_in_executor(None, child.stdout.readline), timeout=60)
+        assert line.startswith("CHILD-READY"), (
+            line, (tmp_path / "child.err").read_text()[-2000:])
+
+        table = FileMembershipTable(table_path)
+        silo = (SiloBuilder().with_name("parent").with_fabric(SocketFabric())
+                .add_grains(EchoGrain)
+                .with_config(**LIVENESS)).build()
+        join_cluster(silo, table)
+        await silo.start()
+
+        async def converged(n):
+            while len(silo.membership.active) != n:
+                await asyncio.sleep(0.05)
+        await asyncio.wait_for(converged(2), timeout=15)
+
+        client = await GatewayClient(
+            [silo.silo_address.endpoint], response_timeout=10.0).connect()
+
+        wheres = await asyncio.gather(
+            *(client.get_grain(EchoGrain, k).where() for k in range(32)))
+        endpoints = set(wheres)
+        assert len(endpoints) == 2, f"all activations in one process: {endpoints}"
+        child_ep = next(e for e in endpoints
+                        if e != silo.silo_address.endpoint)
+
+        # round-trips through the pickle-only child prove both directions
+        # negotiated down (a hotwire frame would be undecodable there)
+        child_keys = [k for k, w in enumerate(wheres) if w == child_ep]
+        assert child_keys
+        outs = await asyncio.gather(
+            *(client.get_grain(EchoGrain, k).echo("mixed")
+              for k in child_keys))
+        assert outs == [f"{k}:mixed" for k in child_keys]
+    finally:
+        try:
+            if child.poll() is None:
+                child.kill()
+            child.wait(timeout=10)
+        finally:
+            try:
+                if client is not None:
+                    await client.close_async()
+            finally:
+                if silo is not None:
+                    await silo.stop()
+
+
 async def test_cross_os_process_cluster_and_kill(tmp_path):
     table_path = str(tmp_path / "mbr.json")
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

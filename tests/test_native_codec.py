@@ -158,6 +158,34 @@ def test_nesting_depth_capped():
         hw.loads(bad)
 
 
+def test_handshake_is_always_pickle_and_advertises_codec():
+    """The handshake is the negotiation vehicle, so it must be decodable
+    by every build regardless of the local codec — and it must carry the
+    hotwire capability flag."""
+    from orleans_tpu.runtime.wire import decode_handshake, encode_handshake
+    frame = encode_handshake("silo", SILO)
+    hlen = int.from_bytes(frame[:4], "little")
+    headers = frame[8:8 + hlen]
+    assert headers[:1] != b"\xa7"  # never hotwire-encoded
+    hs = decode_handshake(headers)
+    assert hs["address"] == SILO
+    assert hs["hotwire"] == (ser._hotwire is not None)
+
+
+def test_encode_message_native_false_emits_pickle_frames():
+    """Per-connection fallback: native=False must produce frames a
+    pickle-only peer can decode, even when this build has hotwire."""
+    msg = make_request(
+        target_grain=GID, interface_name="n.I", method_name="m",
+        body={"k": 1}, sending_silo=SILO, target_silo=SILO)
+    frame = encode_message(msg, native=False)
+    hlen = int.from_bytes(frame[:4], "little")
+    headers, body = frame[8:8 + hlen], frame[8 + hlen:]
+    assert headers[:1] != b"\xa7" and body[:1] != b"\xa7"
+    out = decode_message(headers, body)
+    assert out.method_name == "m" and out.body == {"k": 1}
+
+
 def test_wire_message_roundtrip_native_and_fallback(monkeypatch):
     msg = make_request(
         target_grain=GID, interface_name="native.IEcho", method_name="echo",
