@@ -523,10 +523,19 @@ static PyObject *dec_value(R *r, int depth) {
         if (!inst) { Py_DECREF(key); Py_DECREF(ext); return NULL; }
         PyObject *catm = PyTuple_GET_ITEM(g_state.grain_cat_members, cat);
         Py_INCREF(catm);
+        /* set_field steals its value, so a short-circuited chain would
+         * leak the owned objects it never reached — consume them
+         * explicitly on each early-failure branch */
         if (set_field(inst, g_state.s_category, catm) < 0 ||
-            set_i64_field(inst, g_state.s_type_code, tc) < 0 ||
-            set_field(inst, g_state.s_key, key) < 0 ||
-            set_field(inst, g_state.s_key_ext, ext) < 0 ||
+            set_i64_field(inst, g_state.s_type_code, tc) < 0) {
+            Py_DECREF(key); Py_DECREF(ext); Py_DECREF(inst);
+            return NULL;
+        }
+        if (set_field(inst, g_state.s_key, key) < 0) {
+            Py_DECREF(ext); Py_DECREF(inst);
+            return NULL;
+        }
+        if (set_field(inst, g_state.s_key_ext, ext) < 0 ||
             set_i64_field(inst, g_state.s_hash64, h64) < 0) {
             Py_DECREF(inst);
             return NULL;
@@ -577,9 +586,16 @@ static PyObject *dec_value(R *r, int depth) {
         if (!act) { Py_DECREF(silo); Py_DECREF(grain); return NULL; }
         PyObject *inst = blank_instance(g_state.act_addr_cls);
         if (!inst) { Py_DECREF(silo); Py_DECREF(grain); Py_DECREF(act); return NULL; }
-        if (set_field(inst, g_state.s_silo, silo) < 0 ||
-            set_field(inst, g_state.s_grain, grain) < 0 ||
-            set_field(inst, g_state.s_activation, act) < 0) {
+        /* consume not-yet-stolen values on early failure (see T_GRAIN_ID) */
+        if (set_field(inst, g_state.s_silo, silo) < 0) {
+            Py_DECREF(grain); Py_DECREF(act); Py_DECREF(inst);
+            return NULL;
+        }
+        if (set_field(inst, g_state.s_grain, grain) < 0) {
+            Py_DECREF(act); Py_DECREF(inst);
+            return NULL;
+        }
+        if (set_field(inst, g_state.s_activation, act) < 0) {
             Py_DECREF(inst);
             return NULL;
         }
@@ -803,6 +819,16 @@ static PyObject *hw_unpack_attrs(PyObject *self, PyObject *args) {
             PyObject *m = PyTuple_GET_ITEM(members, ev);
             Py_INCREF(m);
             Py_SETREF(vals[idx], m);
+        } else if (v != Py_None) {
+            /* enum-typed header fields are None or int on the wire; any
+             * other decoded object (str, tuple, ...) from a corrupt or
+             * hostile peer must be rejected, matching the Python
+             * fallback's strictness */
+            Py_CLEAR(extra);
+            PyErr_Format(PyExc_ValueError,
+                         "hotwire: non-int enum value of type %.100s at "
+                         "field %zd", Py_TYPE(v)->tp_name, idx);
+            goto done;
         }
     }
     for (Py_ssize_t i = 0; i < n; i++) {

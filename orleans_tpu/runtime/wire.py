@@ -181,9 +181,10 @@ def decode_message(headers: bytes, body: bytes) -> Message:
                 v = fields[i]
                 if v is not None:
                     # range-check before indexing: a negative value must be
-                    # rejected, not wrap to the last member (matches the C
-                    # decoder's ev < 0 guard)
-                    m = members[v] if isinstance(v, int) and \
+                    # rejected, not wrap to the last member, and bool is
+                    # not an enum value (matches the C decoder's ev < 0
+                    # guard and its exact-int check)
+                    m = members[v] if type(v) is int and \
                         0 <= v < len(members) else None
                     if m is None:
                         raise ValueError(

@@ -502,6 +502,16 @@ def transactional(fn=None, *, option: str = "required"):
         # through turn queues where wound-wait cannot see or break them.
         # Isolation is the transactional states' job (workspace exclusivity
         # + read-version validation), not the turn gate's.
+        #
+        # SEMANTIC CAVEAT (divergence from the reference, which marks only
+        # the 2PC participant-extension methods [AlwaysInterleave]): plain
+        # instance attributes touched inside a @transactional method are
+        # NOT turn-protected — two transactions on the same activation can
+        # interleave at any await, so read-modify-write of ordinary fields
+        # can race. Keep all transactional data in TransactionalState
+        # facets (which serialize through the wound-wait lock); plain
+        # fields inside transactional methods are safe only for
+        # idempotent/monotonic writes. Documented in MIGRATION.md.
         wrapper.__orleans_always_interleave__ = True
         return wrapper
 

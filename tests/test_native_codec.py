@@ -158,6 +158,22 @@ def test_nesting_depth_capped():
         hw.loads(bad)
 
 
+def test_unpack_attrs_rejects_non_int_enum_values():
+    """A hostile/corrupt peer placing a non-int, non-None object into an
+    enum-typed header slot must be rejected (the Python fallback raises
+    ValueError for the same frame shape)."""
+    from orleans_tpu.core.message import Message
+    from orleans_tpu.runtime.wire import _ENUM_SPEC, _HEADER_SLOTS
+    msg = Message.__new__(Message)
+    for s in Message.__slots__:
+        setattr(msg, s, None)
+    msg.category = "EVIL"  # str where Category is expected
+    data = hw.pack_attrs(msg, _HEADER_SLOTS, None)
+    out = Message.__new__(Message)
+    with pytest.raises(ValueError, match="non-int enum"):
+        hw.unpack_attrs(data, out, _HEADER_SLOTS, _ENUM_SPEC)
+
+
 def test_handshake_is_always_pickle_and_advertises_codec():
     """The handshake is the negotiation vehicle, so it must be decodable
     by every build regardless of the local codec — and it must carry the
