@@ -27,6 +27,18 @@ What is measured (and why):
   contention spikes) that a production v5e host does not share;
   ingest_bytes_per_sec is reported so the transport bound is explicit.
 
+* **Multi-shard mode** (``--devices N`` / ``BENCH_DEVICES=N``) — the same
+  1M-actor workload over an N-virtual-device CPU mesh
+  (``--xla_force_host_platform_device_count``): the scan kernel runs under
+  ``shard_map`` (the branch compiled out on one chip), and every super-round
+  additionally routes all 1M player→game messages over the ``all_to_all``
+  tick fabric (VectorRuntime.route) into a sharded GameGrain fan-in
+  (call_batch_device), with device-side delivered/dropped accounting
+  asserted zero-loss. This is the distributed half of the dispatch engine
+  carrying north-star-scale traffic — the ring/partition semantics of
+  LocalGrainDirectory.cs:477 and the fabric of OutboundMessageQueue.cs:38-44,
+  on device.
+
 Prints exactly one JSON line:
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
 
@@ -45,15 +57,31 @@ import numpy as np
 
 sys.path.insert(0, ".")
 
+BENCH_DEVICES = int(os.environ.get("BENCH_DEVICES", "0"))
+if "--devices" in sys.argv:
+    BENCH_DEVICES = int(sys.argv[sys.argv.index("--devices") + 1])
+if BENCH_DEVICES > 1:
+    # must happen before jax import (main() imports jax lazily, but be
+    # explicit): virtual host devices exist only if XLA is told at init
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={BENCH_DEVICES}")
+
 N_PLAYERS = int(os.environ.get("BENCH_PLAYERS", "1000000"))
+N_GAMES = int(os.environ.get("BENCH_GAMES", "1024"))
+# per-(src,dst) exchange lanes: derived from the population so zero-loss
+# holds at ANY device count (≈N/n² per pair uniform + 25% skew headroom);
+# env-overridable for capacity-pressure experiments
+ROUTE_CAPACITY = int(os.environ.get("BENCH_ROUTE_CAPACITY", "0"))
 ROUNDS_PER_UPLOAD = 8  # K heartbeat rounds scanned inside one kernel call
 N_STAGED = 4           # distinct pre-staged payload super-batches, cycled
 # super-rounds in flight (dispatch-ahead): deeper pipelines absorb more
 # host-dispatch jitter (this dev tunnel's p99 is dispatch-noise-bound)
 PIPELINE_DEPTH = int(os.environ.get("BENCH_PIPELINE_DEPTH", "4"))
 WARMUP_ITERS = 3
-MEASURE_SECONDS = 10.0
-INGEST_SECONDS = 8.0
+MEASURE_SECONDS = float(os.environ.get("BENCH_SECONDS", "10"))
+INGEST_SECONDS = float(os.environ.get("BENCH_INGEST_SECONDS", "8"))
 STALL_FACTOR = 5.0     # a super-round slower than 5x median is a stall
 BASELINE_MSGS_PER_SEC = 1_000_000.0
 
@@ -91,7 +119,7 @@ def main() -> None:
                    "game": state["game"]}
             return new, new["beats"]
 
-    mesh = make_mesh()
+    mesh = make_mesh(BENCH_DEVICES if BENCH_DEVICES > 1 else None)
     n_dev = mesh.devices.size
     cap = -(-N_PLAYERS // n_dev)
     rt = VectorRuntime(mesh=mesh, capacity_per_shard=cap)
@@ -126,11 +154,99 @@ def main() -> None:
     kern = rt._scan_kernel(PlayerGrain, "heartbeat", plan.B, K,
                            contiguous=rt._plan_contiguous(tbl, plan))
 
-    def super_round(i: int):
-        new_state, res = kern(tbl.state, d_slots, d_khash, d_zero, d_valid,
-                              {"pos": staged[i % N_STAGED]})
-        tbl.state = new_state
-        return res
+    # ---- cross-shard leg (multi-shard mode only) -----------------------
+    # Every super-round routes the last heartbeat round's 1M results as
+    # player→game messages over the all_to_all tick fabric into a sharded
+    # GameGrain fan-in. On one device the exchange is a no-op by
+    # construction, so this leg only exists where it proves something.
+    cross_shard = n_dev > 1
+    route_capacity = ROUTE_CAPACITY or -(-5 * N_PLAYERS // (4 * n_dev * n_dev))
+    if cross_shard:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from orleans_tpu.parallel.mesh import SILO_AXIS
+
+        class GameGrain(VectorGrain):
+            """GameGrain fan-in target (Presence GameGrain analog):
+            accumulates per-game heartbeat counts delivered over the
+            exchange."""
+
+            STATE = {"count": (jnp.int32, ())}
+
+            @staticmethod
+            def initial_state(key_hash):
+                return {"count": jnp.int32(0)}
+
+            @actor_method(args={"n": (jnp.int32, ())})
+            def accumulate(state, args):
+                new = {"count": state["count"] + args["n"]}
+                return new, new["count"]
+
+        gt = rt.table(GameGrain)
+        gt.ensure_dense(N_GAMES)
+        gps = gt.dense_per_shard
+        # activate every game once (OnActivate) through the bulk path
+        rt.call_batch(GameGrain, "accumulate", np.arange(N_GAMES),
+                      {"n": np.zeros(N_GAMES, np.int32)})
+        shard_nd = NamedSharding(mesh, P(SILO_AXIS))
+        # static operands: each player's game id rides in lane order
+        d_game = jax.device_put(
+            jnp.asarray(plan.pack(keys % N_GAMES, np.int32, ())), shard_nd)
+        d_validg = jax.device_put(jnp.asarray(plan.valid_b), shard_nd)
+        lanes = np.arange(gps, dtype=np.int32)
+        g_slots = jax.device_put(
+            jnp.asarray(np.broadcast_to(lanes, (n_dev, gps)).copy()),
+            shard_nd)
+        g_khash = g_slots  # khash only seeds initial_state; games are live
+        g_valid = jax.device_put(jnp.ones((n_dev, gps), bool), shard_nd)
+        g_fresh = jax.device_put(jnp.zeros((n_dev, gps), bool), shard_nd)
+
+        from orleans_tpu.ops import segment_sum
+
+        def agg_local(rk, rv):
+            # per-shard fan-in counts AND per-shard delivered tally — the
+            # tally stays shard-local ([n] sharded) so accounting never
+            # compiles a standalone all-reduce (on the single-host CPU
+            # backend, concurrent collective programs can deadlock the
+            # shared thread pool; the only collective per super is the
+            # exchange's all_to_all). segment_sum is the backend-dispatched
+            # reduction (MXU one-hot matmul on TPU, scatter-add elsewhere).
+            k, v = rk[0], rv[0]
+            counts = segment_sum(
+                jnp.where(v, 1, 0).astype(jnp.int32), k % gps, gps)
+            return counts[None], jnp.sum(v.astype(jnp.int32))[None]
+
+        spec = P(SILO_AXIS)
+        agg = jax.jit(jax.shard_map(
+            agg_local, mesh=mesh, in_specs=(spec, spec),
+            out_specs=(spec, spec), check_vma=False))
+        # lazy per-shard device accumulators — summed on host at the end
+        acc = {"delivered": jnp.zeros((n_dev,), jnp.int32),
+               "dropped": jnp.zeros((n_dev,), jnp.int32)}
+
+        def super_round(i: int):
+            new_state, res = kern(tbl.state, d_slots, d_khash, d_zero,
+                                  d_valid, {"pos": staged[i % N_STAGED]})
+            tbl.state = new_state
+            # route 1M player→game messages over the all_to_all fabric,
+            # fan them into the sharded GameGrain table (one aggregated
+            # message per game per super keeps the one-msg-per-actor-per-
+            # tick turn contract)
+            rk, _recv, rv, drops = rt.route(
+                GameGrain, d_game, {"beats": res[-1]}, d_validg,
+                capacity=route_capacity)
+            counts, dl = agg(rk, rv)
+            out = rt.call_batch_device(GameGrain, "accumulate", g_slots,
+                                       g_khash, g_fresh, g_valid,
+                                       {"n": counts})
+            acc["delivered"] = acc["delivered"] + dl
+            acc["dropped"] = acc["dropped"] + drops.astype(jnp.int32)
+            return out
+    else:
+        def super_round(i: int):
+            new_state, res = kern(tbl.state, d_slots, d_khash, d_zero,
+                                  d_valid, {"pos": staged[i % N_STAGED]})
+            tbl.state = new_state
+            return res
 
     for i in range(WARMUP_ITERS):
         jax.block_until_ready(super_round(i))
@@ -140,6 +256,11 @@ def main() -> None:
     # Keep PIPELINE_DEPTH supers in flight; completions are timestamped as
     # each oldest in-flight super finishes. Steady-state inter-completion
     # intervals ARE the super-round service times once the pipe is full.
+    # cross-shard mode runs supers sequentially (depth 1): overlapping
+    # collective programs deadlock the single-host CPU backend's shared
+    # rendezvous pool — and a sequential record is the honest one for a
+    # correctness-at-scale artifact anyway
+    depth = 1 if cross_shard else PIPELINE_DEPTH
     inflight: deque = deque()
     completions: list[float] = []
     supers = 0
@@ -147,7 +268,7 @@ def main() -> None:
     while time.perf_counter() - t0 < MEASURE_SECONDS:
         inflight.append(super_round(supers))
         supers += 1
-        if len(inflight) >= PIPELINE_DEPTH:
+        if len(inflight) >= depth:
             jax.block_until_ready(inflight.popleft())
             completions.append(time.perf_counter())
     while inflight:
@@ -168,6 +289,28 @@ def main() -> None:
     non_stall = per_round_ms[~stall_mask]
     p99_excl_stalls = round(float(np.percentile(non_stall, 99)), 3) \
         if non_stall.size else None
+
+    # ---- cross-shard conservation: zero-loss accounting ----------------
+    cross_stats = None
+    if cross_shard:
+        routed_supers = WARMUP_ITERS + supers
+        delivered = int(np.asarray(jax.device_get(acc["delivered"])).sum())
+        dropped = int(np.asarray(jax.device_get(acc["dropped"])).sum())
+        game_total = int(np.asarray(
+            rt.table(GameGrain).state["count"][:, :gps]).sum())
+        expected = routed_supers * N_PLAYERS
+        assert dropped == 0, f"exchange dropped {dropped} messages"
+        assert delivered == expected, (delivered, expected)
+        assert game_total == delivered, (game_total, delivered)
+        cross_stats = {
+            "routed_msgs_per_super": N_PLAYERS,
+            "routed_supers": routed_supers,
+            "delivered": delivered,
+            "dropped": dropped,
+            "fan_in_games": N_GAMES,
+            "route_capacity": route_capacity,
+            "conservation_ok": True,
+        }
 
     # ---- secondary: double-buffered ingest pipeline --------------------
     # A staging thread packs + uploads super-batch N+1 while the device
@@ -213,7 +356,7 @@ def main() -> None:
             "n_players": N_PLAYERS,
             "rounds_measured": len(intervals) * K,
             "rounds_per_super": K,
-            "pipeline_depth": PIPELINE_DEPTH,
+            "pipeline_depth": depth,
             "staged_batches": N_STAGED,
             "p99_round_latency_ms": p99_round_ms,
             "round_latency_ms": dist,
@@ -226,6 +369,7 @@ def main() -> None:
             "ingest_supers": ingest_supers,
             "devices": n_dev,
             "platform": jax.devices()[0].platform,
+            **({"cross_shard": cross_stats} if cross_stats else {}),
         },
     }))
 
