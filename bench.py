@@ -6,7 +6,12 @@ PlayerGrains receiving position heartbeats (reference:
 test/Benchmarks/Ping/PingBenchmark.cs:35-46 measurement style: timed loop,
 prints calls/sec). Each heartbeat round is ONE vectorized dispatch tick
 over the sharded actor table; the metric of record is grain msgs/sec/chip
-with the per-round (== per-message p99) latency distribution.
+with two latency figures: the AMORTIZED per-round cadence
+(dispatch interval / rounds per dispatch — the tick-granularity figure,
+scales with BENCH_FUSE) and the raw dispatch-completion interval
+(``dispatch_interval_ms`` — the lower bound on any message's end-to-end
+wall latency, which fusing cannot shrink). Both are emitted so batching
+knobs can never hide real latency.
 
 What is measured (and why):
 
@@ -79,6 +84,12 @@ N_STAGED = 4           # distinct pre-staged payload super-batches, cycled
 # super-rounds in flight (dispatch-ahead): deeper pipelines absorb more
 # host-dispatch jitter (this dev tunnel's p99 is dispatch-noise-bound)
 PIPELINE_DEPTH = int(os.environ.get("BENCH_PIPELINE_DEPTH", "4"))
+# supers fused into one dispatch: the host/tunnel dispatch cost (~58 ms
+# through this dev tunnel, 87% of the super-round — see device_time in the
+# output) amortizes over S× more staged device work per call. Payload
+# content is unchanged (the same staged distinct supers, concatenated);
+# this is the production host's batching knob, not a workload change.
+FUSE_SUPERS = max(1, int(os.environ.get("BENCH_FUSE", "8")))
 WARMUP_ITERS = 3
 MEASURE_SECONDS = float(os.environ.get("BENCH_SECONDS", "10"))
 INGEST_SECONDS = float(os.environ.get("BENCH_INGEST_SECONDS", "8"))
@@ -153,6 +164,23 @@ def main() -> None:
               for i in range(N_STAGED)]
     kern = rt._scan_kernel(PlayerGrain, "heartbeat", plan.B, K,
                            contiguous=rt._plan_contiguous(tbl, plan))
+
+    # dispatch-fused staging: each headline dispatch scans K_DISP rounds
+    # (cross-shard mode keeps one super per dispatch — its route leg is
+    # per-super by design)
+    fuse = 1 if n_dev > 1 else FUSE_SUPERS
+    K_DISP = K * fuse
+    if fuse > 1:
+        disp_staged = [
+            jnp.concatenate([staged[(v + i) % N_STAGED]
+                             for i in range(fuse)], axis=0)
+            for v in range(2)]
+        kern_disp = rt._scan_kernel(PlayerGrain, "heartbeat", plan.B,
+                                    K_DISP,
+                                    contiguous=rt._plan_contiguous(tbl, plan))
+    else:
+        disp_staged = staged
+        kern_disp = kern
 
     # ---- cross-shard leg (multi-shard mode only) -----------------------
     # Every super-round routes the last heartbeat round's 1M results as
@@ -243,14 +271,15 @@ def main() -> None:
             return out
     else:
         def super_round(i: int):
-            new_state, res = kern(tbl.state, d_slots, d_khash, d_zero,
-                                  d_valid, {"pos": staged[i % N_STAGED]})
+            new_state, res = kern_disp(
+                tbl.state, d_slots, d_khash, d_zero, d_valid,
+                {"pos": disp_staged[i % len(disp_staged)]})
             tbl.state = new_state
             return res
 
     for i in range(WARMUP_ITERS):
         jax.block_until_ready(super_round(i))
-        rounds_done += K
+        rounds_done += K_DISP
 
     # ---- headline: pipelined steady-state dispatch throughput ----------
     # Keep PIPELINE_DEPTH supers in flight; completions are timestamped as
@@ -274,21 +303,105 @@ def main() -> None:
     while inflight:
         jax.block_until_ready(inflight.popleft())
         completions.append(time.perf_counter())
-    rounds_done += supers * K
+    rounds_done += supers * K_DISP
 
     comp = np.array(completions)
-    intervals = np.diff(comp)                    # super-round service times
+    intervals = np.diff(comp)                    # per-dispatch service times
     elapsed = comp[-1] - comp[0]
-    msgs_per_sec = (len(intervals) * K * N_PLAYERS) / elapsed
-    per_round_ms = intervals / K * 1e3
+    msgs_per_sec = (len(intervals) * K_DISP * N_PLAYERS) / elapsed
+    per_round_ms = intervals / K_DISP * 1e3
     med_super = float(np.median(intervals))
     stall_mask = intervals > STALL_FACTOR * med_super
     dist = {p: round(float(np.percentile(per_round_ms, p)), 3)
             for p in (50, 90, 99, 99.9)}
+    # the raw dispatch-completion cadence, unamortized: a message's
+    # end-to-end wall latency is bounded below by this (its dispatch must
+    # complete before its result is observable) — reported alongside the
+    # amortized per-round figure so fusing can never hide real latency
+    disp_dist = {p: round(float(np.percentile(intervals * 1e3, p)), 3)
+                 for p in (50, 99)}
     p99_round_ms = dist[99]
     non_stall = per_round_ms[~stall_mask]
     p99_excl_stalls = round(float(np.percentile(non_stall, 99)), 3) \
         if non_stall.size else None
+
+    # ---- device-time attribution + bandwidth roofline ------------------
+    # The wall-clock dispatch interval above includes host dispatch and
+    # (in this dev environment) a tunneled transport. A single blocking
+    # measurement cannot separate them — any fused call still pays one
+    # RPC. So: measure blocking calls at TWO fusion levels S_A and
+    # S_B = 2*S_A (payloads tiled on device, no host transfer) and fit
+    # T(S) = overhead + S * device_super. The slope is pure device
+    # execution per K-round super; the intercept is the per-dispatch
+    # host/tunnel cost. No clamping — a negative pipelined residual just
+    # means the pipeline overlaps dispatch with execution. This is the
+    # hot-path statistics discipline of MessagingStatisticsGroup.cs
+    # (Dispatcher.cs:77,249,421) applied to the device tier, plus the
+    # roofline this workload is actually bound by (HBM bytes, not FLOPs).
+    DEV_REPS = int(os.environ.get("BENCH_DEVTIME_REPS", "3"))
+    # floor the fit span at S=8: with per-dispatch overhead ~68 ms through
+    # this tunnel, a 1-vs-2 fit's slope is below measurement noise (it
+    # once yielded 347% of HBM peak); 8-vs-16 gives the slope a ~5 ms
+    # lever arm, and the four points S∈{1,2,8,16} agree within noise
+    S_A = max(8, K_DISP // K)
+    S_B = 2 * S_A
+
+    def fused_payload(S):
+        if S == K_DISP // K and fuse > 1:
+            return disp_staged[0], kern_disp  # reuse the headline buffer
+        buf = jnp.concatenate(
+            [staged[i % N_STAGED] for i in range(S)], axis=0)
+        kf = rt._scan_kernel(PlayerGrain, "heartbeat", plan.B, K * S,
+                             contiguous=rt._plan_contiguous(tbl, plan))
+        return buf, kf
+
+    def time_blocking(S) -> float:
+        nonlocal rounds_done
+        buf, kf = fused_payload(S)
+        for rep in range(DEV_REPS + 1):  # first call warms the compile
+            if rep == 1:
+                t0 = time.perf_counter()
+            new_state, r = kf(
+                tbl.state, d_slots, d_khash, d_zero, d_valid, {"pos": buf})
+            tbl.state = new_state
+            jax.block_until_ready(r)
+            rounds_done += K * S
+        return (time.perf_counter() - t0) / DEV_REPS
+
+    t_a = time_blocking(S_A)
+    t_b = time_blocking(S_B)
+    device_super_s = max((t_b - t_a) / (S_B - S_A), 1e-9)  # slope
+    dispatch_overhead_s = t_a - S_A * device_super_s       # intercept
+    device_super_ms = device_super_s * 1e3
+    device_dispatch_ms = device_super_ms * (K_DISP / K)
+    # pipelined residual: how much of the steady-state interval is NOT
+    # accounted for by device execution (negative = pipeline overlap)
+    pipelined_residual_ms = med_super * 1e3 - device_dispatch_ms
+    # bytes-moved model per round per actor: state read (pos f32x2 +
+    # beats i32 + game i32 = 16B) + state write (16B) + payload read
+    # (f16x2 = 4B) + result write (i32 = 4B) = 40B
+    bytes_per_super = K * N_PLAYERS * 40
+    achieved_bw = bytes_per_super / device_super_s
+    platform = jax.devices()[0].platform
+    # v5e HBM peak 819 GB/s (public spec); no meaningful figure for the
+    # virtual-CPU mesh
+    peak_bw = 819e9 if platform == "tpu" else None
+    device_time = {
+        "fit_supers": [S_A, S_B],
+        "reps": DEV_REPS,
+        "blocking_call_ms": [round(t_a * 1e3, 3), round(t_b * 1e3, 3)],
+        "device_super_ms": round(device_super_ms, 3),
+        "device_round_ms": round(device_super_ms / K, 3),
+        "device_dispatch_ms": round(device_dispatch_ms, 3),
+        "dispatch_overhead_ms": round(dispatch_overhead_s * 1e3, 3),
+        "dispatched_interval_ms": round(med_super * 1e3, 3),
+        "pipelined_residual_ms": round(pipelined_residual_ms, 3),
+        "bytes_per_super_model": bytes_per_super,
+        "achieved_device_bytes_per_sec": round(achieved_bw, 1),
+        "hbm_peak_bytes_per_sec": peak_bw,
+        "pct_of_peak_bw": round(100.0 * achieved_bw / peak_bw, 2)
+        if peak_bw else None,
+    }
 
     # ---- cross-shard conservation: zero-loss accounting ----------------
     cross_stats = None
@@ -354,12 +467,15 @@ def main() -> None:
         "vs_baseline": round(msgs_per_sec / BASELINE_MSGS_PER_SEC, 3),
         "extra": {
             "n_players": N_PLAYERS,
-            "rounds_measured": len(intervals) * K,
+            "rounds_measured": len(intervals) * K_DISP,
             "rounds_per_super": K,
+            "fused_supers_per_dispatch": K_DISP // K,
+            "rounds_per_dispatch": K_DISP,
             "pipeline_depth": depth,
             "staged_batches": N_STAGED,
             "p99_round_latency_ms": p99_round_ms,
             "round_latency_ms": dist,
+            "dispatch_interval_ms": disp_dist,
             "round_latency_max_ms": round(float(per_round_ms.max()), 3),
             "median_super_round_ms": round(med_super * 1e3, 3),
             "stall_supers": int(stall_mask.sum()),
@@ -369,6 +485,7 @@ def main() -> None:
             "ingest_supers": ingest_supers,
             "devices": n_dev,
             "platform": jax.devices()[0].platform,
+            "device_time": device_time,
             **({"cross_shard": cross_stats} if cross_stats else {}),
         },
     }))
