@@ -231,6 +231,51 @@ def install_journal_notifier(silo) -> None:
                                     JOURNAL_NOTIFY_TARGET)
 
 
+async def _notify_silo(silo, peer, class_name: str, key, key_ext,
+                       from_version: int, events: list,
+                       new_version: int) -> bool:
+    """One journal_notify system-target call to ``peer`` (may be this
+    silo). Shared by the intra-cluster broadcast and the geo relay."""
+    from ..core.ids import GrainId, type_code_of
+    from ..core.message import Category
+    target = GrainId.system_target(
+        type_code_of(JOURNAL_NOTIFY_TARGET), peer)
+    return await silo.runtime_client.send_request(
+        target_grain=target, grain_class=JournalNotificationTarget,
+        interface_name="JournalNotificationTarget",
+        method_name="journal_notify",
+        args=(class_name, key, key_ext, from_version, list(events),
+              new_version),
+        kwargs={}, target_silo=peer, category=Category.SYSTEM)
+
+
+class JournalRelayGrain(Grain):
+    """Cross-cluster journal gateway (the ProtocolGateway analog,
+    /root/reference/src/Orleans.Runtime/LogConsistency/ProtocolGateway.cs):
+    a writer cluster pushes confirmed-event notifications to each remote
+    cluster's relay grain over the cluster gateway; the relay fans them
+    out to every silo of ITS cluster through the same notification target
+    the intra-cluster broadcast uses. Keyed by the journaled grain's
+    identity so relays for different grains parallelize."""
+
+    async def journal_relay(self, class_name: str, key, key_ext,
+                            from_version: int, events: list,
+                            new_version: int) -> int:
+        silo = self._activation.runtime
+        peers = list(getattr(silo.locator, "alive_list", [])) or \
+            [silo.silo_address]
+        delivered = 0
+        for peer in peers:
+            try:
+                if await _notify_silo(silo, peer, class_name, key, key_ext,
+                                      from_version, events, new_version):
+                    delivered += 1
+            except Exception:  # noqa: BLE001 — a dying silo's replica
+                # reloads from storage on next activation
+                log.debug("journal relay to %s failed", peer, exc_info=True)
+        return delivered
+
+
 def log_consistency(provider: str, storage_name: str = "Default"):
     """Class decorator choosing the consistency provider
     ([LogConsistencyProvider] attribute analog)."""
@@ -348,32 +393,26 @@ class JournaledGrain(Grain):
 
     def _broadcast_confirmed(self, batch: list, new_version: int) -> None:
         """Writer side: push (from_version, events, new_version) to every
-        peer silo's notification target; failures retry with backoff
-        (the reference's notification worker)."""
+        peer silo's notification target, and — when this silo is part of a
+        multi-cluster deployment — to every known remote cluster's relay
+        grain over the cluster gateways (geo replication: the
+        notification-worker half of PrimaryBasedLogViewAdaptor.cs:907
+        riding ProtocolGateway.cs). Failures retry with backoff; a cluster
+        that stays unreachable catches up from primary storage via the
+        replicas' gap machinery once notifications resume."""
         silo = self._activation.runtime
         from_version = new_version - len(batch)
+        cname = type(self).__name__
+        gid = self.grain_id
         peers = [s for s in getattr(silo.locator, "alive_list", [])
                  if s != silo.silo_address]
-        if not peers:
-            return
-        gid = self.grain_id
 
         async def notify_one(peer) -> None:
-            from ..core.ids import GrainId, type_code_of
-            from ..core.message import Category
-            target = GrainId.system_target(
-                type_code_of(JOURNAL_NOTIFY_TARGET), peer)
             for attempt in range(NOTIFY_RETRIES):
                 try:
-                    await silo.runtime_client.send_request(
-                        target_grain=target,
-                        grain_class=JournalNotificationTarget,
-                        interface_name="JournalNotificationTarget",
-                        method_name="journal_notify",
-                        args=(type(self).__name__, gid.key, gid.key_ext,
-                              from_version, list(batch), new_version),
-                        kwargs={}, target_silo=peer,
-                        category=Category.SYSTEM)
+                    await _notify_silo(silo, peer, cname, gid.key,
+                                       gid.key_ext, from_version,
+                                       list(batch), new_version)
                     return
                 except Exception:  # noqa: BLE001 — peer may be mid-death;
                     # its replica reloads from storage on next activation
@@ -381,13 +420,39 @@ class JournaledGrain(Grain):
             log.warning("journal notification to %s gave up for %s",
                         peer, gid)
 
+        async def notify_cluster(cid: str) -> None:
+            for attempt in range(NOTIFY_RETRIES):
+                try:
+                    client = await silo.gsi._client_for(cid)
+                    relay = client.get_grain(
+                        JournalRelayGrain, str(gid.key),
+                        key_ext=f"{cname}|{gid.key_ext or ''}")
+                    await relay.journal_relay(
+                        cname, gid.key, gid.key_ext, from_version,
+                        list(batch), new_version)
+                    return
+                except Exception:  # noqa: BLE001 — partition/restart: the
+                    # remote replicas' gap catch-up reads primary storage
+                    await asyncio.sleep(NOTIFY_RETRY_BASE * (2 ** attempt))
+            log.warning("geo journal notification to cluster %s gave up "
+                        "for %s", cid, gid)
+
         tasks = getattr(silo, "_journal_notify_tasks", None)
         if tasks is None:
             tasks = silo._journal_notify_tasks = set()
-        for peer in peers:
-            t = asyncio.ensure_future(notify_one(peer))
+
+        def spawn(coro) -> None:
+            t = asyncio.ensure_future(coro)
             tasks.add(t)
             t.add_done_callback(tasks.discard)
+
+        for peer in peers:
+            spawn(notify_one(peer))
+        oracle = getattr(silo, "multicluster", None)
+        if oracle is not None and getattr(silo, "gsi", None) is not None:
+            for cid in oracle.known_clusters():
+                if cid != oracle.cluster_id:
+                    spawn(notify_cluster(cid))
 
     @property
     def state(self) -> Any:

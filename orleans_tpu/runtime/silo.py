@@ -446,8 +446,13 @@ class Silo:
         # before any replica confirms events (eventsourcing notifications)
         for cls in self.registry.all_classes():
             if getattr(cls, "__journal_replicated__", False):
-                from ..eventsourcing.journaled import install_journal_notifier
+                from ..eventsourcing.journaled import (
+                    JournalRelayGrain, install_journal_notifier)
                 install_journal_notifier(self)
+                # geo replication rides an ordinary grain reachable through
+                # cluster gateways (the ProtocolGateway analog) — register
+                # it wherever replicated journals are hosted
+                self.registry.register(JournalRelayGrain)
                 break
         if self.vector is not None:
             # vector-hosting silos must accept forwarded bulk stream items

@@ -10,6 +10,11 @@ from .balancer import (
     QueueBalancer,
 )
 from .cache import PooledQueueCache, QueueCacheCursor
+from .durable import (
+    DurableQueueAdapter,
+    FileQueueAdapter,
+    SqliteQueueAdapter,
+)
 from .core import (StreamId, StreamProvider, StreamRef,
                    SubscriptionHandle, batch_consumer)
 from .persistent import (
@@ -30,6 +35,7 @@ __all__ = [
     "SMSStreamProvider", "add_sms_streams",
     "QueueAdapter", "QueueReceiver", "QueueBatch", "MemoryQueueAdapter",
     "GeneratorQueueAdapter",
+    "DurableQueueAdapter", "FileQueueAdapter", "SqliteQueueAdapter",
     "PersistentStreamProvider", "add_persistent_streams",
     "PubSubRendezvousGrain", "implicit_stream_subscription",
     "QueueBalancer", "DeploymentBasedBalancer", "BestFitBalancer",

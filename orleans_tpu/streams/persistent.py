@@ -201,6 +201,7 @@ class _ConsumerPump:
 
     async def _run(self) -> None:
         agent = self.agent
+        await self._replay_durable_history()
         while True:
             # clear BEFORE checking so a set() racing the check is kept
             self.wake.clear()
@@ -212,6 +213,45 @@ class _ConsumerPump:
                     await self.wake.wait()
                     continue
             await self._deliver(cb.batch)
+            if cb.batch.stream == self.stream:
+                prog = agent.provider.replay_progress
+                prog[self.key] = max(
+                    prog.get(self.key, 0),
+                    cb.batch.seq + len(cb.batch.items))
+
+    async def _replay_durable_history(self) -> None:
+        """Rewind beyond the in-memory cache window: a subscription with a
+        ``from_token`` older than anything cached replays ACKED batches
+        from the durable queue log (the EventHub-offset retention replay;
+        durable.DurableQueueAdapter.replay). Only acked batches: unacked
+        ones redeliver through the normal pull, and this pump's cursor —
+        created from_oldest BEFORE this runs — pins eviction, so no batch
+        can slip between replay and the cache (at-least-once holds;
+        overlap dedups by token via the from_token trim in
+        deliver_to_consumer).
+
+        The replay floor is max(subscription token, this silo's recorded
+        delivery progress for the consumer): pumps are recreated on every
+        queue rebalance / consumer-view churn, and without the progress
+        floor each recreation would re-deliver the full retained history.
+        Progress is silo-local — a queue handed to ANOTHER silo replays
+        from the subscription token again (at-least-once; consumers dedup
+        by token)."""
+        ft = getattr(self.handle, "from_token", None)
+        replay = getattr(self.agent.provider.adapter, "replay", None)
+        if ft is None or replay is None:
+            return
+        progress = self.agent.provider.replay_progress
+        floor = max(ft, progress.get(self.key, ft))
+        try:
+            history = await replay(self.stream, floor)
+        except Exception:  # noqa: BLE001 — replay is best-effort recovery
+            log.exception("durable replay failed for %s", self.stream)
+            return
+        for batch in sorted(history, key=lambda b: b.seq):
+            await self._deliver(batch)
+            progress[self.key] = max(progress.get(self.key, 0),
+                                     batch.seq + len(batch.items))
 
     def _next_mine(self):
         """Advance past other streams' batches to the next batch of ours."""
@@ -444,6 +484,9 @@ class PersistentStreamProvider(StreamProvider):
         self.balancer = balancer or DeploymentBasedBalancer()
         self.cache_capacity = cache_capacity
         self.manager = PullingManager(self, rebalance_period=rebalance_period)
+        # silo-local delivery progress per (stream, handle_id): the floor
+        # for durable-history replay across pump recreations
+        self.replay_progress: dict[tuple, int] = {}
 
     async def produce(self, stream: StreamId, items: list) -> None:
         queue_id = stream.uniform_hash % self.adapter.n_queues

@@ -1,0 +1,263 @@
+"""Durable stream queue adapters (file/sqlite): produce survives process
+death, pulling agents resume from the durable ack cursor, rewound
+subscriptions replay beyond the in-memory cache window, and a silo killed
+mid-stream loses zero events (reference: AzureQueueAdapterReceiver.cs +
+PersistentStreamPullingAgent.cs:350-368 — durability lives in the queue)."""
+
+import asyncio
+import time
+
+import pytest
+
+from orleans_tpu.membership import InMemoryMembershipTable, join_cluster
+from orleans_tpu.runtime import ClusterClient, Grain, SiloBuilder
+from orleans_tpu.runtime.cluster import InProcFabric
+from orleans_tpu.storage import MemoryStorage
+from orleans_tpu.streams import (
+    FileQueueAdapter,
+    SqliteQueueAdapter,
+    StreamId,
+    add_persistent_streams,
+)
+
+RECEIVED: dict = {}
+
+
+def _adapter(kind: str, tmp_path, **kw):
+    if kind == "file":
+        return FileQueueAdapter(str(tmp_path / "queues"), **kw)
+    return SqliteQueueAdapter(str(tmp_path / "queues.db"), **kw)
+
+
+# ---------------------------------------------------------------------------
+# Adapter-level semantics
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kind", ["file", "sqlite"])
+async def test_durable_adapter_ack_cursor_and_replay(kind, tmp_path):
+    ad = _adapter(kind, tmp_path, n_queues=2)
+    sid = StreamId("p", "ns", "k")
+    q = ad.queue_of(sid)
+    await ad.queue_message_batch(q, sid, ["a", "b"])
+    await ad.queue_message_batch(q, sid, ["c"])
+    await ad.queue_message_batch(q, sid, ["d", "e", "f"])
+
+    r1 = ad.create_receiver(q)
+    got = await r1.get_messages(10)
+    # item-cumulative tokens: batch seq = first item's token
+    assert [(b.seq, b.items) for b in got] == \
+        [(0, ["a", "b"]), (2, ["c"]), (3, ["d", "e", "f"])]
+    # a repeat poll on the same receiver does not redeliver
+    assert await r1.get_messages(10) == []
+    await r1.ack(got[0])
+
+    # "restart": a fresh receiver resumes from the durable cursor —
+    # acked batches stay gone, unacked ones redeliver
+    r2 = ad.create_receiver(q)
+    redelivered = await r2.get_messages(10)
+    assert [(b.seq, b.items) for b in redelivered] == \
+        [(2, ["c"]), (3, ["d", "e", "f"])]
+    await r2.ack(redelivered[0])
+    await r2.ack(redelivered[1])
+
+    # replay serves ACKED history from the durable log (rewind source)
+    hist = await ad.replay(sid, 0)
+    assert [(b.seq, b.items) for b in hist] == \
+        [(0, ["a", "b"]), (2, ["c"]), (3, ["d", "e", "f"])]
+    # from_seq filters batches wholly before the token
+    hist = await ad.replay(sid, 3)
+    assert [b.seq for b in hist] == [3]
+
+
+@pytest.mark.parametrize("kind", ["file", "sqlite"])
+async def test_durable_adapter_survives_reopen(kind, tmp_path):
+    """The adapter object dying (process death) loses nothing: a new
+    adapter over the same storage sees every unacked batch."""
+    ad = _adapter(kind, tmp_path)
+    sid = StreamId("p", "ns", "k2")
+    q = ad.queue_of(sid)
+    await ad.queue_message_batch(q, sid, [1, 2, 3])
+    r = ad.create_receiver(q)
+    got = await r.get_messages(10)
+    await r.ack(got[0])
+    await ad.queue_message_batch(q, sid, [4])
+    if kind == "sqlite":
+        ad.close()
+
+    ad2 = _adapter(kind, tmp_path)
+    r2 = ad2.create_receiver(q)
+    got2 = await r2.get_messages(10)
+    assert [b.items for b in got2] == [[4]]
+    assert [b.items for b in await ad2.replay(sid, 0)] == [[1, 2, 3]]
+
+
+async def test_file_adapter_recovers_from_torn_tail(tmp_path):
+    """A crashed writer's partial trailing line must not poison the queue:
+    the next produce truncates the torn tail and appends a parseable
+    record; no acknowledged batch is lost."""
+    ad = FileQueueAdapter(str(tmp_path / "queues"), n_queues=1)
+    sid = StreamId("p", "ns", "k")
+    await ad.queue_message_batch(0, sid, ["a", "b"])
+    # simulate a crash mid-append: a torn, unterminated JSON fragment
+    with open(ad._log(0), "a", encoding="utf-8") as f:
+        f.write('{"sid": "AAAA", "b": "BB')
+    await ad.queue_message_batch(0, sid, ["c"])
+    r = ad.create_receiver(0)
+    got = await r.get_messages(10)
+    assert [(b.seq, b.items) for b in got] == [(0, ["a", "b"]), (2, ["c"])]
+
+
+async def test_sqlite_retention_bounds_acked_history(tmp_path):
+    ad = SqliteQueueAdapter(str(tmp_path / "q.db"), n_queues=1, retention=3)
+    sid = StreamId("p", "n", "k")
+    for i in range(6):
+        await ad.queue_message_batch(0, sid, [i])
+    r = ad.create_receiver(0)
+    for b in await r.get_messages(10):
+        await r.ack(b)
+    hist = await ad.replay(sid, 0)
+    assert [b.items for b in hist] == [[3], [4], [5]]  # newest 3 retained
+
+
+# ---------------------------------------------------------------------------
+# End-to-end through the pulling machinery
+# ---------------------------------------------------------------------------
+
+class ConsumerGrain(Grain):
+    async def join(self, ns, key, from_token=None):
+        stream = self.get_stream_provider("dq").get_stream(ns, key)
+        await stream.subscribe(self.on_event, from_token=from_token)
+
+    async def on_event(self, item, token):
+        RECEIVED.setdefault(self.primary_key, []).append((token, item))
+
+
+class ProducerGrain(Grain):
+    async def publish(self, ns, key, items):
+        stream = self.get_stream_provider("dq").get_stream(ns, key)
+        await stream.on_next_batch(items)
+
+
+async def _cluster(n, adapter, with_membership=False, cache_capacity=256):
+    fabric = InProcFabric()
+    storage = MemoryStorage()
+    mbr = InMemoryMembershipTable()
+    silos = []
+    for i in range(n):
+        b = (SiloBuilder().with_name(f"dq{i}").with_fabric(fabric)
+             .add_grains(ConsumerGrain, ProducerGrain)
+             .with_storage("Default", storage)
+             .with_config(membership_probe_period=0.1,
+                          membership_probe_timeout=0.15,
+                          membership_missed_probes_limit=2,
+                          membership_refresh_period=0.3,
+                          response_timeout=2.0))
+        add_persistent_streams(b, "dq", adapter, pull_period=0.05,
+                               cache_capacity=cache_capacity,
+                               rebalance_period=0.5)
+        silo = b.build()
+        if with_membership:
+            join_cluster(silo, mbr)
+        await silo.start()
+        silos.append(silo)
+    client = await ClusterClient(fabric).connect()
+    return silos, client
+
+
+async def _stop(silos, client):
+    await client.close_async()
+    for s in silos:
+        if s.status not in ("Stopped", "Dead"):
+            await s.stop()
+
+
+async def _wait_count(key, count, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if len(RECEIVED.get(key, [])) >= count:
+            return RECEIVED[key]
+        await asyncio.sleep(0.03)
+    raise AssertionError(
+        f"{key}: got {len(RECEIVED.get(key, []))}, wanted {count}")
+
+
+@pytest.mark.parametrize("kind", ["file", "sqlite"])
+async def test_durable_stream_end_to_end(kind, tmp_path):
+    RECEIVED.clear()
+    silos, client = await _cluster(1, _adapter(kind, tmp_path))
+    try:
+        await client.get_grain(ConsumerGrain, 1).join("gps", "car")
+        await client.get_grain(ProducerGrain, 1).publish(
+            "gps", "car", list(range(5)))
+        got = await _wait_count(1, 5)
+        assert [i for _, i in got] == [0, 1, 2, 3, 4]
+    finally:
+        await _stop(silos, client)
+
+
+async def test_silo_kill_mid_stream_loses_nothing(tmp_path):
+    """Kill the queue-owning silo with undelivered+unacked events in
+    flight: the surviving silo's balancer takes the queue over and its
+    fresh receiver resumes from the durable ack cursor — every produced
+    event is eventually delivered (at-least-once; dedup by token)."""
+    RECEIVED.clear()
+    adapter = SqliteQueueAdapter(str(tmp_path / "q.db"), n_queues=2)
+    silos, client = await _cluster(3, adapter, with_membership=True)
+    try:
+        await client.get_grain(ConsumerGrain, 9).join("gps", "bus")
+        prod = client.get_grain(ProducerGrain, 1)
+        await prod.publish("gps", "bus", list(range(10)))
+        await _wait_count(9, 10)
+
+        # find and kill the silo whose agent owns the stream's queue
+        sid = StreamId("dq", "gps", "bus")
+        q = adapter.queue_of(sid)
+        owner = next(s for s in silos
+                     if q in s.stream_providers["dq"].manager.agents)
+        # produce a second wave and kill the owner immediately — some of
+        # these are pulled-but-unacked or not yet pulled at kill time
+        await prod.publish("gps", "bus", list(range(10, 30)))
+        await owner.stop(graceful=False)
+
+        got = await _wait_count(9, 30, timeout=20.0)
+        items = {i for _, i in got}
+        assert items == set(range(30)), sorted(set(range(30)) - items)
+        # tokens are unique per item: dedup-by-token recovers exactly-once
+        toks = [t for t, _ in got]
+        uniq = {}
+        for t, i in got:
+            uniq.setdefault(t, i)
+        assert sorted(uniq.values()) == list(range(30))
+        assert len(toks) >= 30  # redelivery (duplicates) is allowed
+    finally:
+        await _stop(silos, client)
+
+
+async def test_rewind_beyond_cache_replays_durable_history(tmp_path):
+    """A subscription rewound to token 0 after the cache window has moved
+    on replays acked batches from the durable log — beyond what the
+    in-memory cache retains (the EventHub-offset retention replay)."""
+    RECEIVED.clear()
+    adapter = SqliteQueueAdapter(str(tmp_path / "q.db"), n_queues=1)
+    silos, client = await _cluster(1, adapter, cache_capacity=4)
+    try:
+        await client.get_grain(ConsumerGrain, 1).join("gps", "t")
+        prod = client.get_grain(ProducerGrain, 1)
+        for i in range(40):  # 40 batches >> cache capacity 4
+            await prod.publish("gps", "t", [i])
+        await _wait_count(1, 40)
+        # let eviction+ack drain the cache behind the consumer
+        await asyncio.sleep(0.5)
+        agent = silos[0].stream_providers["dq"].manager.agents[0]
+        assert agent.cache.count < 40  # the cache window really moved on
+
+        # a NEW consumer rewinds to the beginning
+        await client.get_grain(ConsumerGrain, 2).join(
+            "gps", "t", from_token=0)
+        got = await _wait_count(2, 40, timeout=15.0)
+        uniq = {}
+        for t, i in got:
+            uniq.setdefault(t, i)
+        assert sorted(uniq.values()) == list(range(40))
+    finally:
+        await _stop(silos, client)
