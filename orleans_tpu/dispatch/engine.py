@@ -182,6 +182,27 @@ class VectorRuntime:
         # bulk tick is pure overhead unless a storage bridge consumes it)
         self.track_dirty = False
         self._dirty: dict[type, list[np.ndarray]] = {}
+        # stateless-worker (mesh-replicated) hosts per class — see
+        # dispatch.replicated (StatelessWorkerPlacement.cs:6 on device)
+        self._replicated_hosts: dict[type, Any] = {}
+
+    def replicated_host(self, cls: type, n_keys: int | None = None):
+        """Host ``cls`` as a mesh-replicated stateless worker (no
+        directory entry; any shard serves any key; reads fan in via the
+        class's MERGE collectives). ``n_keys`` is required on first call."""
+        host = self._replicated_hosts.get(cls)
+        if host is None:
+            if n_keys is None:
+                raise ValueError(
+                    f"first replicated_host({cls.__name__}) needs n_keys")
+            from .replicated import ReplicatedWorkerHost
+            host = ReplicatedWorkerHost(cls, self.mesh, n_keys)
+            self._replicated_hosts[cls] = host
+        elif n_keys is not None and n_keys != host.n_keys:
+            raise ValueError(
+                f"{cls.__name__} already hosted with n_keys="
+                f"{host.n_keys}; cannot re-host with n_keys={n_keys}")
+        return host
 
     # ------------------------------------------------------------------
     def register(self, *grain_classes: type[VectorGrain],

@@ -1,0 +1,148 @@
+"""Device-tier stateless workers: class replicated over the mesh axis, no
+directory entry, round-robin shard assignment, collective read fan-in
+(StatelessWorkerPlacement.cs:6 / StatelessWorkerDirector.cs:8 re-designed
+for the device tier; SURVEY §2.4)."""
+
+import numpy as np
+
+import jax.numpy as jnp
+import pytest
+
+from orleans_tpu.dispatch import (
+    VectorGrain,
+    VectorRuntime,
+    actor_method,
+    replicated_worker,
+)
+from orleans_tpu.parallel import make_mesh
+
+
+@replicated_worker
+class HitCounter(VectorGrain):
+    """Stateless-worker aggregate: per-shard local counters, cluster view
+    by collective merge."""
+
+    STATE = {"hits": (jnp.int32, ()), "peak": (jnp.int32, ())}
+    MERGE = {"hits": "sum", "peak": "max"}
+
+    @staticmethod
+    def initial_state(key_hash):
+        return {"hits": jnp.int32(0), "peak": jnp.int32(0)}
+
+    @actor_method(args={"amount": (jnp.int32, ())})
+    def record(state, args):
+        new = {"hits": state["hits"] + 1,
+               "peak": jnp.maximum(state["peak"], args["amount"])}
+        return new, new["hits"]
+
+
+def test_replicated_worker_requires_merge_spec():
+    with pytest.raises(TypeError, match="MERGE"):
+        @replicated_worker
+        class Bad(VectorGrain):
+            STATE = {"x": (jnp.int32, ())}
+
+    with pytest.raises(TypeError, match="unknown merge"):
+        @replicated_worker
+        class Bad2(VectorGrain):
+            STATE = {"x": (jnp.int32, ())}
+            MERGE = {"x": "avg"}
+
+
+def test_round_robin_spreads_work_and_merge_folds_replicas():
+    rt = VectorRuntime(mesh=make_mesh(8))
+    host = rt.replicated_host(HitCounter, n_keys=16)
+    n = host.n_shards
+    assert n == 8
+
+    # 64 calls to ONE key: with an owned table this is one actor's mailbox;
+    # as a stateless worker the calls spread over all 8 shards
+    keys = np.zeros(64, dtype=np.int64)
+    amounts = np.arange(64, dtype=np.int32)
+    host.call_batch("record", keys, {"amount": amounts})
+
+    merged = host.read_merged(np.array([0]))
+    # sum-merge: every shard counted its local share — cluster total 64
+    assert int(merged["hits"][0]) == 64
+    # max-merge: the cluster-wide peak is the global max amount
+    assert int(merged["peak"][0]) == 63
+    # replicas really are independent (each shard saw 8 of the 64 calls)
+    per_shard = np.asarray(host.state["hits"][:, 0])
+    assert per_shard.tolist() == [8] * 8
+
+
+def test_many_keys_and_read_only_merge():
+    rt = VectorRuntime(mesh=make_mesh(8))
+    host = rt.replicated_host(HitCounter, n_keys=32)
+    rng = np.random.default_rng(7)
+    keys = rng.integers(0, 32, size=400)
+    amounts = rng.integers(0, 1000, size=400).astype(np.int32)
+    out = host.call_batch("record", keys,
+                          {"amount": amounts})
+    assert out.shape == (400,)
+
+    merged = host.read_merged(np.arange(32))
+    counts = np.bincount(keys, minlength=32)
+    assert np.asarray(merged["hits"]).tolist() == counts.tolist()
+    for k in range(32):
+        want = int(amounts[keys == k].max()) if counts[k] else 0
+        assert int(merged["peak"][k]) == want
+
+
+@replicated_worker
+class Quota(VectorGrain):
+    """Nonzero initial state + a read-only method: the read-only first
+    touch must not burn the fresh flag (donation + activation guards)."""
+
+    STATE = {"left": (jnp.int32, ())}
+    MERGE = {"left": "min"}
+
+    @staticmethod
+    def initial_state(key_hash):
+        return {"left": jnp.int32(100)}
+
+    @actor_method(args={}, read_only=True)
+    def peek(state, args):
+        return state, state["left"]
+
+    @actor_method(args={"n": (jnp.int32, ())})
+    def take(state, args):
+        new = {"left": state["left"] - args["n"]}
+        return new, new["left"]
+
+
+def test_read_only_first_touch_keeps_initial_state():
+    rt = VectorRuntime(mesh=make_mesh(2))
+    host = rt.replicated_host(Quota, n_keys=4)
+    # read-only first touch sees initial_state without persisting it
+    out = host.call_batch("peek", np.array([1]))
+    assert int(out[0]) == 100
+    # the first WRITE still runs initial_state (fresh flag intact) — and
+    # state stays usable after the read-only tick (donation guard)
+    out = host.call_batch("take", np.array([1]), {"n": np.array([30],
+                                                                np.int32)})
+    assert int(out[0]) == 70
+
+
+def test_key_range_and_rehost_validation():
+    rt = VectorRuntime(mesh=make_mesh(2))
+    host = rt.replicated_host(Quota, n_keys=4)
+    with pytest.raises(ValueError, match=r"\[0, 4\)"):
+        host.call_batch("peek", np.array([-1]))
+    with pytest.raises(ValueError, match=r"\[0, 4\)"):
+        host.read_merged(np.array([4]))
+    with pytest.raises(ValueError, match="already hosted"):
+        rt.replicated_host(Quota, n_keys=8)
+    with pytest.raises(TypeError, match="args mismatch"):
+        host.call_batch("take", np.array([0]),
+                        {"wrong": np.array([1], np.int32)})
+
+
+def test_single_shard_mesh_degenerates_cleanly():
+    rt = VectorRuntime(mesh=make_mesh(1))
+    host = rt.replicated_host(HitCounter, n_keys=4)
+    host.call_batch("record", np.array([1, 1, 2]),
+                    {"amount": np.array([5, 9, 3], np.int32)})
+    merged = host.read_merged(np.array([1, 2, 3]))
+    assert merged["hits"].tolist() == [2, 1, 0]
+    assert merged["peak"].tolist() == [9, 3, 0]
