@@ -141,12 +141,25 @@ class LoadSheddingOptions:
 @dataclass
 class DirectoryOptions:
     """Grain-directory caching (GrainDirectoryOptions: CachingStrategy,
-    CacheSize)."""
+    CacheSize; adaptive per-entry TTLs per
+    AdaptiveGrainDirectoryCache.cs:178 + the maintainer's refresh loop,
+    AdaptiveDirectoryCacheMaintainer.cs:243)."""
 
     cache_size: int = 100_000
+    cache_initial_ttl: float = 5.0     # seconds; doubles on revalidation
+    cache_max_ttl: float = 120.0
+    cache_refresh_period: float = 2.0  # maintainer sweep; 0 disables
 
     def validate(self) -> None:
-        _positive(self, "cache_size")
+        _positive(self, "cache_size", "cache_initial_ttl", "cache_max_ttl")
+        if self.cache_initial_ttl > self.cache_max_ttl:
+            raise ConfigurationError(
+                "directory cache_initial_ttl must be <= cache_max_ttl "
+                f"(got {self.cache_initial_ttl} > {self.cache_max_ttl})")
+        if self.cache_refresh_period < 0:
+            raise ConfigurationError(
+                "directory cache_refresh_period must be >= 0 "
+                "(0 disables the maintainer)")
 
 
 @dataclass
@@ -184,6 +197,10 @@ _FLAT_MAP = {
     "membership_refresh_period": (MembershipOptions, "refresh_period"),
     "membership_vote_expiration": (MembershipOptions, "vote_expiration"),
     "directory_cache_size": (DirectoryOptions, "cache_size"),
+    "directory_cache_initial_ttl": (DirectoryOptions, "cache_initial_ttl"),
+    "directory_cache_max_ttl": (DirectoryOptions, "cache_max_ttl"),
+    "directory_cache_refresh_period": (DirectoryOptions,
+                                       "cache_refresh_period"),
     "load_shedding_enabled": (LoadSheddingOptions, "enabled"),
     "load_shedding_limit": (LoadSheddingOptions, "limit"),
 }

@@ -18,7 +18,6 @@ owner's DirectoryTarget.
 from __future__ import annotations
 
 import asyncio
-import collections
 import logging
 from typing import TYPE_CHECKING
 
@@ -85,6 +84,18 @@ class DirectoryTarget:
             self.locator.local_register(addr)
         return True
 
+    async def dir_lookup_many(self, grain_ids: list) -> list:
+        """Batched owner lookup for the adaptive-cache maintainer
+        (AdaptiveDirectoryCacheMaintainer.cs:243 batches per owner):
+        current hosting silo per grain, None where no live registration
+        exists."""
+        out = []
+        for gid in grain_ids:
+            reg = self.locator.partition.get(gid)
+            out.append(reg.silo if reg is not None
+                       and reg.silo in self.locator.alive_set else None)
+        return out
+
 
 class DistributedLocator:
     """Implements the silo locator protocol over a ring-partitioned
@@ -96,9 +107,13 @@ class DistributedLocator:
         self.alive_set: set[SiloAddress] = {silo.silo_address}
         self.alive_list: list[SiloAddress] = [silo.silo_address]
         self.partition: dict[GrainId, ActivationAddress] = {}
-        self.cache: collections.OrderedDict[GrainId, SiloAddress] = \
-            collections.OrderedDict()
+        from .adaptive_cache import AdaptiveDirectoryCache
+        self.cache = AdaptiveDirectoryCache(
+            silo.config.directory_cache_size,
+            initial_ttl=silo.config.directory_cache_initial_ttl,
+            max_ttl=silo.config.directory_cache_max_ttl)
         self.cache_size = silo.config.directory_cache_size
+        self._maintainer_task = None  # started by Silo.start
         self.placement = PlacementManager(load_of=self._load_of)
         from ..versions import VersionManager
         from ..versions.manager import TYPE_MANAGER_TARGET
@@ -153,9 +168,8 @@ class DistributedLocator:
         if grain_class is not None and \
                 getattr(grain_class, "__orleans_stateless_worker__", 0):
             return self.silo.silo_address  # stateless workers host locally
-        cached = self.cache.get(grain_id)
+        cached = self.cache.get(grain_id)  # TTL-aware: expired reads miss
         if cached is not None and cached in self.alive_set:
-            self.cache.move_to_end(grain_id)
             return cached
         owner = self.ring.owner(grain_id.uniform_hash) or self.silo.silo_address
         if owner != self.silo.silo_address:
@@ -298,10 +312,60 @@ class DistributedLocator:
             self.partition.pop(address.grain, None)
 
     def _cache_put(self, grain_id: GrainId, silo: SiloAddress) -> None:
-        self.cache[grain_id] = silo
-        self.cache.move_to_end(grain_id)
-        while len(self.cache) > self.cache_size:
-            self.cache.popitem(last=False)
+        self.cache.put(grain_id, silo)
+
+    # ------------------------------------------------------------------
+    # Adaptive-cache maintainer (AdaptiveDirectoryCacheMaintainer.cs:243)
+    # ------------------------------------------------------------------
+    def start_cache_maintainer(self) -> None:
+        if self._maintainer_task is None and \
+                self.silo.config.directory_cache_refresh_period > 0:
+            self._maintainer_task = asyncio.get_running_loop().create_task(
+                self._maintainer_loop())
+
+    def stop_cache_maintainer(self) -> None:
+        if self._maintainer_task is not None:
+            self._maintainer_task.cancel()
+            self._maintainer_task = None
+
+    async def _maintainer_loop(self) -> None:
+        period = self.silo.config.directory_cache_refresh_period
+        while True:
+            await asyncio.sleep(period)
+            try:
+                await self._refresh_hot_entries(period)
+            except Exception:  # noqa: BLE001 — next sweep retries
+                log.debug("directory cache refresh failed", exc_info=True)
+
+    async def _refresh_hot_entries(self, horizon: float) -> None:
+        """Refresh entries accessed since the last sweep that are expired
+        or expiring within one period: batch per directory owner, fold
+        answers back (same silo → TTL doubles; moved → reset; gone →
+        drop). Hot routes stay fresh instead of paying staleness in
+        forward hops."""
+        gids = self.cache.sweep_candidates(horizon)
+        if not gids:
+            return
+        me = self.silo.silo_address
+        by_owner: dict[SiloAddress, list[GrainId]] = {}
+        for gid in gids:
+            owner = self.ring.owner(gid.uniform_hash)
+            if owner is not None:
+                by_owner.setdefault(owner, []).append(gid)
+        for owner, batch in by_owner.items():
+            if owner == me:
+                results = await self.target.dir_lookup_many(batch)
+            else:
+                try:
+                    results = await self._target_ref(
+                        owner, "dir_lookup_many", batch)
+                except Exception:  # noqa: BLE001 — owner mid-death: the
+                    # membership sweep clears its range; skip this batch
+                    continue
+            for gid, silo in zip(batch, results, strict=True):
+                self.cache.refresh_result(gid, silo)
+            self.silo.stats.increment("directory.cache.refreshed",
+                                      len(batch))
 
     # ------------------------------------------------------------------
     # Membership events (LocalGrainDirectory.cs:431-460 + handoff manager)

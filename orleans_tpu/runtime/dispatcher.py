@@ -593,6 +593,10 @@ class Dispatcher:
             msg.target_silo = None
             msg.target_activation = None
             self.silo.locator.invalidate_cache(msg.target_grain)
+            # hot-path statistics discipline (MessagingStatisticsGroup):
+            # forward rate is THE staleness signal the adaptive directory
+            # cache exists to suppress — it must be observable
+            self.silo.stats.increment("messaging.forwarded")
             self.send_message(msg)
         else:
             self._reject(msg, RejectionType.TRANSIENT,

@@ -110,6 +110,12 @@ class SiloConfig:
     membership_refresh_period: float = 5.0
     membership_vote_expiration: float = 10.0
     directory_cache_size: int = 100_000
+    # adaptive directory cache (AdaptiveGrainDirectoryCache.cs:178):
+    # per-entry TTL doubles on revalidation up to the max; the maintainer
+    # refreshes hot entries every refresh period (0 disables the loop)
+    directory_cache_initial_ttl: float = 5.0
+    directory_cache_max_ttl: float = 120.0
+    directory_cache_refresh_period: float = 2.0
     turn_warning_length: float = 0.2  # TurnWarningLengthThreshold
     # run new turn tasks eagerly to their first suspension
     # (asyncio.eager_task_factory): a turn that completes without awaiting
@@ -464,6 +470,9 @@ class Silo:
             getattr(self.locator, "versions", None), "start_exchange", None)
         if start_exchange is not None:
             start_exchange()  # cluster type-map refresh (TypeManager)
+        start_maint = getattr(self.locator, "start_cache_maintainer", None)
+        if start_maint is not None:
+            start_maint()  # adaptive directory-cache refresh loop
         self.fabric.register_silo(self)
         for stage, start, _ in sorted(self._lifecycle, key=lambda x: x[0]):
             r = start()
@@ -512,6 +521,9 @@ class Silo:
             getattr(self.locator, "versions", None), "stop_exchange", None)
         if stop_exchange is not None:
             stop_exchange()
+        stop_maint = getattr(self.locator, "stop_cache_maintainer", None)
+        if stop_maint is not None:
+            stop_maint()
         self.message_center.stop()
         self.runtime_client.close()
         self.fabric.unregister_silo(self, dead=not graceful)

@@ -1,0 +1,168 @@
+"""Adaptive directory cache: per-entry TTLs that double on revalidation,
+expired entries read as misses, and the maintainer refreshes hot entries
+so staleness is repaired proactively instead of paid in forward hops
+(AdaptiveGrainDirectoryCache.cs:178, AdaptiveDirectoryCacheMaintainer.cs:243)."""
+
+import asyncio
+
+from orleans_tpu.directory.adaptive_cache import AdaptiveDirectoryCache
+from orleans_tpu.runtime import Grain
+from orleans_tpu.runtime.grain import placement
+from orleans_tpu.testing import TestClusterBuilder
+
+# ---------------------------------------------------------------------------
+# Unit: the cache's adaptive behavior under an injected clock
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_ttl_doubles_on_revalidation_and_resets_on_change():
+    clk = FakeClock()
+    c = AdaptiveDirectoryCache(10, initial_ttl=1.0, max_ttl=8.0, clock=clk)
+    c.put("g", "silo-a")
+    assert c.get("g") == "silo-a"
+    # same answer re-confirmed: TTL 1 → 2 → 4 → 8 → capped at 8
+    for want in (2.0, 4.0, 8.0, 8.0):
+        c.put("g", "silo-a")
+        assert c._d["g"].ttl == want
+    # a CHANGED answer resets to the initial TTL
+    c.put("g", "silo-b")
+    assert c._d["g"].ttl == 1.0
+    assert c.get("g") == "silo-b"
+
+
+def test_expired_entry_reads_as_miss_but_stays_for_maintainer():
+    clk = FakeClock()
+    c = AdaptiveDirectoryCache(10, initial_ttl=1.0, clock=clk)
+    c.put("g", "silo-a")
+    clk.t = 1.5
+    assert c.get("g") is None       # expired → miss
+    assert "g" in c                 # but resident (maintainer's signal)
+    assert c.expired_hits == 1
+    # the re-resolve confirming the same answer doubles the TTL
+    c.put("g", "silo-a")
+    assert c._d["g"].ttl == 2.0
+    assert c.get("g") == "silo-a"
+
+
+def test_sweep_candidates_only_accessed_and_expiring():
+    clk = FakeClock()
+    c = AdaptiveDirectoryCache(10, initial_ttl=1.0, clock=clk)
+    c.put("hot-expiring", "a")
+    c.put("hot-fresh", "a")
+    c.put("cold", "a")
+    # revalidate hot-fresh so its TTL is long
+    c.put("hot-fresh", "a")   # ttl 2.0
+    c.get("hot-expiring")
+    c.get("hot-fresh")        # both accessed; cold untouched
+    clk.t = 0.9               # hot-expiring expires at 1.0, fresh at 2.0
+    got = c.sweep_candidates(horizon=0.3)
+    assert got == ["hot-expiring"]
+    # accessed marks are consumed by the sweep
+    assert c.sweep_candidates(horizon=0.3) == []
+
+
+def test_refresh_result_semantics():
+    clk = FakeClock()
+    c = AdaptiveDirectoryCache(10, initial_ttl=1.0, clock=clk)
+    c.put("g1", "a")
+    c.put("g2", "a")
+    c.put("g3", "a")
+    c.refresh_result("g1", "a")     # confirmed → TTL doubles
+    c.refresh_result("g2", "b")     # moved → replaced at initial TTL
+    c.refresh_result("g3", None)    # gone → dropped
+    assert c._d["g1"].ttl == 2.0
+    assert c.get("g2") == "b" and c._d["g2"].ttl == 1.0
+    assert "g3" not in c
+
+
+def test_lru_bound_holds():
+    c = AdaptiveDirectoryCache(3, initial_ttl=10.0)
+    for i in range(6):
+        c.put(i, "s")
+    assert len(c) == 3 and 5 in c and 0 not in c
+
+
+# ---------------------------------------------------------------------------
+# Cluster: the maintainer repairs stale routes before traffic pays forwards
+# ---------------------------------------------------------------------------
+
+@placement("prefer_local")
+class Backend(Grain):
+    async def ping(self) -> str:
+        return self.runtime_identity
+
+
+@placement("prefer_local")
+class Frontend(Grain):
+    async def fan(self, keys) -> list:
+        return list(await asyncio.gather(
+            *(self.get_grain(Backend, k).ping() for k in keys)))
+
+
+async def _forward_churn_run(initial_ttl, refresh_period, max_ttl=600.0):
+    """Returns forwards counted on the caller silo during a post-churn
+    burst. Churn = every Backend deactivates and reactivates on a
+    DIFFERENT silo while the caller's cache still points at the old one."""
+    N = 24
+    cluster = await (
+        TestClusterBuilder(n_silos=3)
+        .add_grains(Backend, Frontend)
+        .configure_silo(lambda b: b.with_config(
+            directory_cache_initial_ttl=initial_ttl,
+            directory_cache_max_ttl=max_ttl,
+            directory_cache_refresh_period=refresh_period))
+        .build().deploy())
+    try:
+        s0, s1, s2 = cluster.silos
+        keys = list(range(N))
+        # frontends pinned per silo (prefer_local)
+        await s1.grain_factory.get_grain(Frontend, 1).fan(keys)
+        # burst through silo0: populates + marks silo0's cache entries
+        await s0.grain_factory.get_grain(Frontend, 0).fan(keys)
+        await s0.grain_factory.get_grain(Frontend, 0).fan(keys)
+
+        # churn: deactivate every Backend (wherever it lives) ...
+        for silo in cluster.silos:
+            for gid, acts in list(silo.catalog.by_grain.items()):
+                for act in list(acts):
+                    if isinstance(act.grain_instance, Backend):
+                        silo.catalog.schedule_deactivation(act)
+        await asyncio.sleep(0.3)
+        # ... and reactivate them all via silo2 (prefer_local → silo2),
+        # so silo0's cached routes are stale-but-alive
+        await s2.grain_factory.get_grain(Frontend, 2).fan(keys)
+
+        # give the maintainer (if enabled) time for ≥2 sweeps
+        await asyncio.sleep(max(0.8, 3 * refresh_period))
+
+        def total_forwards():
+            # a stale route pays its forward on the RECEIVING silo
+            return sum(s.stats.get("messaging.forwarded") or 0
+                       for s in cluster.silos)
+
+        before = total_forwards()
+        await s0.grain_factory.get_grain(Frontend, 0).fan(keys)
+        return total_forwards() - before
+    finally:
+        await cluster.stop_all()
+
+
+async def test_maintainer_suppresses_forward_hops_under_churn():
+    # plain-LRU behavior: huge TTL, no maintainer → stale entries pay a
+    # forward hop each on first touch after the churn
+    baseline = await _forward_churn_run(initial_ttl=300.0,
+                                        refresh_period=0.0)
+    # adaptive behavior: short TTL + maintainer sweeps repair the routes
+    # before the burst
+    adaptive = await _forward_churn_run(initial_ttl=0.5,
+                                        refresh_period=0.25)
+    assert baseline >= 12, f"churn harness produced no staleness: {baseline}"
+    assert adaptive <= baseline // 4, (adaptive, baseline)
