@@ -120,7 +120,7 @@ async def bench_cross_silo(client, silo1, silo2, concurrency: int,
 
 
 async def run(concurrency: int, seconds: float, n_grains: int,
-              tmpdir: str) -> None:
+              tmpdir: str) -> list[dict]:
     import os
     table = FileMembershipTable(os.path.join(tmpdir, "mbr.json"))
     fabric1, fabric2 = SocketFabric(), SocketFabric()
@@ -142,15 +142,13 @@ async def run(concurrency: int, seconds: float, n_grains: int,
                 await asyncio.sleep(0.05)
         await asyncio.wait_for(converged(), timeout=15.0)
 
-        print(json.dumps(await bench_gateway(
-            silo1.silo_address.endpoint, concurrency, seconds, n_grains)),
-            flush=True)
-
+        results = [await bench_gateway(
+            silo1.silo_address.endpoint, concurrency, seconds, n_grains)]
         client = await GatewayClient(
             [silo1.silo_address.endpoint], response_timeout=30.0).connect()
-        print(json.dumps(await bench_cross_silo(
-            client, silo1, silo2, concurrency, seconds, n_grains)),
-            flush=True)
+        results.append(await bench_cross_silo(
+            client, silo1, silo2, concurrency, seconds, n_grains))
+        return results
     finally:
         if client is not None:
             await client.close_async()
@@ -166,7 +164,9 @@ def main() -> None:
     args = p.parse_args()
     import tempfile
     with tempfile.TemporaryDirectory() as td:
-        asyncio.run(run(args.concurrency, args.seconds, args.grains, td))
+        for r in asyncio.run(
+                run(args.concurrency, args.seconds, args.grains, td)):
+            print(json.dumps(r), flush=True)
 
 
 if __name__ == "__main__":

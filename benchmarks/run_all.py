@@ -37,8 +37,9 @@ def main() -> None:
                                                   concurrency=32))))
     import tempfile
     with tempfile.TemporaryDirectory() as td:
-        asyncio.run(ping_socket.run(concurrency=64, seconds=3.0,
-                                    n_grains=200, tmpdir=td))
+        for r in asyncio.run(ping_socket.run(concurrency=64, seconds=3.0,
+                                             n_grains=200, tmpdir=td)):
+            print(json.dumps(r))
     print(json.dumps(chirper_fanout.run(seconds=5.0)))
     for r in asyncio.run(gpstracker_stream.run(seconds=2.0)):
         print(json.dumps(r))

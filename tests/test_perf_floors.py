@@ -1,0 +1,59 @@
+"""Loose CI performance floors: a regression on a hot path cannot land
+silently (VERDICT r3 ask #8; the reference's BVT gating discipline,
+test/Benchmarks/Ping/PingBenchmark.cs:35-46).
+
+Floors are HALF-BAND values — deliberately far below the documented
+medians (RESULTS_r3/r4) so single-shared-core noise can't flake them,
+while a real regression (2x slowdown) still trips. Each check takes the
+best of two short runs for the same reason. The >=1M events/sec stream
+floor lives in test_vector_streams.py."""
+
+from benchmarks import ping, ping_socket, transactions
+
+# floor, documented band (single shared core, JAX_PLATFORMS=cpu)
+TXN_FLOOR = 2_500          # band 3.7-4.7k @ c=32 (RESULTS_r4, 5 runs)
+HOST_PING_FLOOR = 30_000   # band ~38-51k calls/sec
+GATEWAY_FLOOR = 8_000      # band ~13-16k calls/sec over real sockets
+CROSS_SILO_FLOOR = 4_000   # band ~6-8k calls/sec
+
+
+async def _floor_check(fn, floor, label):
+    v = await fn()
+    if v < floor * 1.25:
+        # close call (or failing): noise guard — retry once, take best
+        v = max(v, await fn())
+    assert v >= floor, f"{label} {v:.0f}/s below floor {floor}"
+
+
+async def test_floor_transactions_c32():
+    async def once():
+        r = await transactions.run(n_accounts=32, concurrency=32,
+                                   seconds=2.0)
+        return r["value"]
+    await _floor_check(once, TXN_FLOOR, "transactions")
+
+
+async def test_floor_host_ping():
+    async def once():
+        r = await ping.bench_host_tier(n_grains=256, concurrency=100,
+                                       seconds=2.0)
+        return r["value"]
+    await _floor_check(once, HOST_PING_FLOOR, "host ping")
+
+
+async def test_floor_socket_gateway_and_cross_silo(tmp_path):
+    gw_best = cs_best = 0.0
+    for attempt in range(2):
+        d = tmp_path / str(attempt)
+        d.mkdir(exist_ok=True)
+        gateway, cross = await ping_socket.run(
+            concurrency=64, seconds=2.0, n_grains=128, tmpdir=str(d))
+        gw_best = max(gw_best, gateway["value"])
+        cs_best = max(cs_best, cross["value"])
+        if gw_best >= GATEWAY_FLOOR * 1.25 and \
+                cs_best >= CROSS_SILO_FLOOR * 1.25:
+            break  # comfortably clear: skip the noise-guard retry
+    assert gw_best >= GATEWAY_FLOOR, \
+        f"gateway {gw_best:.0f}/s below floor {GATEWAY_FLOOR}"
+    assert cs_best >= CROSS_SILO_FLOOR, \
+        f"cross-silo {cs_best:.0f}/s below floor {CROSS_SILO_FLOOR}"
