@@ -28,6 +28,7 @@ import numpy as np
 __all__ = [
     "Immutable", "deep_copy", "serialize", "deserialize",
     "allow_wire_modules", "ArrayField", "ArraySchema", "register_copier",
+    "register_wire_codec", "unregister_wire_codec",
 ]
 
 
@@ -107,6 +108,95 @@ def copy_result(result: Any) -> Any:
     return deep_copy(result)
 
 
+# -- external-serializer seam ------------------------------------------------
+# The reference swaps whole serializers per type (Orleans.Serialization.Bond/
+# Orleans.Serialization.Protobuf, registered through
+# SerializationManager.cs:173-201). Here a registered codec routes its type
+# through custom bytes WHEREVER values cross the wire tier: the pickle path
+# uses a reducer_override, and the native hotwire codec's per-value escape
+# hook goes through the same pickler — one registry covers both builds.
+# Decoding reconstructs via _ext_restore (an orleans_tpu function, so the
+# restricted unpickler admits it); a frame naming a codec the receiving
+# process has not registered fails LOUDLY at decode.
+
+_ext_codecs: dict[str, tuple[type, Callable[[Any], bytes],
+                             Callable[[bytes], Any]]] = {}
+_ext_by_type: dict[type, str] = {}
+# exact-type → __reduce__-shaped fn, installed as a Pickler dispatch_table:
+# C-speed per-type lookup, so unregistered payloads keep plain-pickle speed
+_ext_dispatch: dict[type, Callable] = {}
+
+# types the picklers/hotwire encode via built-in fast paths that never
+# consult a dispatch table — a codec registered for one of these would be
+# silently ignored, so reject it loudly instead
+_EXT_UNROUTABLE = (list, dict, tuple, set, frozenset, str, bytes,
+                   bytearray, int, float, bool, complex, type(None))
+
+
+def register_wire_codec(name: str, typ: type,
+                        encode: Callable[[Any], bytes],
+                        decode: Callable[[bytes], Any]) -> None:
+    """Route ``typ`` through a custom wire codec (the external-serializer
+    registration seam). ``encode(obj) -> bytes`` / ``decode(bytes) -> obj``
+    must be registered under the same ``name`` on every process that
+    decodes such frames (exactly the reference's per-type serializer
+    registration contract). Exact-type match — subclasses are not
+    implicitly routed. One name per type; builtin container/scalar types
+    are rejected (their fast paths bypass any dispatch).
+
+    Scope: the WIRE/blob tier only. Same-silo calls copy-isolate through
+    :func:`deep_copy`; a type that cannot survive ``copy.deepcopy`` (C
+    handles, mmaps) needs a separate :func:`register_copier`."""
+    if typ in _EXT_UNROUTABLE:
+        raise ValueError(
+            f"cannot route builtin type {typ.__name__} through a wire "
+            f"codec: the pickler/hotwire fast paths never consult the "
+            f"dispatch table for it")
+    if name in _ext_codecs and _ext_codecs[name][0] is not typ:
+        raise ValueError(f"wire codec {name!r} already registered for "
+                         f"{_ext_codecs[name][0].__name__}")
+    prior = _ext_by_type.get(typ)
+    if prior is not None and prior != name:
+        raise ValueError(
+            f"{typ.__name__} already routes through codec {prior!r}; one "
+            f"codec per type (unregister it first)")
+    _ext_codecs[name] = (typ, encode, decode)
+    _ext_by_type[typ] = name
+
+    def reduce_(obj, _n=name, _e=encode):
+        return (_ext_restore, (_n, _e(obj)))
+
+    _ext_dispatch[typ] = reduce_
+
+
+def unregister_wire_codec(name: str) -> None:
+    entry = _ext_codecs.pop(name, None)
+    if entry is not None and _ext_by_type.get(entry[0]) == name:
+        _ext_by_type.pop(entry[0], None)
+        _ext_dispatch.pop(entry[0], None)
+
+
+def _ext_restore(name: str, payload: bytes) -> Any:
+    entry = _ext_codecs.get(name)
+    if entry is None:
+        raise pickle.UnpicklingError(
+            f"frame uses wire codec {name!r}, which this process has not "
+            f"registered (register_wire_codec on every decoding silo)")
+    return entry[2](payload)
+
+
+def _pickle_dumps(obj: Any) -> bytes:
+    """Pickle with the external-codec seam applied (identical to plain
+    pickle.dumps when no codecs are registered)."""
+    if not _ext_dispatch:
+        return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    buf = io.BytesIO()
+    p = pickle.Pickler(buf, protocol=pickle.HIGHEST_PROTOCOL)
+    p.dispatch_table = _ext_dispatch
+    p.dump(obj)
+    return buf.getvalue()
+
+
 def serialize(obj: Any) -> bytes:
     """Wire-tier encode (fallback-serializer slot, ``SerializationManager.cs:50``).
 
@@ -127,8 +217,8 @@ def serialize(obj: Any) -> bytes:
             return _hotwire.dumps(obj)
         except ValueError:
             # cyclic / pathologically deep payload: pickle's memo handles it
-            return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+            return _pickle_dumps(obj)
+    return _pickle_dumps(obj)
 
 
 # Module roots the wire-tier decoder will instantiate. Anything else is
@@ -179,8 +269,10 @@ def serialize_portable(obj: Any) -> bytes:
     so the bytes remain readable in a process where the native codec is
     unavailable (``deserialize`` dispatches on the magic byte either way).
     Wire frames die with the connection; storage blobs outlive the encoding
-    process, so they must not depend on the toolchain being present."""
-    return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    process, so they must not depend on the toolchain being present.
+    Registered external codecs apply here too — their registration is part
+    of the deployment, same as the type allowlist."""
+    return _pickle_dumps(obj)
 
 
 def members_by_value(enum_cls) -> tuple:
@@ -230,7 +322,10 @@ def _load_hotwire():
     cat_members = members_by_value(GrainCategory)
 
     def _escape_dumps(obj: Any) -> bytes:
-        return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        # per-value escape for types hotwire doesn't encode natively —
+        # the external-codec seam applies here so registered types route
+        # through their custom bytes under the native build too
+        return _pickle_dumps(obj)
 
     hw.configure(GrainId, cat_members, SiloAddress, ActivationId,
                  ActivationAddress, _escape_dumps, _restricted_pickle_loads)
