@@ -20,6 +20,7 @@ from benchmarks import (
     ping,
     ping_socket,
     serialization,
+    streams_durable,
     streams_vector,
     transactions,
 )
@@ -44,6 +45,8 @@ def main() -> None:
     for r in asyncio.run(gpstracker_stream.run(seconds=2.0)):
         print(json.dumps(r))
     print(json.dumps(asyncio.run(streams_vector.run(n_keys=50_000))))
+    for r in asyncio.run(streams_durable.run(seconds=3.0)):
+        print(json.dumps(r))
 
 
 if __name__ == "__main__":

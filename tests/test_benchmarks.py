@@ -4,7 +4,7 @@ TestCluster). Correctness assertions inside each harness (echo values,
 word-count table, balance conservation) are the point; speed is not."""
 
 from benchmarks import chirper_fanout, gpstracker_stream, mapreduce, ping, \
-    serialization, transactions
+    serialization, streams_durable, transactions
 
 
 def _check(r: dict) -> None:
@@ -33,6 +33,12 @@ async def test_transactions_harness():
     r = await transactions.run(n_accounts=8, concurrency=3, seconds=0.3)
     _check(r)
     assert r["extra"]["committed"] > 0
+
+
+async def test_streams_durable_harness(tmp_path):
+    for r in await streams_durable.run(seconds=0.3, batch=16,
+                                       db_path=str(tmp_path / "q.db")):
+        _check(r)
 
 
 async def test_gpstracker_harness():
