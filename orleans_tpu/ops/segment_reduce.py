@@ -19,9 +19,15 @@ classic TPU anti-pattern. Both implementations here instead ride the MXU:
     in the output block (grid is sequential on TPU), so HBM traffic is
     one read of values/ids + one write of out.
 
-``segment_sum`` picks the Pallas path on TPU for well-tiled shapes and the
-one-hot path otherwise (and everywhere on CPU, where Pallas runs in
-interpret mode only for tests).
+``segment_sum`` picks the Pallas path on TPU for well-tiled shapes, the
+one-hot path for other TPU shapes, and a plain scatter-add on non-TPU
+backends (where the one-hot operand is pure overhead — the scatter IS the
+fast path there; Pallas runs in interpret mode only for tests).
+
+Accumulation note: the MXU paths accumulate in float32, exact for integer
+values only below 2^24 per segment; the CPU scatter path sums exactly in
+the input dtype. Per-segment totals beyond 2^24 should accumulate across
+calls in caller state (as the bench's GameGrain does), not per call.
 """
 
 from __future__ import annotations
@@ -111,12 +117,20 @@ def segment_sum_pallas(values: jax.Array, seg_ids: jax.Array,
 
 def segment_sum(values: jax.Array, seg_ids: jax.Array,
                 num_segments: int) -> jax.Array:
-    """Fan-in reduction, MXU-shaped. Dispatches to the Pallas kernel on TPU
-    when the shape tiles well; the fused one-hot matmul otherwise."""
-    v2, _ = _as_2d(values)
-    B, D = v2.shape
+    """Fan-in reduction, backend-dispatched: the Pallas MXU kernel on TPU
+    when the shape tiles well, the fused one-hot matmul for other TPU
+    shapes (scatter-add is the weak op there), and a plain scatter-add
+    everywhere else — on CPU the one-hot path materializes an O(B×S)
+    operand for no benefit (measured 2.3× slower at B=156k, S=128 in the
+    multi-shard bench's fan-in)."""
+    v2, _ = _as_2d(values)  # enforce the [B]/[B,D] contract on EVERY
+    # backend, so shapes that would fail on TPU fail on CPU too
     on_tpu = jax.default_backend() == "tpu"
-    if on_tpu and B >= 1024 and num_segments >= 256 and D % 128 == 0:
+    if not on_tpu:
+        return jax.ops.segment_sum(values, seg_ids,
+                                   num_segments=num_segments)
+    B, D = v2.shape
+    if B >= 1024 and num_segments >= 256 and D % 128 == 0:
         return segment_sum_pallas(values, seg_ids, num_segments,
                                   interpret=False)
     return segment_sum_onehot(values, seg_ids, num_segments)

@@ -101,6 +101,16 @@ async def test_chirper_fan_out_and_graph_updates():
         assert len(await followers[1].timeline()) == 2
 
 
+async def test_telemetry_sample_end_to_end():
+    """samples/telemetry.py: durable sqlite ingest, live + rewound
+    dashboards (replay beyond the tiny cache window), mesh-replicated
+    endpoint meters with collective read fan-in, custom wire codec."""
+    import telemetry
+    report = await telemetry.main(n_devices=20, rounds=3)
+    assert report["replayed"] >= report["ingested"]
+    assert sum(report["requests_by_endpoint"]) == report["ingested"]
+
+
 async def test_bank_sample_end_to_end():
     """samples/bank.py: atomic audited transfers, over-draw rollback,
     cancellable sweep, batch audit ledger — run the sample's own main."""
