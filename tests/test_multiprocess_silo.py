@@ -14,6 +14,7 @@ import signal
 import subprocess
 import sys
 import textwrap
+import time
 
 import pytest
 
@@ -70,6 +71,38 @@ CHILD = textwrap.dedent("""
 """)
 
 
+async def _converged(silo, n):
+    """Parent-side convergence: membership and placement views both at n."""
+    while len(silo.membership.active) != n or \
+            len(silo.locator.alive_list) != n:
+        await asyncio.sleep(0.05)
+
+
+async def _spread_over_both(client, parent_ep, deadline_s=20.0):
+    """Touch grains until placement lands some in EACH process and return
+    (child_endpoint, child_keys). The parent cannot observe the CHILD's
+    membership view, and a child that has not yet refreshed to see the
+    parent places its directory share on itself — so the first batch can
+    legitimately land one-sided under load. The subject of these tests is
+    the process boundary, not first-try placement, so spread is awaited
+    with fresh keys per attempt."""
+    deadline = time.monotonic() + deadline_s
+    base = 0
+    while True:
+        keys = list(range(base, base + 32))
+        wheres = await asyncio.gather(
+            *(client.get_grain(EchoGrain, k).where() for k in keys))
+        endpoints = set(wheres)
+        if len(endpoints) == 2:
+            child_ep = next(e for e in endpoints if e != parent_ep)
+            return child_ep, [k for k, w in zip(keys, wheres)
+                              if w == child_ep]
+        assert time.monotonic() < deadline, \
+            f"placement never spread over both processes: {endpoints}"
+        base += 32
+        await asyncio.sleep(0.5)
+
+
 async def test_mixed_build_cluster_negotiates_codec(tmp_path):
     """A silo whose native hotwire build is unavailable must interoperate
     with native-enabled peers: the handshake advertises codec support and
@@ -104,25 +137,16 @@ async def test_mixed_build_cluster_negotiates_codec(tmp_path):
         join_cluster(silo, table)
         await silo.start()
 
-        async def converged(n):
-            while len(silo.membership.active) != n:
-                await asyncio.sleep(0.05)
-        await asyncio.wait_for(converged(2), timeout=15)
+        await asyncio.wait_for(_converged(silo, 2), timeout=15)
 
         client = await GatewayClient(
             [silo.silo_address.endpoint], response_timeout=10.0).connect()
 
-        wheres = await asyncio.gather(
-            *(client.get_grain(EchoGrain, k).where() for k in range(32)))
-        endpoints = set(wheres)
-        assert len(endpoints) == 2, f"all activations in one process: {endpoints}"
-        child_ep = next(e for e in endpoints
-                        if e != silo.silo_address.endpoint)
+        child_ep, child_keys = await _spread_over_both(
+            client, silo.silo_address.endpoint)
 
         # round-trips through the pickle-only child prove both directions
         # negotiated down (a hotwire frame would be undecodable there)
-        child_keys = [k for k, w in enumerate(wheres) if w == child_ep]
-        assert child_keys
         outs = await asyncio.gather(
             *(client.get_grain(EchoGrain, k).echo("mixed")
               for k in child_keys))
@@ -166,24 +190,16 @@ async def test_cross_os_process_cluster_and_kill(tmp_path):
         join_cluster(silo, table)
         await silo.start()
 
-        async def converged(n):
-            while len(silo.membership.active) != n:
-                await asyncio.sleep(0.05)
-        await asyncio.wait_for(converged(2), timeout=15)
+        await asyncio.wait_for(_converged(silo, 2), timeout=15)
 
         client = await GatewayClient(
             [silo.silo_address.endpoint], response_timeout=10.0).connect()
 
-        # touch many grains; placement must land some IN THE CHILD PROCESS
-        wheres = await asyncio.gather(
-            *(client.get_grain(EchoGrain, k).where() for k in range(32)))
-        endpoints = set(wheres)
-        assert len(endpoints) == 2, f"all activations in one process: {endpoints}"
-        child_ep = next(e for e in endpoints
-                        if e != silo.silo_address.endpoint)
+        # touch grains until placement lands some IN THE CHILD PROCESS
+        child_ep, child_keys = await _spread_over_both(
+            client, silo.silo_address.endpoint)
 
         # calls to child-hosted grains cross the OS-process boundary
-        child_keys = [k for k, w in enumerate(wheres) if w == child_ep]
         outs = await asyncio.gather(
             *(client.get_grain(EchoGrain, k).echo("hi") for k in child_keys))
         assert outs == [f"{k}:hi" for k in child_keys]
