@@ -63,18 +63,25 @@ class LedgerGrain(JournaledGrain):
         return (self.version, dict(self.state))
 
 
-async def _start_cluster(cluster_id, channel, storage, tmp_path):
+async def _start_cluster(cluster_id, channel, storage, tmp_path,
+                         n_silos=1):
     fabric = SocketFabric()
     table = FileMembershipTable(str(tmp_path / f"mbr-{cluster_id}.json"))
-    b = (SiloBuilder().with_name(f"{cluster_id}-s0").with_fabric(fabric)
-         .add_grains(LedgerGrain).with_storage("Default", storage)
-         .with_config(**FAST))
-    add_multicluster(b, cluster_id, [channel], gossip_period=0.1,
-                     maintainer_period=0.5)
-    silo = b.build()
-    join_cluster(silo, table)
-    await silo.start()
-    return silo
+    silos = []
+    for i in range(n_silos):
+        b = (SiloBuilder().with_name(f"{cluster_id}-s{i}")
+             .with_fabric(SocketFabric() if i else fabric)
+             .add_grains(LedgerGrain).with_storage("Default", storage)
+             .with_config(**FAST))
+        add_multicluster(b, cluster_id, [channel], gossip_period=0.1,
+                         maintainer_period=0.5)
+        silo = b.build()
+        join_cluster(silo, table)
+        await silo.start()
+        silos.append(silo)
+    if n_silos == 1:
+        return silos[0]
+    return silos
 
 
 async def _wait_gossip(a, b, timeout=10.0):
@@ -131,6 +138,54 @@ async def test_replica_in_remote_cluster_folds_without_storage_read(tmp_path):
                 await c.close_async()
         await a.stop()
         await b.stop()
+
+
+async def test_relay_fans_out_to_every_silo_of_the_remote_cluster(tmp_path):
+    """Cluster B has TWO silos, each hosting its own @replicated_journal
+    replica. One relay delivery from cluster A must fold into BOTH
+    (JournalRelayGrain iterates the receiving cluster's alive_list)."""
+    import time as _t
+
+    channel = FileGossipChannel(str(tmp_path / "gossip.json"))
+    storage = CountingStorage(MemoryStorage())
+    a = await _start_cluster("A", channel, storage, tmp_path)
+    b1, b2 = await _start_cluster("B", channel, storage, tmp_path,
+                                  n_silos=2)
+    ca = None
+    try:
+        # B's two silos converge into one cluster first
+        deadline = _t.monotonic() + 15
+        while len(b1.membership.active) != 2 or \
+                len(b2.membership.active) != 2:
+            assert _t.monotonic() < deadline
+            await asyncio.sleep(0.05)
+        await _wait_gossip(a, b1)
+        ca = await GatewayClient([a.silo_address.endpoint],
+                                 response_timeout=5.0).connect()
+
+        # activate a replica on EACH B silo directly (stateless-worker
+        # placement: one per silo)
+        for bs in (b1, b2):
+            v, _ = await bs.grain_factory.get_grain(
+                LedgerGrain, "shared").view()
+            assert v == 0
+
+        await ca.get_grain(LedgerGrain, "shared").credit(7)
+
+        async def both_converged():
+            while True:
+                views = [await bs.grain_factory.get_grain(
+                    LedgerGrain, "shared").view() for bs in (b1, b2)]
+                if all(v == 1 and st["total"] == 7 for v, st in views):
+                    return
+                await asyncio.sleep(0.05)
+        await asyncio.wait_for(both_converged(), timeout=10.0)
+    finally:
+        if ca is not None:
+            await ca.close_async()
+        await a.stop()
+        await b1.stop()
+        await b2.stop()
 
 
 async def test_partitioned_cluster_catches_up_on_heal(tmp_path):
