@@ -65,12 +65,13 @@ class LedgerGrain(JournaledGrain):
 
 async def _start_cluster(cluster_id, channel, storage, tmp_path,
                          n_silos=1):
-    fabric = SocketFabric()
+    """Start one cluster of n silos (each on its own fabric, joined via
+    the shared file membership table). Always returns a list."""
     table = FileMembershipTable(str(tmp_path / f"mbr-{cluster_id}.json"))
     silos = []
     for i in range(n_silos):
         b = (SiloBuilder().with_name(f"{cluster_id}-s{i}")
-             .with_fabric(SocketFabric() if i else fabric)
+             .with_fabric(SocketFabric())
              .add_grains(LedgerGrain).with_storage("Default", storage)
              .with_config(**FAST))
         add_multicluster(b, cluster_id, [channel], gossip_period=0.1,
@@ -79,8 +80,6 @@ async def _start_cluster(cluster_id, channel, storage, tmp_path,
         join_cluster(silo, table)
         await silo.start()
         silos.append(silo)
-    if n_silos == 1:
-        return silos[0]
     return silos
 
 
@@ -109,8 +108,8 @@ async def test_replica_in_remote_cluster_folds_without_storage_read(tmp_path):
     channel = FileGossipChannel(str(tmp_path / "gossip.json"))
     primary = MemoryStorage()  # the shared PRIMARY storage
     sa, sb = CountingStorage(primary), CountingStorage(primary)
-    a = await _start_cluster("A", channel, sa, tmp_path)
-    b = await _start_cluster("B", channel, sb, tmp_path)
+    (a,) = await _start_cluster("A", channel, sa, tmp_path)
+    (b,) = await _start_cluster("B", channel, sb, tmp_path)
     ca = cb = None
     try:
         await _wait_gossip(a, b)
@@ -144,21 +143,19 @@ async def test_relay_fans_out_to_every_silo_of_the_remote_cluster(tmp_path):
     """Cluster B has TWO silos, each hosting its own @replicated_journal
     replica. One relay delivery from cluster A must fold into BOTH
     (JournalRelayGrain iterates the receiving cluster's alive_list)."""
-    import time as _t
-
     channel = FileGossipChannel(str(tmp_path / "gossip.json"))
-    storage = CountingStorage(MemoryStorage())
-    a = await _start_cluster("A", channel, storage, tmp_path)
+    storage = MemoryStorage()
+    (a,) = await _start_cluster("A", channel, storage, tmp_path)
     b1, b2 = await _start_cluster("B", channel, storage, tmp_path,
                                   n_silos=2)
     ca = None
     try:
         # B's two silos converge into one cluster first
-        deadline = _t.monotonic() + 15
-        while len(b1.membership.active) != 2 or \
-                len(b2.membership.active) != 2:
-            assert _t.monotonic() < deadline
-            await asyncio.sleep(0.05)
+        async def b_converged():
+            while len(b1.membership.active) != 2 or \
+                    len(b2.membership.active) != 2:
+                await asyncio.sleep(0.05)
+        await asyncio.wait_for(b_converged(), timeout=15.0)
         await _wait_gossip(a, b1)
         ca = await GatewayClient([a.silo_address.endpoint],
                                  response_timeout=5.0).connect()
@@ -195,8 +192,8 @@ async def test_partitioned_cluster_catches_up_on_heal(tmp_path):
     reconverges (the reference's notification-loss → catch-up path)."""
     channel = FileGossipChannel(str(tmp_path / "gossip.json"))
     storage = CountingStorage(MemoryStorage())
-    a = await _start_cluster("A", channel, storage, tmp_path)
-    b = await _start_cluster("B", channel, storage, tmp_path)
+    (a,) = await _start_cluster("A", channel, storage, tmp_path)
+    (b,) = await _start_cluster("B", channel, storage, tmp_path)
     ca = cb = None
     try:
         await _wait_gossip(a, b)
