@@ -222,12 +222,18 @@ class FileQueueAdapter(DurableQueueAdapter):
                  name: str = "file", retention: int = 4096):
         self.name = name
         self.n_queues = n_queues
-        self.retention = retention  # advisory: file logs are append-only
+        # newest acked batches kept per queue for rewind replay; older
+        # acked batches are dropped by compaction (a log rewrite with a
+        # seq-watermark record so token continuity survives), triggered
+        # once enough acks accumulate — the log is bounded, not
+        # append-forever
+        self.retention = retention
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
         self._lock = threading.Lock()
         self._next_seq: dict[int, int] = {}
         self._scanned: dict[int, int] = {}  # queue -> file size at scan
+        self._acks_since_compact: dict[int, int] = {}
 
     def _log(self, q: int) -> str:
         return os.path.join(self.directory, f"q{q}.log")
@@ -249,20 +255,27 @@ class FileQueueAdapter(DurableQueueAdapter):
             finally:
                 fcntl.flock(lk.fileno(), fcntl.LOCK_UN)
 
-    def _read_log_raw(self, q: int
-                      ) -> tuple[list[tuple[int, bytes, bytes, int]], int]:
-        """Parse q<i>.log into (seq, stream_blob, items_blob, n_items)
-        rows plus the byte length of the VALID prefix. A torn trailing
-        line (crash mid-append: unterminated or unparseable) ends the
-        valid prefix — that writer's produce() never returned, so the
-        torn record was never acknowledged to anyone. The producer
-        truncates the torn tail before appending: appending after it
-        would leave the new record unreachable behind the parse stop."""
+    def _read_log_raw(self, q: int) -> tuple[
+            list[tuple[int, bytes, bytes, int]], int, int]:
+        """Parse q<i>.log into ``(rows, valid_end, next_seq)``:
+        ``rows`` are (seq, stream_blob, items_blob, n_items) batch
+        records; ``valid_end`` is the byte length of the VALID prefix;
+        ``next_seq`` is the next token to assign — the max over every
+        record (including compaction watermarks ``{"s":…, "w":1}``,
+        which carry the sequence over dropped history) of seq + n.
+
+        A torn trailing line (crash mid-append: unterminated or
+        unparseable) ends the valid prefix — that writer's produce()
+        never returned, so the torn record was never acknowledged to
+        anyone. The producer truncates the torn tail before appending:
+        appending after it would leave the new record unreachable behind
+        the parse stop."""
         path = self._log(q)
         if not os.path.exists(path):
-            return [], 0
+            return [], 0, 0
         rows: list = []
         valid_end = 0
+        next_seq = 0
         with open(path, "rb") as f:
             for line in f:
                 if not line.endswith(b"\n"):
@@ -271,13 +284,19 @@ class FileQueueAdapter(DurableQueueAdapter):
                 if stripped:
                     try:
                         r = json.loads(stripped)
-                        rows.append((r["s"],
-                                     base64.b64decode(r["sid"]),
-                                     base64.b64decode(r["b"]), r["n"]))
+                        if r.get("w"):
+                            # compaction watermark: preserves the token
+                            # sequence across dropped history
+                            next_seq = max(next_seq, r["s"])
+                        else:
+                            rows.append((r["s"],
+                                         base64.b64decode(r["sid"]),
+                                         base64.b64decode(r["b"]), r["n"]))
+                            next_seq = max(next_seq, r["s"] + r["n"])
                     except (ValueError, KeyError):
                         break
                 valid_end += len(line)
-        return rows, valid_end
+        return rows, valid_end, next_seq
 
     def _read_log(self, q: int) -> list[tuple[int, bytes, bytes, int]]:
         return self._read_log_raw(q)[0]
@@ -317,14 +336,14 @@ class FileQueueAdapter(DurableQueueAdapter):
                 except OSError:
                     size = 0
                 if self._scanned.get(queue_id) != size:
-                    rows, valid_end = self._read_log_raw(queue_id)
+                    _rows, valid_end, next_seq = \
+                        self._read_log_raw(queue_id)
                     if valid_end < size:
                         # truncate a crashed writer's torn tail so the
                         # record appended below stays parseable
                         with open(path, "r+b") as tf:
                             tf.truncate(valid_end)
-                    self._next_seq[queue_id] = \
-                        rows[-1][0] + rows[-1][3] if rows else 0
+                    self._next_seq[queue_id] = next_seq
                 seq = self._next_seq.get(queue_id, 0)
                 rec["s"] = seq
                 with open(path, "a", encoding="utf-8") as f:
@@ -358,14 +377,71 @@ class FileQueueAdapter(DurableQueueAdapter):
 
     async def _ack(self, queue_id: int, seq: int) -> None:
         def write() -> None:
-            with self._lock:
+            # the flock serializes against a concurrent compaction in
+            # ANOTHER process: its ack-file rewrite must never discard an
+            # ack appended between its read and its replace
+            with self._lock, self._os_lock(queue_id):
                 with open(self._ackf(queue_id), "a",
                           encoding="utf-8") as f:
                     f.write(f"{seq}\n")
                     f.flush()
                     os.fsync(f.fileno())
+                n = self._acks_since_compact.get(queue_id, 0) + 1
+                if n >= max(self.retention, 64):
+                    self._compact_under_flock(queue_id)
+                    n = 0
+                self._acks_since_compact[queue_id] = n
 
         await asyncio.get_running_loop().run_in_executor(None, write)
+
+    def _compact_locked(self, q: int) -> None:
+        """Compact with only ``_lock`` held (takes the flock itself).
+        Never call while already holding the flock — flock on a second
+        fd of the same lock file blocks even within one process."""
+        with self._os_lock(q):
+            self._compact_under_flock(q)
+
+    def _compact_under_flock(self, q: int) -> None:
+        """Bound the log: keep unacked batches plus the newest
+        ``retention`` acked ones; a leading watermark record carries the
+        token sequence over the dropped history. Caller holds ``_lock``
+        AND the queue flock (which serializes against cross-process
+        producers and ackers). Replace order is log-then-ack: a crash
+        between the two leaves stale seqs in the ack file (harmless —
+        acks for absent batches are ignored) but never un-acks a kept
+        batch."""
+        rows, _, next_seq = self._read_log_raw(q)
+        acked = self._read_acks(q)
+        acked_seqs = sorted(r[0] for r in rows if r[0] in acked)
+        # [-0:] would keep EVERYTHING; retention=0 means no history
+        keep_acked = set(acked_seqs[-self.retention:]) \
+            if self.retention > 0 else set()
+        kept = [r for r in rows
+                if r[0] not in acked or r[0] in keep_acked]
+        path = self._log(q)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(json.dumps({"s": next_seq, "w": 1},
+                               separators=(",", ":")) + "\n")
+            for seq, sblob, blob, n in kept:
+                f.write(json.dumps(
+                    {"s": seq,
+                     "sid": base64.b64encode(sblob).decode(),
+                     "b": base64.b64encode(blob).decode(),
+                     "n": n}, separators=(",", ":")) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        ackf = self._ackf(q)
+        atmp = ackf + ".tmp"
+        with open(atmp, "w", encoding="utf-8") as f:
+            for seq in sorted(keep_acked):
+                f.write(f"{seq}\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(atmp, ackf)
+        self._scanned[q] = os.path.getsize(path)
+        self._next_seq[q] = next_seq
 
     async def replay(self, stream: StreamId,
                      from_seq: int) -> list[QueueBatch]:

@@ -107,6 +107,63 @@ async def test_file_adapter_recovers_from_torn_tail(tmp_path):
     assert [(b.seq, b.items) for b in got] == [(0, ["a", "b"]), (2, ["c"])]
 
 
+async def test_file_adapter_compaction_bounds_log(tmp_path):
+    """The file log is bounded, not append-forever: once enough acks
+    accumulate, compaction keeps unacked batches plus the newest
+    `retention` acked ones, and a watermark record carries the token
+    sequence over the dropped history (new produces keep their seq)."""
+    ad = FileQueueAdapter(str(tmp_path / "queues"), n_queues=1,
+                          retention=3)
+    sid = StreamId("p", "ns", "k")
+    for i in range(70):  # ack threshold is max(retention, 64)
+        await ad.queue_message_batch(0, sid, [i])
+    r = ad.create_receiver(0)
+    for b in await r.get_messages(100):
+        await r.ack(b)
+    # the trigger-driven bound: retention + acks-since-last-compact,
+    # never the full 70-batch history
+    rows = ad._read_log(0)
+    assert len(rows) < 70 and len(rows) <= 3 + 64, len(rows)
+    # an explicit compact (what the next trigger does) reaches the exact
+    # retention bound, keeping the NEWEST acked batches
+    with ad._lock:
+        ad._compact_locked(0)
+    rows = ad._read_log(0)
+    assert len(rows) == 3, rows
+    hist = await ad.replay(sid, 0)
+    assert [b.items for b in hist] == [[67], [68], [69]]
+    # token continuity across the compaction: next produce continues
+    await ad.queue_message_batch(0, sid, ["new"])
+    got = await ad.create_receiver(0).get_messages(10)
+    assert [(b.seq, b.items) for b in got] == [(70, ["new"])]
+    # and a fresh adapter over the same directory agrees
+    ad2 = FileQueueAdapter(str(tmp_path / "queues"), n_queues=1,
+                           retention=3)
+    got2 = await ad2.create_receiver(0).get_messages(10)
+    assert [(b.seq, b.items) for b in got2] == [(70, ["new"])]
+
+
+async def test_file_adapter_retention_zero_keeps_no_history(tmp_path):
+    """retention=0 means NO acked history (matching the sqlite backend's
+    LIMIT 0), not keep-everything (the [-0:] slice trap)."""
+    ad = FileQueueAdapter(str(tmp_path / "queues"), n_queues=1,
+                          retention=0)
+    sid = StreamId("p", "ns", "k")
+    for i in range(5):
+        await ad.queue_message_batch(0, sid, [i])
+    r = ad.create_receiver(0)
+    for b in await r.get_messages(10):
+        await r.ack(b)
+    with ad._lock:
+        ad._compact_locked(0)
+    assert ad._read_log(0) == []
+    assert await ad.replay(sid, 0) == []
+    # token continuity still holds through the watermark
+    await ad.queue_message_batch(0, sid, ["next"])
+    got = await ad.create_receiver(0).get_messages(10)
+    assert [(b.seq, b.items) for b in got] == [(5, ["next"])]
+
+
 async def test_sqlite_retention_bounds_acked_history(tmp_path):
     ad = SqliteQueueAdapter(str(tmp_path / "q.db"), n_queues=1, retention=3)
     sid = StreamId("p", "n", "k")
