@@ -69,6 +69,13 @@ class AdaptiveDirectoryCache:
         e = self._d.get(gid)
         if e is None:
             return None
+        # bounded even when no maintainer drains it (refresh period 0):
+        # distinct-gid traffic must not grow the set past the cache
+        # itself. Clear only when a NEW gid would exceed the bound — a
+        # steady-state working set of exactly `size` hot gids must keep
+        # its marks or the maintainer would never see them at sweep time
+        if gid not in self._accessed and len(self._accessed) >= self.size:
+            self._accessed.clear()
         self._accessed.add(gid)
         if self.clock() >= e.expires:
             self.expired_hits += 1

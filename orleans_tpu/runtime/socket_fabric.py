@@ -104,18 +104,20 @@ _NEGOTIATE_TIMEOUT = 5.0
 
 async def _read_peer_codec(reader: asyncio.StreamReader) -> bool:
     """Read the acceptor's handshake reply; True iff the peer advertises
-    hotwire decode support. Garbled, undecodable, or truncated replies fall
-    back to the universally-decodable pickle form (never fail the dial over
-    negotiation); an unresponsive peer raises TimeoutError — an OSError —
-    into the caller's dial-retry path."""
+    hotwire decode support. A well-framed but undecodable reply falls back
+    to the universally-decodable pickle form; a GARBLED or truncated frame
+    raises ConnectionError — the stream is misaligned and every later frame
+    on it would misparse, so the dial must fail into the retry path (fresh
+    connection), never keep reading. An unresponsive peer raises
+    TimeoutError — an OSError — into the same path."""
     try:
         headers, _ = await asyncio.wait_for(
             read_frame(reader), _NEGOTIATE_TIMEOUT)
-    except (FrameError, asyncio.IncompleteReadError):
-        return False
+    except (FrameError, asyncio.IncompleteReadError) as e:
+        raise ConnectionError(f"handshake reply unreadable: {e}") from e
     try:
         return bool(decode_handshake(headers).get("hotwire", False))
-    except Exception:  # noqa: BLE001 — any undecodable reply → pickle
+    except Exception:  # noqa: BLE001 — well-framed junk reply → pickle
         return False
 
 
@@ -152,7 +154,11 @@ class _Sender:
             await writer.drain()
             # codec negotiation: the acceptor replies with its own
             # handshake; encode at the peer's level from here on
-            self.peer_native = await _read_peer_codec(reader)
+            try:
+                self.peer_native = await _read_peer_codec(reader)
+            except OSError:
+                writer.close()  # failed negotiation: redial, don't leak
+                raise
             return writer
 
         try:
@@ -518,7 +524,11 @@ class _GatewayConnection:
         writer.write(encode_handshake("client", self.pseudo_address))
         await writer.drain()
         # codec negotiation: the gateway replies with its own handshake
-        self.peer_native = await _read_peer_codec(reader)
+        try:
+            self.peer_native = await _read_peer_codec(reader)
+        except OSError:
+            writer.close()  # misaligned reply stream must not feed _pump
+            raise
         self.writer = writer
         self.live = True
         loop = asyncio.get_running_loop()

@@ -15,7 +15,7 @@ from .durable import (
     FileQueueAdapter,
     SqliteQueueAdapter,
 )
-from .core import (StreamId, StreamProvider, StreamRef,
+from .core import (StreamId, StreamProvider, StreamRef, StreamSignal,
                    SubscriptionHandle, batch_consumer)
 from .persistent import (
     GeneratorQueueAdapter,
@@ -30,8 +30,8 @@ from .pubsub import PubSubRendezvousGrain, implicit_stream_subscription
 from .sms import SMSStreamProvider, add_sms_streams
 
 __all__ = [
-    "StreamId", "StreamRef", "SubscriptionHandle", "StreamProvider",
-    "batch_consumer",
+    "StreamId", "StreamRef", "StreamSignal", "SubscriptionHandle",
+    "StreamProvider", "batch_consumer",
     "SMSStreamProvider", "add_sms_streams",
     "QueueAdapter", "QueueReceiver", "QueueBatch", "MemoryQueueAdapter",
     "GeneratorQueueAdapter",

@@ -21,7 +21,8 @@ from ..core.ids import GrainId, stable_hash64
 if TYPE_CHECKING:
     from ..runtime.silo import Silo
 
-__all__ = ["StreamId", "StreamRef", "SubscriptionHandle", "StreamProvider"]
+__all__ = ["StreamId", "StreamRef", "StreamSignal", "SubscriptionHandle",
+           "StreamProvider"]
 
 
 @dataclass(frozen=True)
@@ -41,6 +42,19 @@ class StreamId:
 
 
 @dataclass(frozen=True)
+class StreamSignal:
+    """Producer-signaled control event riding the normal item path as a
+    single-item batch: ``kind`` is ``"error"`` (OnErrorAsync —
+    AsyncObservableExtensions.cs:19-41 routes it to the observer's
+    onErrorAsync delegate) or ``"completed"`` (OnCompletedAsync). Signals
+    consume one sequence token like any item, so ordering relative to
+    data, durable replay, and token dedup all hold unchanged."""
+
+    kind: str
+    error: Any = None
+
+
+@dataclass(frozen=True)
 class SubscriptionHandle:
     """Opaque subscription token (StreamSubscriptionHandle<T>)."""
 
@@ -49,6 +63,12 @@ class SubscriptionHandle:
     grain_id: GrainId
     interface_name: str
     method_name: str
+    # consumer-side OnError/OnCompleted methods (GenericAsyncObserver.cs:37
+    # holds the three delegates; here: method names on the SAME grain).
+    # None = the consumer declined that part of the contract; the signal
+    # is then logged and dropped, as the reference does for null delegates
+    error_method_name: str | None = None
+    completed_method_name: str | None = None
     # batch consumer (IAsyncBatchObserver<T>): deliveries arrive as ONE
     # call per queue batch — method(items, first_token) — instead of a
     # grain call per event
@@ -95,10 +115,25 @@ class StreamRef:
 
     # -- producer side (StreamImpl.OnNext :89) --------------------------
     async def on_next(self, item: Any) -> None:
+        if isinstance(item, StreamSignal):
+            raise StreamError("StreamSignal is not a data item; use "
+                              "on_error()/on_completed()")
         await self.provider.produce(self.stream_id, [item])
 
     async def on_next_batch(self, items: list) -> None:
-        await self.provider.produce(self.stream_id, list(items))
+        items = list(items)
+        if any(isinstance(i, StreamSignal) for i in items):
+            raise StreamError("StreamSignal is not a data item; use "
+                              "on_error()/on_completed()")
+        await self.provider.produce(self.stream_id, items)
+
+    async def on_error(self, exc: BaseException) -> None:
+        """Producer signals failure to every subscriber (OnErrorAsync).
+        Rides the normal produce path as its own single-item batch, so
+        it is ordered after everything already produced and — on a
+        durable provider — survives and replays like data."""
+        await self.provider.produce(
+            self.stream_id, [StreamSignal(kind="error", error=exc)])
 
     async def on_completed(self) -> None:
         await self.provider.complete(self.stream_id)
@@ -106,18 +141,37 @@ class StreamRef:
     # -- consumer side (StreamImpl.Subscribe :60) -----------------------
     async def subscribe(self, handler: Callable,
                         batch: bool | None = None,
-                        from_token: int | None = None) -> SubscriptionHandle:
+                        from_token: int | None = None,
+                        on_error: Callable | None = None,
+                        on_completed: Callable | None = None,
+                        ) -> SubscriptionHandle:
         """Subscribe a bound grain method. ``batch`` (or the
         ``@batch_consumer`` marker) selects whole-batch delivery;
         ``from_token`` resumes a rewindable (persistent) stream from a
-        sequence token, replaying from the provider's cache window."""
+        sequence token, replaying from the provider's cache window.
+        ``on_error`` / ``on_completed`` are further bound methods of the
+        SAME grain receiving producer signals: ``on_error(exc, token)``
+        and ``on_completed(token)`` — the observer triple of
+        GenericAsyncObserver.cs:37."""
         grain_id, iface, method = consumer_of(handler)
+        err_method = comp_method = None
+        if on_error is not None:
+            egid, _, err_method = consumer_of(on_error)
+            if egid != grain_id:
+                raise StreamError("on_error must be a method of the same "
+                                  "grain as the data handler")
+        if on_completed is not None:
+            cgid, _, comp_method = consumer_of(on_completed)
+            if cgid != grain_id:
+                raise StreamError("on_completed must be a method of the "
+                                  "same grain as the data handler")
         if batch is None:
             batch = bool(getattr(handler, "__orleans_stream_batch__", False))
         handle = SubscriptionHandle(
             stream=self.stream_id, handle_id=uuid.uuid4().hex,
             grain_id=grain_id, interface_name=iface, method_name=method,
-            batch=batch, from_token=from_token)
+            batch=batch, from_token=from_token,
+            error_method_name=err_method, completed_method_name=comp_method)
         await self.provider.register_consumer(handle)
         return handle
 
@@ -143,8 +197,17 @@ class StreamProvider:
     async def produce(self, stream: StreamId, items: list) -> None:
         raise NotImplementedError
 
-    async def complete(self, stream: StreamId) -> None:  # noqa: B027
-        pass
+    async def complete(self, stream: StreamId) -> None:
+        """Completion is a signal through the same ordered path as data
+        (subscribers with a ``completed_method_name`` hear it; others
+        ignore it)."""
+        try:
+            await self.produce(stream, [StreamSignal(kind="completed")])
+        except StreamError as e:
+            # a produce-rejecting adapter (e.g. the generator provider)
+            # cannot carry signals either — name the actual operation
+            raise StreamError(
+                f"on_completed not supported on {stream}: {e}") from e
 
     async def register_consumer(self, handle: SubscriptionHandle) -> None:
         raise NotImplementedError

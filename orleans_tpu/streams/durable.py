@@ -110,6 +110,14 @@ class SqliteQueueAdapter(DurableQueueAdapter):
                 " queue_id INTEGER, seq INTEGER, stream BLOB, items BLOB,"
                 " n INTEGER, acked INTEGER DEFAULT 0,"
                 " PRIMARY KEY (queue_id, seq))")
+            # per-queue high-water mark (the sqlite analog of the file
+            # adapter's watermark record): retention can DELETE every row
+            # of a drained queue, and deriving next-seq from surviving rows
+            # alone would then restart at 0 and collide with
+            # already-delivered tokens
+            self._db.execute(
+                "CREATE TABLE IF NOT EXISTS stream_watermarks ("
+                " queue_id INTEGER PRIMARY KEY, next_seq INTEGER)")
             self._db.commit()
 
     def close(self) -> None:
@@ -130,16 +138,26 @@ class SqliteQueueAdapter(DurableQueueAdapter):
                 self._db.execute("BEGIN IMMEDIATE")
                 try:
                     # item-cumulative per-queue seq (EventSequenceToken
-                    # contract): next = previous seq + previous item count
+                    # contract): next = previous seq + previous item count.
+                    # max() with the watermark: rows alone under-count after
+                    # retention drained the queue; the watermark alone
+                    # under-counts on a pre-watermark db being upgraded
                     row = self._db.execute(
                         "SELECT seq + n FROM stream_batches WHERE queue_id=?"
                         " ORDER BY seq DESC LIMIT 1", (queue_id,)).fetchone()
-                    seq = row[0] if row else 0
+                    wm = self._db.execute(
+                        "SELECT next_seq FROM stream_watermarks"
+                        " WHERE queue_id=?", (queue_id,)).fetchone()
+                    seq = max(row[0] if row else 0, wm[0] if wm else 0)
                     self._db.execute(
                         "INSERT INTO stream_batches"
                         " (queue_id, seq, stream, items, n)"
                         " VALUES (?,?,?,?,?)",
                         (queue_id, seq, sblob, blob, n))
+                    self._db.execute(
+                        "INSERT OR REPLACE INTO stream_watermarks"
+                        " (queue_id, next_seq) VALUES (?,?)",
+                        (queue_id, seq + n))
                     self._db.commit()
                 except BaseException:
                     self._db.rollback()

@@ -212,12 +212,14 @@ class _ConsumerPump:
                 if cb is None:
                     await self.wake.wait()
                     continue
+            end = cb.batch.seq + len(cb.batch.items)
+            if end <= agent.provider.replay_progress.get(self.key, 0):
+                # fully below the recorded progress floor: this batch was
+                # already delivered by durable-history replay (or an
+                # earlier pump incarnation) — skip the avoidable duplicate
+                continue
             await self._deliver(cb.batch)
-            if cb.batch.stream == self.stream:
-                prog = agent.provider.replay_progress
-                prog[self.key] = max(
-                    prog.get(self.key, 0),
-                    cb.batch.seq + len(cb.batch.items))
+            agent.provider.note_replay_progress(self.key, end)
 
     async def _replay_durable_history(self) -> None:
         """Rewind beyond the in-memory cache window: a subscription with a
@@ -226,9 +228,9 @@ class _ConsumerPump:
         durable.DurableQueueAdapter.replay). Only acked batches: unacked
         ones redeliver through the normal pull, and this pump's cursor —
         created from_oldest BEFORE this runs — pins eviction, so no batch
-        can slip between replay and the cache (at-least-once holds;
-        overlap dedups by token via the from_token trim in
-        deliver_to_consumer).
+        can slip between replay and the cache (at-least-once holds; cache
+        batches overlapping what replay already delivered are skipped by
+        the replay-progress floor in the delivery loop).
 
         The replay floor is max(subscription token, this silo's recorded
         delivery progress for the consumer): pumps are recreated on every
@@ -250,8 +252,8 @@ class _ConsumerPump:
             return
         for batch in sorted(history, key=lambda b: b.seq):
             await self._deliver(batch)
-            progress[self.key] = max(progress.get(self.key, 0),
-                                     batch.seq + len(batch.items))
+            self.agent.provider.note_replay_progress(
+                self.key, batch.seq + len(batch.items))
 
     def _next_mine(self):
         """Advance past other streams' batches to the next batch of ours."""
@@ -385,6 +387,10 @@ class PullingAgent:
         live = {(stream, h.handle_id) for h in handles}
         for key in [k for k in self.pumps if k[0] == stream and k not in live]:
             self.pumps.pop(key).stop()
+            # the subscription itself is gone (pubsub unregister), not a
+            # rebalance-driven pump recreation: its replay floor will never
+            # be consulted again — drop it or it leaks per dead handle_id
+            self.provider.replay_progress.pop(key, None)
         for h in handles:
             key = (stream, h.handle_id)
             if key not in self.pumps:
@@ -485,8 +491,23 @@ class PersistentStreamProvider(StreamProvider):
         self.cache_capacity = cache_capacity
         self.manager = PullingManager(self, rebalance_period=rebalance_period)
         # silo-local delivery progress per (stream, handle_id): the floor
-        # for durable-history replay across pump recreations
+        # for durable-history replay across pump recreations. Entries for
+        # unsubscribed handles are dropped at pump reconciliation; the LRU
+        # cap below catches handles removed while this silo did not own
+        # the queue (losing a floor only re-delivers — at-least-once holds)
         self.replay_progress: dict[tuple, int] = {}
+
+    _REPLAY_PROGRESS_CAP = 4096
+
+    def note_replay_progress(self, key: tuple, end: int) -> None:
+        """Raise the delivery floor for (stream, handle_id); re-insertion
+        keeps the dict ordered by last update so the cap evicts the
+        longest-idle floors first."""
+        prog = self.replay_progress
+        cur = prog.pop(key, 0)
+        prog[key] = max(cur, end)
+        while len(prog) > self._REPLAY_PROGRESS_CAP:
+            prog.pop(next(iter(prog)))
 
     async def produce(self, stream: StreamId, items: list) -> None:
         queue_id = stream.uniform_hash % self.adapter.n_queues

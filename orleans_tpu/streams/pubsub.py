@@ -10,14 +10,18 @@ round-trip).
 
 from __future__ import annotations
 
+import logging
 from typing import TYPE_CHECKING
 
+from ..core.errors import StreamError
 from ..core.ids import GrainId, GrainType
 from ..runtime.grain import StatefulGrain
-from .core import StreamId, SubscriptionHandle
+from .core import StreamId, StreamSignal, SubscriptionHandle
 
 if TYPE_CHECKING:
     from ..runtime.silo import Silo
+
+log = logging.getLogger("orleans.streams.pubsub")
 
 __all__ = ["PubSubRendezvousGrain", "implicit_stream_subscription",
            "implicit_consumers", "resolve_consumers", "deliver_to_consumer"]
@@ -71,15 +75,26 @@ def implicit_consumers(silo: "Silo", stream: StreamId) -> list[SubscriptionHandl
     for vcls in getattr(silo, "vector_interfaces", {}).values():
         if vcls.__name__ not in seen:
             classes.append(vcls)
+    vector_names = set(getattr(silo, "vector_interfaces", {}))
     for cls in classes:
         if stream.namespace in getattr(cls, "__implicit_stream_ns__", ()):
             gid = GrainId.for_grain(GrainType.of(cls.__name__), stream.key)
+            # host-tier classes that define on_error/on_completed hear
+            # producer signals automatically; device-tier (kernel) methods
+            # cannot take the signal call shape, so signals skip them
+            host = cls.__name__ not in vector_names
             out.append(SubscriptionHandle(
                 stream=stream, handle_id=f"implicit:{cls.__name__}",
                 grain_id=gid, interface_name=cls.__name__,
                 method_name="on_next",
                 batch=bool(getattr(getattr(cls, "on_next", None),
-                                   "__orleans_stream_batch__", False))))
+                                   "__orleans_stream_batch__", False)),
+                error_method_name="on_error"
+                if host and callable(getattr(cls, "on_error", None))
+                else None,
+                completed_method_name="on_completed"
+                if host and callable(getattr(cls, "on_completed", None))
+                else None))
     return out
 
 
@@ -120,6 +135,18 @@ async def deliver_to_consumer(silo: "Silo", handle: SubscriptionHandle,
         if first_token < ft:
             items = items[ft - first_token:]
             first_token = ft
+    if any(isinstance(i, StreamSignal) for i in items):
+        # signals are produced as their own 1-item batches (on_next
+        # rejects them as data), so a signal batch is all-signal; a mixed
+        # batch can only come from a hand-built adapter — reject it into
+        # the retry/failure-handler path rather than guess an order
+        if not all(isinstance(i, StreamSignal) for i in items):
+            raise StreamError(
+                "stream signals must not be batched with data items")
+        for i in range(progress.get("done", 0), len(items)):
+            await _deliver_signal(silo, handle, items[i], first_token + i)
+            progress["done"] = i + 1
+        return
     vcls = silo.vector_interfaces.get(handle.interface_name)
     if vcls is not None and getattr(silo, "vector", None) is not None:
         return await deliver_to_vector_consumer(silo, vcls, handle, items,
@@ -147,6 +174,32 @@ async def deliver_to_consumer(silo: "Silo", handle: SubscriptionHandle,
             args=(items[i], first_token + i), kwargs={})
         await fut
         progress["done"] = i + 1
+
+
+async def _deliver_signal(silo: "Silo", handle: SubscriptionHandle,
+                          sig: StreamSignal, token: int) -> None:
+    """Route one producer signal to the consumer's dedicated method:
+    ``on_error(exc, token)`` / ``on_completed(token)``. A consumer that
+    registered no method for this signal kind ignores it (counted), as
+    the reference does for a null onErrorAsync delegate."""
+    attr = ("error_method_name" if sig.kind == "error"
+            else "completed_method_name")
+    method = getattr(handle, attr, None)
+    if method is None:
+        silo.stats.increment(f"streams.signals.{sig.kind}_unhandled")
+        log.debug("consumer %s has no %s handler for %s",
+                  handle.grain_id, sig.kind, handle.stream)
+        return
+    cls = silo.registry.resolve(handle.interface_name)
+    if cls is None:
+        raise LookupError(
+            f"stream consumer class {handle.interface_name} not registered")
+    args = (sig.error, token) if sig.kind == "error" else (token,)
+    silo.stats.increment(f"streams.signals.{sig.kind}_delivered")
+    await silo.runtime_client.send_request(
+        target_grain=handle.grain_id, grain_class=cls,
+        interface_name=handle.interface_name, method_name=method,
+        args=args, kwargs={})
 
 
 async def deliver_to_vector_consumer(silo: "Silo", vcls: type,

@@ -28,6 +28,14 @@ class ProducerGrain(Grain):
         stream = self.get_stream_provider(provider).get_stream(ns, stream_key)
         await stream.on_next_batch(items)
 
+    async def publish_error(self, provider, ns, stream_key, text):
+        stream = self.get_stream_provider(provider).get_stream(ns, stream_key)
+        await stream.on_error(RuntimeError(text))
+
+    async def publish_completed(self, provider, ns, stream_key):
+        stream = self.get_stream_provider(provider).get_stream(ns, stream_key)
+        await stream.on_completed()
+
 
 class ConsumerGrain(Grain):
     async def join(self, provider, ns, stream_key):
@@ -58,8 +66,29 @@ class FlakyConsumerGrain(Grain):
         raise RuntimeError("consumer permanently broken")
 
 
+class SignalConsumerGrain(Grain):
+    """Subscribes the full observer triple (OnNext/OnError/OnCompleted)."""
+
+    async def join(self, provider, ns, stream_key):
+        stream = self.get_stream_provider(provider).get_stream(ns, stream_key)
+        await stream.subscribe(self.on_event,
+                               on_error=self.on_stream_error,
+                               on_completed=self.on_stream_done)
+
+    async def on_event(self, item, token):
+        RECEIVED.setdefault((self.primary_key, "signal"), []).append(item)
+
+    async def on_stream_error(self, exc, token):
+        RECEIVED.setdefault((self.primary_key, "signal"), []).append(
+            ("error", str(exc), token))
+
+    async def on_stream_done(self, token):
+        RECEIVED.setdefault((self.primary_key, "signal"), []).append(
+            ("completed", token))
+
+
 GRAINS = [ProducerGrain, ConsumerGrain, ImplicitConsumerGrain,
-          FlakyConsumerGrain]
+          FlakyConsumerGrain, SignalConsumerGrain]
 
 
 async def start_cluster(n, adapter=None, with_membership=False):
@@ -423,3 +452,84 @@ async def test_generator_adapter_synthesizes_streams():
     finally:
         await client.close_async()
         await silo.stop()
+
+
+async def test_sms_on_error_and_completed_signals():
+    """Producer OnError/OnCompleted fan out to the consumer's dedicated
+    methods, ordered after prior items and carrying the sequence token
+    (GenericAsyncObserver.cs:37 observer-triple contract)."""
+    RECEIVED.clear()
+    fabric, adapter, silos, client = await start_cluster(1)
+    try:
+        await client.get_grain(SignalConsumerGrain, 7).join(
+            "sms", "sig", "s1")
+        producer = client.get_grain(ProducerGrain, 1)
+        await producer.publish("sms", "sig", "s1", "a")
+        await producer.publish_error("sms", "sig", "s1", "boom")
+        await producer.publish("sms", "sig", "s1", "b")
+        await producer.publish_completed("sms", "sig", "s1")
+        got = await wait_received((7, "signal"), 4)
+        assert got[0] == "a"
+        assert got[1][:2] == ("error", "boom")
+        assert got[2] == "b"
+        assert got[3][0] == "completed"
+        # signals consume sequence tokens like items: a=0, error=1, b=2,
+        # completed=3
+        assert got[1][2] == 1 and got[3][1] == 3
+    finally:
+        await stop_all(silos, client)
+
+
+async def test_persistent_on_error_and_completed_signals():
+    """Signals ride the queue like data: durable, ordered, token-stamped."""
+    RECEIVED.clear()
+    fabric, adapter, silos, client = await start_cluster(1)
+    try:
+        await client.get_grain(SignalConsumerGrain, 9).join(
+            "queue", "sig", "s2")
+        producer = client.get_grain(ProducerGrain, 1)
+        await producer.publish_batch("queue", "sig", "s2", ["x", "y"])
+        await producer.publish_error("queue", "sig", "s2", "kaput")
+        await producer.publish_completed("queue", "sig", "s2")
+        got = await wait_received((9, "signal"), 4)
+        assert got[:2] == ["x", "y"]
+        assert got[2][:2] == ("error", "kaput")
+        assert got[3] == ("completed", 3)
+    finally:
+        await stop_all(silos, client)
+
+
+async def test_signals_skip_consumers_without_handlers():
+    """A consumer subscribed without on_error/on_completed never sees
+    signals (null-delegate semantics) and keeps receiving data."""
+    RECEIVED.clear()
+    fabric, adapter, silos, client = await start_cluster(1)
+    try:
+        await client.get_grain(ConsumerGrain, 3).join("sms", "sig", "s3")
+        producer = client.get_grain(ProducerGrain, 1)
+        await producer.publish("sms", "sig", "s3", "before")
+        await producer.publish_error("sms", "sig", "s3", "ignored")
+        await producer.publish("sms", "sig", "s3", "after")
+        got = await wait_received((3, "explicit"), 2)
+        assert got == ["before", "after"]
+        assert silos[0].stats.get("streams.signals.error_unhandled") >= 1
+    finally:
+        await stop_all(silos, client)
+
+
+async def test_stream_signal_rejected_as_data():
+    from orleans_tpu.core.errors import StreamError
+    from orleans_tpu.streams import StreamSignal
+
+    fabric, adapter, silos, client = await start_cluster(1)
+    try:
+        stream = silos[0].stream_providers["sms"].get_stream("sig", "s4")
+        for bad in (stream.on_next(StreamSignal(kind="error")),
+                    stream.on_next_batch(["ok", StreamSignal(kind="completed")])):
+            try:
+                await bad
+                raise AssertionError("expected StreamError")
+            except StreamError:
+                pass
+    finally:
+        await stop_all(silos, client)
