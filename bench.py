@@ -294,8 +294,13 @@ def main() -> None:
     # cross-shard mode runs supers sequentially (depth 1): overlapping
     # collective programs deadlock the single-host CPU backend's shared
     # rendezvous pool — and a sequential record is the honest one for a
-    # correctness-at-scale artifact anyway
-    depth = 1 if cross_shard else PIPELINE_DEPTH
+    # correctness-at-scale artifact anyway. The runtime enforces the
+    # constraint (VectorRuntime.validate_pipeline_depth): an EXPLICIT
+    # BENCH_PIPELINE_DEPTH>1 under --devices>1 fails loudly instead of
+    # hanging; the unconfigured default quietly runs sequential
+    depth = 1 if cross_shard and "BENCH_PIPELINE_DEPTH" not in os.environ \
+        else PIPELINE_DEPTH
+    depth = rt.validate_pipeline_depth(depth)
     inflight: deque = deque()
     completions: list[float] = []
     supers = 0

@@ -54,7 +54,8 @@ class Producer(Grain):
 
 
 async def run(seconds: float = 5.0, batch: int = 64,
-              db_path: str | None = None) -> list[dict]:
+              db_path: str | None = None,
+              concurrency: int = 32) -> list[dict]:
     td = None
     if db_path is None:
         td = tempfile.TemporaryDirectory()
@@ -71,19 +72,33 @@ async def run(seconds: float = 5.0, batch: int = 64,
     try:
         consumer = client.get_grain(Consumer, 1)
         await consumer.join()
-        prod = client.get_grain(Producer, 1)
+        # N producer ACTIVATIONS publishing concurrently: grain turns
+        # serialize per activation, so concurrency in the produce path —
+        # what group commit coalesces into shared fsyncs — requires
+        # distinct producer grains, as a real fan-in deployment has
+        prods = [client.get_grain(Producer, i + 1)
+                 for i in range(concurrency)]
         produced = 0
         t0 = time.perf_counter()
         stop_at = t0 + seconds
         seq = 0
-        while time.perf_counter() < stop_at:
-            await prod.publish(list(range(seq, seq + batch)))
-            seq += batch
-            produced += batch
+
+        async def pump(prod) -> int:
+            nonlocal seq
+            mine = 0
+            while time.perf_counter() < stop_at:
+                lo, seq = seq, seq + batch
+                await prod.publish(list(range(lo, lo + batch)))
+                mine += batch
+            return mine
+
+        produced = sum(await asyncio.gather(*(pump(p) for p in prods)))
         produce_elapsed = time.perf_counter() - t0
         # drain: UNIQUE token coverage must reach produced — dedup by
-        # token, so redelivered duplicates can never mask a lost event
-        deadline = time.monotonic() + 30
+        # token, so redelivered duplicates can never mask a lost event.
+        # Group commit lets produce outrun delivery by a wide margin, so
+        # the drain window scales with the backlog
+        deadline = time.monotonic() + 30 + produced / 5000
         while True:
             unique, deliveries = await consumer.counts()
             if unique >= produced:
@@ -98,6 +113,7 @@ async def run(seconds: float = 5.0, batch: int = 64,
              "value": round(produced / produce_elapsed, 1),
              "unit": "events/sec", "vs_baseline": None,
              "extra": {"produced": produced, "batch": batch,
+                       "concurrency": concurrency,
                        "backend": "sqlite"}},
             {"metric": "streams_durable_delivered_per_sec",
              "value": round(unique / total_elapsed, 1),
@@ -118,8 +134,10 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seconds", type=float, default=5.0)
     ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--concurrency", type=int, default=32)
     a = ap.parse_args()
-    for r in asyncio.run(run(a.seconds, a.batch)):
+    for r in asyncio.run(run(a.seconds, a.batch,
+                             concurrency=a.concurrency)):
         print(json.dumps(r))
 
 

@@ -186,6 +186,36 @@ class VectorRuntime:
         # dispatch.replicated (StatelessWorkerPlacement.cs:6 on device)
         self._replicated_hosts: dict[type, Any] = {}
 
+    def validate_pipeline_depth(self, depth: int,
+                                allow_unproven: bool = False) -> int:
+        """Refuse to keep more than one super-round in flight on a
+        multi-shard mesh.
+
+        Overlapping collective programs (the ``all_to_all`` route fabric)
+        DEADLOCK the single-host CPU backend: concurrently-executing
+        programs contend for the shared cross-device rendezvous pool, and
+        two half-started all_to_alls each hold rendezvous slots the other
+        needs. On real multi-chip hardware the combination (fused pipeline
+        × collectives) has never been executed by this runtime, so it is
+        refused there too until proven; pass ``allow_unproven=True`` to
+        try it on a non-CPU backend at your own risk. Single-shard meshes
+        run no collectives and pipeline freely."""
+        if depth < 1:
+            raise ValueError(f"pipeline depth must be >= 1, got {depth}")
+        n_dev = int(self.mesh.devices.size)
+        if depth > 1 and n_dev > 1:
+            platform = self.mesh.devices.flat[0].platform
+            if platform == "cpu" or not allow_unproven:
+                raise ValueError(
+                    f"pipeline_depth={depth} is not supported on a "
+                    f"{n_dev}-shard mesh ({platform}): overlapping "
+                    "collective programs deadlock the CPU backend's "
+                    "shared rendezvous pool, and the combination is "
+                    "unproven on multi-chip hardware. Run cross-shard "
+                    "supers at depth 1 (sequential), or pass "
+                    "allow_unproven=True on a non-CPU backend.")
+        return depth
+
     def replicated_host(self, cls: type, n_keys: int | None = None):
         """Host ``cls`` as a mesh-replicated stateless worker (no
         directory entry; any shard serves any key; reads fan in via the

@@ -101,6 +101,14 @@ class SiloControl:
         self.silo.locator.invalidate_cache(grain_id)
         return True
 
+    async def ctl_multicluster_stamp(self) -> float | None:
+        """This silo's view of the current multi-cluster configuration
+        stamp (None = no config / no oracle) — the ManagementGrain's
+        lagging-silo stability check reads this before injecting a new
+        configuration."""
+        oracle = getattr(self.silo, "multicluster", None)
+        return oracle.config_stamp() if oracle is not None else None
+
 
 def add_management(builder):
     """Install SiloControl + the management grain + the load publisher on a

@@ -81,6 +81,46 @@ class ManagementGrain(Grain):
                                          selector: str | None = None) -> None:
         await self._fan_out("ctl_set_compatibility_strategy", compat, selector)
 
+    # -- multi-cluster administration (ManagementGrain.cs:387-427) --------
+    async def get_multicluster_configuration(self) -> dict | None:
+        """The active admin-injected configuration, or None when the
+        network runs zero-conf (gossip-governed membership)."""
+        oracle = getattr(self._activation.runtime, "multicluster", None)
+        if oracle is None:
+            raise RuntimeError("multi-cluster is not configured on this "
+                               "cluster (add_multicluster)")
+        return oracle.active_config()
+
+    async def inject_multicluster_configuration(
+            self, clusters: list[str], comment: str = "",
+            check_for_lagging_silos: bool = True) -> dict:
+        """Replace the multi-cluster configuration
+        (InjectMultiClusterConfiguration :392): verifies first — unless
+        told not to — that every silo in THIS cluster has converged on
+        the current configuration (an unreachable silo, or one still
+        gossiping an older stamp, aborts the injection: injecting over a
+        lagging silo could strand it on a config two generations back),
+        then stamps + gossips the new cluster list. Clusters removed by
+        the new configuration have their GSI entries demoted to Doubtful
+        everywhere so grains re-home (see
+        ClusterDirectoryGrain.demote_removed_owners)."""
+        oracle = getattr(self._activation.runtime, "multicluster", None)
+        if oracle is None:
+            raise RuntimeError("multi-cluster is not configured on this "
+                               "cluster (add_multicluster)")
+        if check_for_lagging_silos:
+            silos = self._silos()
+            stamps = await self._fan_out("ctl_multicluster_stamp")
+            cur = oracle.config_stamp()
+            lagging = [s for s in map(str, silos)
+                       if s not in stamps or stamps[s] != cur]
+            if lagging:
+                raise RuntimeError(
+                    f"cannot inject multi-cluster configuration: silos "
+                    f"not stabilized on the current configuration: "
+                    f"{lagging}")
+        return await oracle.inject_configuration(clusters, comment)
+
     async def find_lagging_silos(self, threshold: float = 0.5) -> list[str]:
         """Silos whose control surface responds slower than ``threshold``
         seconds (FindLaggingSilos :424)."""

@@ -35,10 +35,16 @@ __all__ = ["MultiClusterData", "InMemoryGossipChannel", "FileGossipChannel",
 @dataclass
 class MultiClusterData:
     """Gossiped payload (MultiClusterData): per-cluster gateway lists +
-    stamps; merge = per-key newest stamp wins."""
+    stamps, plus the admin-injected configuration; merge = per-key (and
+    for the config) newest stamp wins."""
 
     clusters: dict[str, dict] = field(default_factory=dict)
     # clusters[cluster_id] = {"gateways": [SiloAddress], "stamp": float}
+    # admin-injected multi-cluster configuration
+    # (MultiClusterConfiguration: timestamped cluster list + comment);
+    # None until an operator injects one — gossip membership then governs
+    config: dict | None = None
+    # config = {"clusters": [str], "stamp": float, "comment": str}
 
     def merge(self, other: "MultiClusterData") -> bool:
         changed = False
@@ -47,10 +53,17 @@ class MultiClusterData:
             if mine is None or entry["stamp"] > mine["stamp"]:
                 self.clusters[cid] = dict(entry)
                 changed = True
+        if other.config is not None and (
+                self.config is None
+                or other.config["stamp"] > self.config["stamp"]):
+            self.config = dict(other.config)
+            changed = True
         return changed
 
     def copy(self) -> "MultiClusterData":
-        return MultiClusterData({k: dict(v) for k, v in self.clusters.items()})
+        return MultiClusterData(
+            {k: dict(v) for k, v in self.clusters.items()},
+            dict(self.config) if self.config else None)
 
 
 class GossipChannel:
@@ -77,19 +90,27 @@ class InMemoryGossipChannel(GossipChannel):
         return self._data.copy()
 
 
+_CONFIG_KEY = "__config__"  # reserved: not a valid cluster id
+
+
 def _data_to_json(data: MultiClusterData) -> dict:
-    return {cid: {"stamp": e["stamp"],
-                  "gateways": [[g.host, g.port, g.generation, g.mesh_index]
-                               for g in e["gateways"]]}
-            for cid, e in data.clusters.items()}
+    out = {cid: {"stamp": e["stamp"],
+                 "gateways": [[g.host, g.port, g.generation, g.mesh_index]
+                              for g in e["gateways"]]}
+           for cid, e in data.clusters.items()}
+    if data.config is not None:
+        out[_CONFIG_KEY] = dict(data.config)
+    return out
 
 
 def _data_from_json(raw: dict) -> MultiClusterData:
+    config = raw.get(_CONFIG_KEY)
     return MultiClusterData({
         cid: {"stamp": e["stamp"],
               "gateways": [SiloAddress(h, p, g, m)
                            for h, p, g, m in e["gateways"]]}
-        for cid, e in raw.items()})
+        for cid, e in raw.items() if cid != _CONFIG_KEY},
+        dict(config) if config else None)
 
 
 class FileGossipChannel(GossipChannel):
@@ -159,6 +180,17 @@ class SqliteGossipChannel(GossipChannel):
                             (cid, e["stamp"], json.dumps(
                                 [[g.host, g.port, g.generation, g.mesh_index]
                                  for g in e["gateways"]])))
+                if data.config is not None:
+                    # the admin configuration rides the same table under a
+                    # reserved key; the gateways column carries its JSON
+                    row = self._db.execute(
+                        "SELECT stamp FROM gossip WHERE cluster=?",
+                        (_CONFIG_KEY,)).fetchone()
+                    if row is None or data.config["stamp"] > row[0]:
+                        self._db.execute(
+                            "INSERT OR REPLACE INTO gossip VALUES (?,?,?)",
+                            (_CONFIG_KEY, data.config["stamp"],
+                             json.dumps(data.config)))
                 self._db.commit()
 
         await asyncio.get_running_loop().run_in_executor(None, write)
@@ -168,11 +200,17 @@ class SqliteGossipChannel(GossipChannel):
             with self._dblock:
                 rows = self._db.execute(
                     "SELECT cluster, stamp, gateways FROM gossip").fetchall()
-            return MultiClusterData({
-                cid: {"stamp": stamp,
-                      "gateways": [SiloAddress(h, p, g, m)
-                                   for h, p, g, m in json.loads(gws)]}
-                for cid, stamp, gws in rows})
+            config = None
+            clusters = {}
+            for cid, stamp, gws in rows:
+                if cid == _CONFIG_KEY:
+                    config = json.loads(gws)
+                else:
+                    clusters[cid] = {
+                        "stamp": stamp,
+                        "gateways": [SiloAddress(h, p, g, m)
+                                     for h, p, g, m in json.loads(gws)]}
+            return MultiClusterData(clusters, config)
 
         return await asyncio.get_running_loop().run_in_executor(None, load)
 
@@ -189,6 +227,11 @@ class MultiClusterOracle:
         self.gossip_period = gossip_period
         self.data = MultiClusterData()
         self._task: asyncio.Task | None = None
+        # fired (sync, with the new config dict) whenever a NEWER admin
+        # configuration lands — whether injected locally or learned
+        # through gossip; the GSI runtime hooks this for removed-cluster
+        # entry demotion
+        self.config_listeners: list = []
 
     def start(self) -> None:
         self._task = asyncio.get_running_loop().create_task(self._loop())
@@ -207,18 +250,64 @@ class MultiClusterOracle:
             await asyncio.sleep(self.gossip_period)
 
     async def gossip_once(self) -> None:
-        """One round: stamp our view, merge every channel, publish back."""
+        """One round: stamp our view, merge every channel, publish back.
+        A newer admin configuration learned from any channel fires the
+        config listeners."""
         self.data.clusters[self.cluster_id] = {
             "gateways": list(self.silo.locator.alive_list),
             "stamp": time.time(),
         }
+        before = self.config_stamp()
         for ch in self.channels:
             remote = await ch.read()
             self.data.merge(remote)
             await ch.publish(self.data)
+        if self.config_stamp() != before:
+            self._fire_config_listeners()
+
+    def _fire_config_listeners(self) -> None:
+        for fn in list(self.config_listeners):
+            try:
+                fn(self.data.config)
+            except Exception:  # noqa: BLE001 — listeners are best-effort
+                log.exception("multicluster config listener failed")
+
+    # -- admin configuration (ManagementGrain.cs:387-427 backing) ---------
+    def config_stamp(self) -> float | None:
+        return self.data.config["stamp"] if self.data.config else None
+
+    async def inject_configuration(self, clusters: list[str],
+                                   comment: str = "") -> dict:
+        """Replace the active multi-cluster configuration
+        (MultiClusterOracle.InjectMultiClusterConfiguration): timestamped,
+        last-writer-wins, gossiped immediately so peers converge within
+        one channel round-trip. Returns the injected config."""
+        clusters = sorted(set(clusters))
+        if not clusters:
+            raise ValueError("multi-cluster configuration must name at "
+                             "least one cluster")
+        cfg = {"clusters": clusters, "stamp": time.time(),
+               "comment": comment}
+        if self.data.config and cfg["stamp"] <= self.data.config["stamp"]:
+            # same-clock-tick re-injection still must win LWW
+            cfg["stamp"] = self.data.config["stamp"] + 1e-6
+        self.data.config = cfg
+        self._fire_config_listeners()
+        await self.gossip_once()
+        return dict(cfg)
 
     # -- queries ---------------------------------------------------------
+    def active_config(self) -> dict | None:
+        return dict(self.data.config) if self.data.config else None
+
     def known_clusters(self) -> list[str]:
+        """The multi-cluster network's member set: the admin-injected
+        configuration when one exists (the reference's conf-governed
+        membership), else everything gossip has merged (zero-conf mode).
+        A configured-but-never-seen cluster is still listed — its
+        gateways just resolve empty until it gossips."""
+        if self.data.config is not None:
+            return list(self.data.config["clusters"])
         return sorted(self.data.clusters)
 
     def gateways_of(self, cluster_id: str) -> list[SiloAddress]:

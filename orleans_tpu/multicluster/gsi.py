@@ -15,6 +15,7 @@ a DCN deployment binds it to remote cluster-gateway calls.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import logging
 from dataclasses import dataclass
 from enum import Enum
@@ -208,6 +209,30 @@ def _make_grain_base():
             return [gid for gid, e in self._registrar_ref().entries.items()
                     if e.state == GsiState.CACHED]
 
+        async def demote_removed_owners(self, active: list) -> int:
+            """Admin-config removal semantics: entries whose owner
+            cluster was removed from the multi-cluster configuration
+            become DOUBTFUL, so the maintainer re-runs the protocol
+            against the REMAINING clusters and the grains re-home
+            (typically to this cluster, now that the old owner is no
+            longer queried). Entries we own ourselves are untouched."""
+            reg = self._registrar_ref()
+            active_set = set(active)
+            demoted = 0
+            for e in reg.entries.values():
+                # CACHED/RACE_LOSER only: already-Doubtful entries are the
+                # maintainer's job regardless — recounting them here would
+                # re-persist and re-log on every later config event
+                if e.owner_cluster != reg.cluster_id \
+                        and e.owner_cluster not in active_set \
+                        and e.state in (GsiState.CACHED,
+                                        GsiState.RACE_LOSER):
+                    e.state = GsiState.DOUBTFUL
+                    demoted += 1
+            if demoted:
+                await self._persist()
+            return demoted
+
     _ClusterDirectoryGrain.__name__ = "ClusterDirectoryGrain"
     return _ClusterDirectoryGrain
 
@@ -241,11 +266,15 @@ class GsiRuntime:
         if self._maintainer is None:
             self._maintainer = asyncio.get_running_loop().create_task(
                 self._maintainer_loop())
+        if self._on_config not in self.oracle.config_listeners:
+            self.oracle.config_listeners.append(self._on_config)
 
     async def stop(self) -> None:
         if self._maintainer is not None:
             self._maintainer.cancel()
             self._maintainer = None
+        with contextlib.suppress(ValueError):
+            self.oracle.config_listeners.remove(self._on_config)
         for c in self._clients.values():
             try:
                 # close_async tears down the reconnect loop + sockets;
@@ -257,6 +286,36 @@ class GsiRuntime:
 
     def known_clusters(self) -> list[str]:
         return self.oracle.known_clusters()
+
+    def _on_config(self, config: dict) -> None:
+        """A new admin configuration landed (injected here or learned via
+        gossip): demote GSI entries owned by removed clusters so the
+        maintainer re-homes them, and drop cached gateway clients to
+        clusters no longer in the network."""
+        if config is None:
+            return
+        active = list(config["clusters"])
+        loop = asyncio.get_running_loop()
+
+        async def apply() -> None:
+            for cid in [c for c in self._clients if c not in active]:
+                client = self._clients.pop(cid, None)
+                if client is not None:
+                    try:
+                        await client.close_async()
+                    except Exception:  # noqa: BLE001
+                        pass
+            if self.silo.status != "Running":
+                return
+            try:
+                n = await self._directory().demote_removed_owners(active)
+                if n:
+                    log.info("multicluster config change: %d GSI entries "
+                             "demoted to Doubtful for re-homing", n)
+            except Exception:  # noqa: BLE001
+                log.exception("removed-owner demotion failed")
+
+        loop.create_task(apply())
 
     # -- local directory surface -----------------------------------------
     def _directory(self):

@@ -47,9 +47,86 @@ except ImportError:  # pragma: no cover — non-POSIX fallback
 __all__ = ["DurableQueueAdapter", "FileQueueAdapter", "SqliteQueueAdapter"]
 
 
+class _GroupCommitter:
+    """Group commit: coalesce produces that are concurrently in flight
+    into ONE durable commit (the batched-write analog of the reference's
+    ``QueueMessageBatchAsync`` path consumed by
+    PersistentStreamPullingAgent.cs:350-368). Entries arriving while a
+    flush runs in the executor join the NEXT flush, so N concurrent
+    producers share ~1 fsync per flush instead of paying one each; a solo
+    producer flushes immediately — no batching-window latency is ever
+    added. Each submitter's await completes only after the commit that
+    contains its entry is durable (or fails, with the flush error)."""
+
+    def __init__(self, flush):
+        self._flush = flush  # flush(entries) — blocking, runs in executor
+        self._pending: list = []
+        self._task: asyncio.Task | None = None
+
+    async def submit(self, entry) -> None:
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._pending.append((entry, fut))
+        if self._task is None or self._task.done():
+            self._task = loop.create_task(self._drain())
+        await fut
+
+    async def _drain(self) -> None:
+        loop = asyncio.get_running_loop()
+        while self._pending:
+            batch, self._pending = self._pending, []
+            entries = [e for e, _ in batch]
+            try:
+                await loop.run_in_executor(None, self._flush, entries)
+            except asyncio.CancelledError:
+                # loop teardown: the in-flight commit may still land in
+                # the executor thread, but its waiters cannot learn that —
+                # cancel them (at-least-once: a retry re-produces) and
+                # STOP draining; swallowing the cancel would re-enter
+                # run_in_executor on a closing loop
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.cancel()
+                raise
+            except BaseException as exc:  # noqa: BLE001 — to every waiter
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(exc)
+            else:
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_result(None)
+
+
 class DurableQueueAdapter(QueueAdapter):
     """Shared contract of the durable backends; adds :meth:`replay` (the
-    rewind-beyond-cache source consumed by the pulling agent's pumps)."""
+    rewind-beyond-cache source consumed by the pulling agent's pumps) and
+    the group-commit produce path."""
+
+    def _flush_entries(self, entries: list) -> None:
+        """Blocking: durably commit a produce flush group (subclass hook)."""
+        raise NotImplementedError
+
+    def _flush_acks(self, entries: list) -> None:
+        """Blocking: durably commit an ack flush group (subclass hook)."""
+        raise NotImplementedError
+
+    def _committer(self, kind: str, flush) -> _GroupCommitter:
+        """One committer per (event loop, kind): adapters are shared
+        objects (the 'external queue service'), and tests drive them from
+        several sequential loops — futures must never cross loops.
+        Committers of closed loops are pruned so sequential loops (and
+        their retained tasks/futures) do not accumulate."""
+        by_key = getattr(self, "_committers", None)
+        if by_key is None:
+            by_key = self._committers = {}
+        for stale in [k for k in by_key if k[0].is_closed()]:
+            del by_key[stale]
+        key = (asyncio.get_running_loop(), kind)
+        c = by_key.get(key)
+        if c is None:
+            c = by_key[key] = _GroupCommitter(flush)
+        return c
 
     async def replay(self, stream: StreamId,
                      from_seq: int) -> list[QueueBatch]:
@@ -127,43 +204,54 @@ class SqliteQueueAdapter(DurableQueueAdapter):
     async def queue_message_batch(self, queue_id, stream, items) -> None:
         blob = serialize_portable(list(items))
         sblob = serialize_portable(stream)
-        n = len(items)
+        await self._committer("produce", self._flush_entries).submit(
+            (queue_id, sblob, blob, len(items)))
 
-        def write() -> None:
-            with self._lock:
-                # BEGIN IMMEDIATE takes the write lock BEFORE the seq
-                # read: two producer PROCESSES sharing this .db must not
-                # both read the same max seq (deferred transactions would
-                # let them, and one INSERT would die on the PK)
-                self._db.execute("BEGIN IMMEDIATE")
-                try:
-                    # item-cumulative per-queue seq (EventSequenceToken
-                    # contract): next = previous seq + previous item count.
-                    # max() with the watermark: rows alone under-count after
-                    # retention drained the queue; the watermark alone
-                    # under-counts on a pre-watermark db being upgraded
-                    row = self._db.execute(
-                        "SELECT seq + n FROM stream_batches WHERE queue_id=?"
-                        " ORDER BY seq DESC LIMIT 1", (queue_id,)).fetchone()
-                    wm = self._db.execute(
-                        "SELECT next_seq FROM stream_watermarks"
-                        " WHERE queue_id=?", (queue_id,)).fetchone()
-                    seq = max(row[0] if row else 0, wm[0] if wm else 0)
+    def _flush_entries(self, entries: list) -> None:
+        """One transaction (one WAL fsync) commits every produce in the
+        flush group."""
+        with self._lock:
+            # BEGIN IMMEDIATE takes the write lock BEFORE the seq
+            # read: two producer PROCESSES sharing this .db must not
+            # both read the same max seq (deferred transactions would
+            # let them, and one INSERT would die on the PK)
+            self._db.execute("BEGIN IMMEDIATE")
+            try:
+                next_seq: dict[int, int] = {}
+                for queue_id, sblob, blob, n in entries:
+                    if queue_id not in next_seq:
+                        # item-cumulative per-queue seq
+                        # (EventSequenceToken contract): next = previous
+                        # seq + previous item count. max() with the
+                        # watermark: rows alone under-count after
+                        # retention drained the queue; the watermark
+                        # alone under-counts on a pre-watermark db
+                        row = self._db.execute(
+                            "SELECT seq + n FROM stream_batches"
+                            " WHERE queue_id=?"
+                            " ORDER BY seq DESC LIMIT 1",
+                            (queue_id,)).fetchone()
+                        wm = self._db.execute(
+                            "SELECT next_seq FROM stream_watermarks"
+                            " WHERE queue_id=?", (queue_id,)).fetchone()
+                        next_seq[queue_id] = max(row[0] if row else 0,
+                                                 wm[0] if wm else 0)
+                    seq = next_seq[queue_id]
                     self._db.execute(
                         "INSERT INTO stream_batches"
                         " (queue_id, seq, stream, items, n)"
                         " VALUES (?,?,?,?,?)",
                         (queue_id, seq, sblob, blob, n))
+                    next_seq[queue_id] = seq + n
+                for queue_id, ns in next_seq.items():
                     self._db.execute(
                         "INSERT OR REPLACE INTO stream_watermarks"
                         " (queue_id, next_seq) VALUES (?,?)",
-                        (queue_id, seq + n))
-                    self._db.commit()
-                except BaseException:
-                    self._db.rollback()
-                    raise
-
-        await asyncio.get_running_loop().run_in_executor(None, write)
+                        (queue_id, ns))
+                self._db.commit()
+            except BaseException:
+                self._db.rollback()
+                raise
 
     def create_receiver(self, queue_id: int) -> QueueReceiver:
         return _DurableReceiver(self, queue_id)
@@ -194,22 +282,32 @@ class SqliteQueueAdapter(DurableQueueAdapter):
         return out
 
     async def _ack(self, queue_id: int, seq: int) -> None:
-        def write() -> None:
-            with self._lock:
-                self._db.execute(
-                    "UPDATE stream_batches SET acked=1"
-                    " WHERE queue_id=? AND seq=?", (queue_id, seq))
-                # bounded retention: keep the newest `retention` acked
-                # batches per queue for rewind replay, drop older
-                self._db.execute(
-                    "DELETE FROM stream_batches WHERE queue_id=? AND acked=1"
-                    " AND seq NOT IN (SELECT seq FROM stream_batches"
-                    "  WHERE queue_id=? AND acked=1"
-                    "  ORDER BY seq DESC LIMIT ?)",
-                    (queue_id, queue_id, self.retention))
-                self._db.commit()
+        await self._committer("ack", self._flush_acks).submit(
+            (queue_id, seq))
 
-        await asyncio.get_running_loop().run_in_executor(None, write)
+    def _flush_acks(self, entries: list) -> None:
+        """One transaction acks the whole flush group; the retention
+        sweep runs once per touched queue, not once per ack."""
+        with self._lock:
+            self._db.execute("BEGIN IMMEDIATE")
+            try:
+                self._db.executemany(
+                    "UPDATE stream_batches SET acked=1"
+                    " WHERE queue_id=? AND seq=?", entries)
+                for queue_id in {q for q, _ in entries}:
+                    # bounded retention: keep the newest `retention` acked
+                    # batches per queue for rewind replay, drop older
+                    self._db.execute(
+                        "DELETE FROM stream_batches WHERE queue_id=?"
+                        " AND acked=1"
+                        " AND seq NOT IN (SELECT seq FROM stream_batches"
+                        "  WHERE queue_id=? AND acked=1"
+                        "  ORDER BY seq DESC LIMIT ?)",
+                        (queue_id, queue_id, self.retention))
+                self._db.commit()
+            except BaseException:
+                self._db.rollback()
+                raise
 
     async def replay(self, stream: StreamId,
                      from_seq: int) -> list[QueueBatch]:
@@ -340,38 +438,48 @@ class FileQueueAdapter(DurableQueueAdapter):
                "b": base64.b64encode(
                    serialize_portable(list(items))).decode(),
                "n": len(items)}
+        await self._committer("produce", self._flush_entries).submit(
+            (queue_id, rec))
 
-        def write() -> None:
-            with self._lock, self._os_lock(queue_id):
-                # cached next-seq, revalidated by file size under the
-                # flock: steady-state single-process produce is O(1); a
-                # cross-process writer (or a torn tail) shows up as a
-                # size mismatch and forces one rescan (the
-                # FileTransactionLog index pattern)
-                path = self._log(queue_id)
-                try:
-                    size = os.path.getsize(path)
-                except OSError:
-                    size = 0
-                if self._scanned.get(queue_id) != size:
-                    _rows, valid_end, next_seq = \
-                        self._read_log_raw(queue_id)
-                    if valid_end < size:
-                        # truncate a crashed writer's torn tail so the
-                        # record appended below stays parseable
-                        with open(path, "r+b") as tf:
-                            tf.truncate(valid_end)
-                    self._next_seq[queue_id] = next_seq
-                seq = self._next_seq.get(queue_id, 0)
-                rec["s"] = seq
-                with open(path, "a", encoding="utf-8") as f:
-                    f.write(json.dumps(rec, separators=(",", ":")) + "\n")
-                    f.flush()
-                    os.fsync(f.fileno())
-                    self._scanned[queue_id] = f.tell()
-                self._next_seq[queue_id] = seq + rec["n"]
-
-        await asyncio.get_running_loop().run_in_executor(None, write)
+    def _flush_entries(self, entries: list) -> None:
+        """One append + one fsync per QUEUE per flush group commits every
+        produce in the group."""
+        by_q: dict[int, list[dict]] = {}
+        for queue_id, rec in entries:
+            by_q.setdefault(queue_id, []).append(rec)
+        with self._lock:
+            for queue_id, recs in by_q.items():
+                with self._os_lock(queue_id):
+                    # cached next-seq, revalidated by file size under the
+                    # flock: steady-state single-process produce is O(1);
+                    # a cross-process writer (or a torn tail) shows up as
+                    # a size mismatch and forces one rescan (the
+                    # FileTransactionLog index pattern)
+                    path = self._log(queue_id)
+                    try:
+                        size = os.path.getsize(path)
+                    except OSError:
+                        size = 0
+                    if self._scanned.get(queue_id) != size:
+                        _rows, valid_end, next_seq = \
+                            self._read_log_raw(queue_id)
+                        if valid_end < size:
+                            # truncate a crashed writer's torn tail so
+                            # the records appended below stay parseable
+                            with open(path, "r+b") as tf:
+                                tf.truncate(valid_end)
+                        self._next_seq[queue_id] = next_seq
+                    seq = self._next_seq.get(queue_id, 0)
+                    with open(path, "a", encoding="utf-8") as f:
+                        for rec in recs:
+                            rec["s"] = seq
+                            seq += rec["n"]
+                            f.write(json.dumps(rec, separators=(",", ":"))
+                                    + "\n")
+                        f.flush()
+                        os.fsync(f.fileno())
+                        self._scanned[queue_id] = f.tell()
+                    self._next_seq[queue_id] = seq
 
     def create_receiver(self, queue_id: int) -> QueueReceiver:
         return _DurableReceiver(self, queue_id)
@@ -394,23 +502,32 @@ class FileQueueAdapter(DurableQueueAdapter):
         return await asyncio.get_running_loop().run_in_executor(None, read)
 
     async def _ack(self, queue_id: int, seq: int) -> None:
-        def write() -> None:
-            # the flock serializes against a concurrent compaction in
-            # ANOTHER process: its ack-file rewrite must never discard an
-            # ack appended between its read and its replace
-            with self._lock, self._os_lock(queue_id):
-                with open(self._ackf(queue_id), "a",
-                          encoding="utf-8") as f:
-                    f.write(f"{seq}\n")
-                    f.flush()
-                    os.fsync(f.fileno())
-                n = self._acks_since_compact.get(queue_id, 0) + 1
-                if n >= max(self.retention, 64):
-                    self._compact_under_flock(queue_id)
-                    n = 0
-                self._acks_since_compact[queue_id] = n
+        await self._committer("ack", self._flush_acks).submit(
+            (queue_id, seq))
 
-        await asyncio.get_running_loop().run_in_executor(None, write)
+    def _flush_acks(self, entries: list) -> None:
+        """One append + one fsync per queue acks the whole flush group;
+        the compaction check runs once per touched queue."""
+        by_q: dict[int, list[int]] = {}
+        for queue_id, seq in entries:
+            by_q.setdefault(queue_id, []).append(seq)
+        with self._lock:
+            for queue_id, seqs in by_q.items():
+                # the flock serializes against a concurrent compaction in
+                # ANOTHER process: its ack-file rewrite must never discard
+                # an ack appended between its read and its replace
+                with self._os_lock(queue_id):
+                    with open(self._ackf(queue_id), "a",
+                              encoding="utf-8") as f:
+                        f.writelines(f"{seq}\n" for seq in seqs)
+                        f.flush()
+                        os.fsync(f.fileno())
+                    n = self._acks_since_compact.get(queue_id, 0) \
+                        + len(seqs)
+                    if n >= max(self.retention, 64):
+                        self._compact_under_flock(queue_id)
+                        n = 0
+                    self._acks_since_compact[queue_id] = n
 
     def _compact_locked(self, q: int) -> None:
         """Compact with only ``_lock`` held (takes the flock itself).

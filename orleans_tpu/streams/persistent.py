@@ -404,12 +404,19 @@ class PullingAgent:
     async def evict_and_ack(self) -> None:
         """Evict fully-consumed batches and ack them upstream — at-least-once
         delivery: a batch leaves the external queue only after every
-        consumer cursor has passed it."""
-        for batch in self.cache.purge():
-            try:
-                await self.receiver.ack(batch)
-            except Exception:  # noqa: BLE001
-                log.exception("ack failed for queue %d", self.queue_id)
+        consumer cursor has passed it. Acks are independent (each marks a
+        distinct seq) and issue concurrently so a group-committing
+        durable backend coalesces them into shared fsyncs."""
+        purged = self.cache.purge()
+        if not purged:
+            return
+        results = await asyncio.gather(
+            *(self.receiver.ack(b) for b in purged),
+            return_exceptions=True)
+        for r in results:
+            if isinstance(r, BaseException):
+                log.warning("ack failed for queue %d: %r",
+                            self.queue_id, r)
 
 
 class PullingManager:

@@ -1,0 +1,194 @@
+"""Multi-cluster admin configuration: operator-injected cluster lists
+with lagging-silo stability checks, config gossip convergence, and
+removal semantics (GSI entries owned by a removed cluster demote to
+Doubtful and re-home). Reference:
+/root/reference/src/Orleans.Runtime/Core/ManagementGrain.cs:387-427
+(InjectMultiClusterConfiguration) over MultiClusterOracle.cs."""
+
+import asyncio
+
+import pytest
+
+from orleans_tpu.core.ids import GrainId
+from orleans_tpu.management import ManagementGrain, add_management
+from orleans_tpu.membership import FileMembershipTable, join_cluster
+from orleans_tpu.multicluster import (
+    FileGossipChannel,
+    GsiState,
+    add_multicluster,
+    global_single_instance,
+)
+from orleans_tpu.runtime import GatewayClient, Grain, SiloBuilder, SocketFabric
+from orleans_tpu.runtime.grain import grain_type_of
+
+FAST = dict(
+    membership_probe_period=0.1,
+    membership_probe_timeout=0.2,
+    membership_missed_probes_limit=2,
+    membership_votes_needed=1,
+    membership_iam_alive_period=0.5,
+    membership_refresh_period=0.2,
+    membership_vote_expiration=5.0,
+    response_timeout=5.0,
+)
+
+
+@global_single_instance
+class ItemGrain(Grain):
+    async def put(self, v):
+        self._v = v
+        return self.runtime_identity
+
+    async def get(self):
+        return (getattr(self, "_v", None), self.runtime_identity)
+
+
+async def _start_cluster(cluster_id, channel, tmp_path, n_silos=1,
+                         maintainer_period=0.2):
+    fabric = SocketFabric()
+    table = FileMembershipTable(str(tmp_path / f"mbr-{cluster_id}.json"))
+    silos = []
+    for i in range(n_silos):
+        b = (SiloBuilder().with_name(f"{cluster_id}-s{i}")
+             .with_fabric(fabric).add_grains(ItemGrain)
+             .with_config(**FAST))
+        add_multicluster(b, cluster_id, [channel], gossip_period=0.1,
+                         maintainer_period=maintainer_period)
+        add_management(b)
+        silo = b.build()
+        join_cluster(silo, table)
+        await silo.start()
+        silos.append(silo)
+    return silos
+
+
+async def _wait(cond, timeout=10.0, msg="condition"):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while not cond():
+        if asyncio.get_running_loop().time() > deadline:
+            raise AssertionError(f"timed out waiting for {msg}")
+        await asyncio.sleep(0.05)
+
+
+async def test_inject_configuration_gossips_to_all_clusters(tmp_path):
+    """Injection through the ManagementGrain stamps + gossips the config;
+    every cluster's oracle converges on it and known_clusters becomes
+    conf-governed (a configured-but-silent cluster stays listed)."""
+    channel = FileGossipChannel(str(tmp_path / "gossip.json"))
+    (a,) = await _start_cluster("A", channel, tmp_path)
+    (b,) = await _start_cluster("B", channel, tmp_path)
+    ca = None
+    try:
+        await _wait(lambda: set(a.multicluster.known_clusters())
+                    >= {"A", "B"} and a.multicluster.gateways_of("B"),
+                    msg="initial gossip")
+        ca = await GatewayClient([a.silo_address.endpoint],
+                                 response_timeout=5.0).connect()
+        mgmt = ca.get_grain(ManagementGrain, 0)
+        assert await mgmt.get_multicluster_configuration() is None
+        cfg = await mgmt.inject_multicluster_configuration(
+            ["A", "B", "C"], comment="add planned cluster C")
+        assert cfg["clusters"] == ["A", "B", "C"]
+        # conf-governed membership: C listed though it never gossiped
+        assert a.multicluster.known_clusters() == ["A", "B", "C"]
+        # B learns the config through the channel
+        await _wait(lambda: b.multicluster.config_stamp() == cfg["stamp"],
+                    msg="config convergence on B")
+        assert b.multicluster.known_clusters() == ["A", "B", "C"]
+        assert (await mgmt.get_multicluster_configuration())["comment"] \
+            == "add planned cluster C"
+    finally:
+        if ca is not None:
+            await ca.close_async()
+        await a.stop()
+        await b.stop()
+
+
+async def test_removed_cluster_entries_rehome(tmp_path):
+    """Inject, then REMOVE a cluster: the surviving cluster's CACHED
+    entries owned by the removed cluster demote to Doubtful and the
+    maintainer re-homes the grains locally — calls that used to forward
+    now activate in the surviving cluster."""
+    channel = FileGossipChannel(str(tmp_path / "gossip.json"))
+    (a,) = await _start_cluster("A", channel, tmp_path)
+    (b,) = await _start_cluster("B", channel, tmp_path)
+    ca = cb = None
+    try:
+        await _wait(lambda: set(a.multicluster.known_clusters())
+                    >= {"A", "B"} and a.multicluster.gateways_of("B")
+                    and b.multicluster.gateways_of("A"),
+                    msg="initial gossip")
+        ca = await GatewayClient([a.silo_address.endpoint],
+                                 response_timeout=5.0).connect()
+        cb = await GatewayClient([b.silo_address.endpoint],
+                                 response_timeout=5.0).connect()
+        # A touches first and owns globally; B caches at A
+        where = await ca.get_grain(ItemGrain, "it1").put("v1")
+        assert where == str(a.silo_address)
+        _, served_by = await cb.get_grain(ItemGrain, "it1").get()
+        assert served_by == str(a.silo_address)
+        gid = GrainId.for_grain(grain_type_of(ItemGrain), "it1")
+        state, owner = await b.gsi.status(gid)
+        assert state == GsiState.CACHED.value and owner == "A"
+        # operator removes cluster A from the network (via B's mgmt)
+        mgmt = cb.get_grain(ManagementGrain, 0)
+        cfg = await mgmt.inject_multicluster_configuration(
+            ["B"], comment="decommission A")
+        assert b.multicluster.known_clusters() == ["B"]
+        # B's entry re-homes: Doubtful -> re-registered -> OWNED by B
+
+        async def rehomed():
+            s, o = await b.gsi.status(gid)
+            return s == GsiState.OWNED.value and o == "B"
+
+        deadline = asyncio.get_running_loop().time() + 10
+        while not await rehomed():
+            assert asyncio.get_running_loop().time() < deadline, \
+                "entry never re-homed to B"
+            await asyncio.sleep(0.1)
+        # calls through B now serve locally (a fresh activation)
+        _, served_by = await cb.get_grain(ItemGrain, "it1").get()
+        assert served_by == str(b.silo_address)
+        assert cfg["clusters"] == ["B"]
+    finally:
+        for c in (ca, cb):
+            if c is not None:
+                await c.close_async()
+        await a.stop()
+        await b.stop()
+
+
+async def test_inject_refuses_on_lagging_silo(tmp_path):
+    """A silo still gossiping an older configuration stamp blocks
+    injection (the stabilization precondition); once it converges the
+    injection proceeds."""
+    channel = FileGossipChannel(str(tmp_path / "gossip.json"))
+    s0, s1 = await _start_cluster("A", channel, tmp_path, n_silos=2)
+    ca = None
+    try:
+        await _wait(lambda: len(s0.locator.alive_list) == 2,
+                    msg="2-silo membership")
+        ca = await GatewayClient([s0.silo_address.endpoint],
+                                 response_timeout=5.0).connect()
+        mgmt = ca.get_grain(ManagementGrain, 0)
+        first = await mgmt.inject_multicluster_configuration(["A", "B"])
+        # simulate a lagging silo: force one oracle onto a divergent stamp
+        lagger = s1 if s1.multicluster.config_stamp() == first["stamp"] \
+            else s0
+        lagger.multicluster.data.config = {
+            "clusters": ["A"], "stamp": first["stamp"] - 100,
+            "comment": "stale"}
+        with pytest.raises(Exception, match="not stabilized"):
+            await mgmt.inject_multicluster_configuration(["A"])
+        # heal: let gossip re-converge the lagger, then inject succeeds
+        await _wait(lambda: s0.multicluster.config_stamp()
+                    == s1.multicluster.config_stamp(),
+                    msg="stamp convergence")
+        cfg = await mgmt.inject_multicluster_configuration(
+            ["A"], check_for_lagging_silos=True)
+        assert cfg["clusters"] == ["A"]
+    finally:
+        if ca is not None:
+            await ca.close_async()
+        await s0.stop()
+        await s1.stop()

@@ -251,3 +251,24 @@ async def test_call_auto_fresh_on_dense_key():
     rt = VectorRuntime(capacity_per_shard=16)
     rt.table(Seeded2).ensure_dense(4)
     assert await rt.actor(Seeded2, 2).get() == 100
+
+
+def test_pipeline_depth_guard_on_multi_shard_mesh():
+    """Overlapping collective programs deadlock the CPU backend's shared
+    rendezvous pool: the runtime must refuse depth>1 on a multi-shard
+    mesh instead of hanging (bench.py documents the failure; this guard
+    makes it a loud error, not a convention)."""
+    import pytest
+
+    multi = VectorRuntime(mesh=make_mesh(8))
+    assert multi.validate_pipeline_depth(1) == 1
+    with pytest.raises(ValueError, match="rendezvous"):
+        multi.validate_pipeline_depth(2)
+    # allow_unproven only unlocks non-CPU backends; CPU always refuses
+    with pytest.raises(ValueError, match="rendezvous"):
+        multi.validate_pipeline_depth(2, allow_unproven=True)
+    with pytest.raises(ValueError):
+        multi.validate_pipeline_depth(0)
+    # single-shard meshes run no collectives: any depth pipelines freely
+    solo = VectorRuntime(mesh=make_mesh(1))
+    assert solo.validate_pipeline_depth(4) == 4
