@@ -1,0 +1,74 @@
+"""Shared device-time attribution for the TPU benchmarks.
+
+The round-4 method (bench.py "device-time attribution"): through the dev
+tunnel every blocking dispatch pays a large host/RPC cost (~60-70 ms)
+that a single measurement cannot separate from device execution. Measure
+BLOCKING calls at two fusion levels S_A and S_B = 2*S_A and fit
+``T(S) = overhead + S * device_time``: the slope is pure device execution
+per fused unit, the intercept is the per-dispatch host/tunnel cost. Keep
+S_A >= 8 — a 1-vs-2 fit's slope is below tunnel noise (it once yielded
+347% of HBM peak, RESULTS_r4.md).
+
+Peaks (TPU v5e, per chip): HBM ~819 GB/s, bf16 MXU ~197 TFLOP/s.
+"""
+
+from __future__ import annotations
+
+import time
+
+HBM_PEAK_BYTES_PER_S = 819e9
+MXU_PEAK_BF16_FLOPS = 197e12
+
+
+def two_point_fit(run_blocking, s_a: int, s_b: int, reps: int = 3
+                  ) -> dict:
+    """``run_blocking(s)`` executes ONE blocking dispatch fusing ``s``
+    units and returns its wall seconds. Returns the fitted per-unit
+    device seconds and per-dispatch overhead (medians over ``reps``)."""
+    def med(s: int) -> float:
+        ts = sorted(run_blocking(s) for _ in range(reps))
+        return ts[len(ts) // 2]
+
+    med(s_a)  # warm both shapes before timing
+    med(s_b)
+    t_a, t_b = med(s_a), med(s_b)
+    per_unit = (t_b - t_a) / (s_b - s_a)
+    overhead = t_a - s_a * per_unit
+    return {
+        "fit_s_a": s_a, "fit_s_b": s_b,
+        "t_a_ms": round(t_a * 1e3, 3), "t_b_ms": round(t_b * 1e3, 3),
+        "device_unit_ms": round(per_unit * 1e3, 4),
+        "dispatch_overhead_ms": round(overhead * 1e3, 3),
+        "device_unit_s": per_unit,
+    }
+
+
+def roofline_fields(fit: dict, bytes_per_unit: float | None = None,
+                    flops_per_unit: float | None = None) -> dict:
+    """Achieved fraction of the relevant peak from the fitted device time
+    per unit. ``bytes_per_unit``/``flops_per_unit`` are the workload's
+    model traffic/compute per fused unit."""
+    out: dict = {}
+    per = fit["device_unit_s"]
+    if per <= 0:
+        out["roofline_note"] = ("fit slope <= 0: device time below tunnel "
+                                "noise at this fusion level")
+        return out
+    if bytes_per_unit is not None:
+        bps = bytes_per_unit / per
+        out["model_bytes_per_unit"] = int(bytes_per_unit)
+        out["achieved_gb_per_s"] = round(bps / 1e9, 1)
+        out["pct_of_peak_bw"] = round(100 * bps / HBM_PEAK_BYTES_PER_S, 1)
+    if flops_per_unit is not None:
+        fps = flops_per_unit / per
+        out["model_flops_per_unit"] = int(flops_per_unit)
+        out["achieved_tflops"] = round(fps / 1e12, 2)
+        out["pct_of_mxu_peak"] = round(
+            100 * fps / MXU_PEAK_BF16_FLOPS, 1)
+    return out
+
+
+def timed(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0

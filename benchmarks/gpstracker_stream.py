@@ -110,10 +110,12 @@ async def bench_host_streams(n_devices: int, batch: int,
     }
 
 
-def bench_device_tier(n_devices: int, rounds: int, iters: int) -> dict:
+def bench_device_tier(n_devices: int, rounds: int, iters: int,
+                      reps: int = 3) -> dict:
     import jax
     import jax.numpy as jnp
 
+    from benchmarks.attribution import roofline_fields, two_point_fit
     from orleans_tpu.dispatch import VectorGrain, VectorRuntime, actor_method
     from orleans_tpu.ops import segment_sum_onehot
     from orleans_tpu.parallel import make_mesh
@@ -141,8 +143,12 @@ def bench_device_tier(n_devices: int, rounds: int, iters: int) -> dict:
     keys = np.arange(n_devices)
     plan = rt.make_dense_plan(DeviceVectorGrain, keys)
     rng = np.random.default_rng(0)
-    pos_rounds = rng.random((rounds, n_devices, 2),
-                            np.float32).astype(np.float16)
+
+    def staged(k: int) -> np.ndarray:
+        return rng.random((k, n_devices, 2),
+                          np.float32).astype(np.float16)
+
+    pos_rounds = staged(rounds)
 
     @jax.jit
     def notify(regions):  # [K, n, B] — per-region delivery counts
@@ -150,28 +156,48 @@ def bench_device_tier(n_devices: int, rounds: int, iters: int) -> dict:
         return segment_sum_onehot(jnp.ones_like(flat, jnp.float32),
                                   flat, N_REGIONS)
 
-    def super_round():
+    def super_round(buf):
         out = rt.call_batch_rounds(DeviceVectorGrain, "fix", keys,
-                                   {"pos": pos_rounds}, plan=plan,
+                                   {"pos": buf}, plan=plan,
                                    device_results=True)
         return notify(out)
 
-    counts = super_round()
+    counts = super_round(pos_rounds)
     jax.block_until_ready(counts)
+    assert float(jnp.sum(counts)) == rounds * plan.B  # all fixes bucketed
     t0 = time.perf_counter()
     for _ in range(iters):
-        counts = super_round()
+        counts = super_round(pos_rounds)
     jax.block_until_ready(counts)
     elapsed = time.perf_counter() - t0
     events = iters * rounds * n_devices
-    assert float(jnp.sum(counts)) == rounds * plan.B  # all fixes bucketed
+
+    # ---- attribution + roofline (benchmarks/attribution.py) ----------
+    bufs = {}
+
+    def run_blocking(k: int) -> float:
+        buf = bufs.setdefault(k, staged(k))
+        t0 = time.perf_counter()
+        jax.block_until_ready(super_round(buf))
+        return time.perf_counter() - t0
+
+    s_a = max(8, rounds)
+    fit = two_point_fit(run_blocking, s_a, 2 * s_a, reps=reps)
+    # per event: pos read+write (2*8 B f32) + fixes r/w (2*4) + staged
+    # fix read (2*2) + region emit (4) + notify re-read (4); the one-hot
+    # fan-in matmul's [B, 256] intermediate is fused, not re-materialized
+    bytes_per_round = n_devices * (16 + 8 + 4 + 4 + 4)
+    roof = roofline_fields(fit, bytes_per_unit=bytes_per_round)
+    fit.pop("device_unit_s", None)
+
     return {
         "metric": "gpstracker_device_events_per_sec",
         "value": round(events / elapsed, 1),
         "unit": "events/sec/chip",
         "vs_baseline": None,
         "extra": {"devices": n_devices, "rounds_per_upload": rounds,
-                  "iters": iters, "regions": N_REGIONS},
+                  "iters": iters, "regions": N_REGIONS,
+                  "bytes_per_event_model": 36, **fit, **roof},
     }
 
 

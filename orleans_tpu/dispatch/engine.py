@@ -270,6 +270,7 @@ class VectorRuntime:
         from ..core.ids import GrainType
         gid = GrainId.for_grain(GrainType.of(grain_class.__name__), key)
         kh = self.key_hash_for(key, gid.uniform_hash)
+        self.table(grain_class).note_route(kh, gid.uniform_hash)
         return VectorActorRef(self, grain_class, key, kh)
 
     # ------------------------------------------------------------------

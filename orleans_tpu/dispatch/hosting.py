@@ -65,6 +65,7 @@ def add_vector_grains(builder, *grain_classes: type[VectorGrain],
             silo.vector_interfaces[cls.__name__] = cls
         for cls, n in (dense or {}).items():
             silo.vector.table(cls).ensure_dense(n)
+        _install_ownership_sweep(silo)
         if checkpoint_dir is not None:
             _install_checkpoints(silo)
         if storage is None:
@@ -78,6 +79,72 @@ def add_vector_grains(builder, *grain_classes: type[VectorGrain],
         for cls in grain_classes:
             silo.vector_bridges[cls] = VectorStorageBridge(
                 silo.vector, cls, storage)
+        _install_flusher(silo)
+
+    def _install_ownership_sweep(silo) -> None:
+        """Membership-change sweep: a silo that loses a key's ring
+        ownership must release its resident row — keeping it would serve
+        a STALE copy if ownership ever returns (the interim owner wrote
+        and persisted newer state), forking the key. Releasing forces
+        recovery-on-first-touch, the same rebuild path a fresh owner
+        takes. Host-tier analog: duplicate-activation deactivation on
+        directory re-registration. Rows with acked-but-unflushed writes
+        are flushed FIRST (leave-side handoff: make the tail durable
+        before handing the key over) when a write-behind bridge exists."""
+        import asyncio
+        import logging
+
+        def on_view_change(alive, dead) -> None:
+            async def sweep() -> None:
+                await asyncio.sleep(0)  # after the locator applies the view
+                me = silo.silo_address
+                ring = silo.locator.ring
+
+                def owned(uh: int) -> bool:
+                    o = ring.owner(uh)
+                    return o is None or o == me
+
+                n = 0
+                for cls in grain_classes:
+                    tbl = silo.vector.tables.get(cls)
+                    if tbl is None or not tbl.key_to_slot:
+                        continue
+                    gone = tbl.unowned_keys(owned)
+                    if not gone:
+                        continue
+                    bridge = getattr(silo, "vector_bridges", {}).get(cls)
+                    if bridge is not None:
+                        try:
+                            await bridge.flush(gone)
+                        except Exception:  # noqa: BLE001 — handoff flush
+                            # is best-effort; a conflict means the new
+                            # owner already persisted newer state
+                            logging.getLogger("orleans.vector").info(
+                                "handoff flush failed for %s",
+                                cls.__name__, exc_info=True)
+                    for kh in gone:
+                        tbl.release(kh)
+                    n += len(gone)
+                if n:
+                    silo.stats.increment("vector.ownership.released", n)
+                    logging.getLogger("orleans.vector").info(
+                        "released %d device-tier rows after ownership "
+                        "re-range", n)
+
+            asyncio.get_running_loop().create_task(sweep())
+
+        def start() -> None:
+            if silo.membership is not None:
+                silo.membership.subscribe(on_view_change)
+
+        from ..runtime.silo import ServiceLifecycleStage
+
+        silo.subscribe_lifecycle(
+            ServiceLifecycleStage.RUNTIME_GRAIN_SERVICES, start, None)
+
+    def _install_flusher(silo) -> None:
+        import asyncio
+
         state = {"task": None}
 
         async def flush_all(strict: bool = False) -> int:

@@ -1,0 +1,62 @@
+"""Device-tier elastic resharding: re-range a dense actor table onto a
+mesh with a different shard count — BOTH directions.
+
+Re-design of /root/reference/src/Orleans.Runtime/GrainDirectory/
+``GrainDirectoryHandoffManager.cs:1-340``: the reference re-ranges
+directory partitions when silos LEAVE (handoff to survivors) and when
+silos JOIN (split to the newcomer, join path via
+``LocalGrainDirectory.cs:374-383``). On the device tier the partition is
+the dense block mapping key → (key // per_shard, key % per_shard), so a
+re-range is a snapshot → key-major flatten → block re-partition →
+restore: one reshape, no per-key handoff messages — the mesh is the
+directory.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["reshard_dense"]
+
+
+def reshard_dense(old_table, new_rt):
+    """Re-range ``old_table``'s densely-provisioned keyspace onto
+    ``new_rt``'s mesh (grow n→m or shrink m→n; any shard counts) and
+    return the new table. State rows carry over exactly; the activation
+    bitmap carries too, so rehydrated rows are not re-initialized on
+    next touch. The old table is left untouched (the caller retires it —
+    or keeps it as the rollback snapshot)."""
+    cls = old_table.grain_class
+    n_keys = old_table.dense_n
+    if n_keys == 0 or old_table.dense_per_shard == 0:
+        raise ValueError(
+            "reshard_dense re-ranges the dense regime; hashed-key tables "
+            "migrate per-key through checkpoint restore (VectorCheckpointer)")
+    if old_table.key_to_slot:
+        raise ValueError(
+            "table mixes hashed keys with the dense range; drain hashed "
+            "activations (release) before a dense re-range")
+    snap = old_table.snapshot()
+    per_old = old_table.dense_per_shard
+    n_old = old_table.n_shards
+
+    tbl2 = new_rt.table(cls)
+    tbl2.ensure_dense(n_keys)
+    per_new = tbl2.dense_per_shard
+    m = tbl2.n_shards
+    restored = {}
+    for name, arr in snap.items():
+        # key-major flatten of the old block mapping, truncated to the
+        # real keyspace (the old last shard's tail rows are padding)
+        km = arr[:, :per_old].reshape(n_old * per_old,
+                                      *arr.shape[2:])[:n_keys]
+        pad = m * per_new - n_keys
+        if pad:
+            km = np.concatenate(
+                [km, np.zeros((pad, *km.shape[1:]), km.dtype)])
+        full = np.zeros((m, tbl2.capacity + 1, *km.shape[1:]), km.dtype)
+        full[:, :per_new] = km.reshape(m, per_new, *km.shape[1:])
+        restored[name] = full
+    tbl2.restore(restored)
+    tbl2.dense_active[:] = old_table.dense_active[:n_keys]
+    return tbl2

@@ -64,6 +64,10 @@ class ShardedActorTable:
         # directory tier (ops.hash_probe; AdaptiveGrainDirectoryCache.cs:178)
         from ..ops.hash_probe import DeviceDirectory64
         self.device_dir = DeviceDirectory64()
+        # key_hash → the GrainId uniform hash that ROUTES it (differs for
+        # small-int keys, where key_hash is the key itself): ring-ownership
+        # sweeps need the routing hash to decide who owns a resident row
+        self.route_hash: dict[int, int] = {}
         self.free: list[list[int]] = [
             list(range(self.capacity - 1, -1, -1)) for _ in range(self.n_shards)]
         self.dense_n = 0  # keys [0, dense_n) are dense-mapped
@@ -190,7 +194,30 @@ class ShardedActorTable:
             return False
         self.free[loc[0]].append(loc[1])
         self.device_dir.remove(key_hash)
+        self.route_hash.pop(key_hash, None)
         return True
+
+    def note_route(self, key_hash: int, uniform_hash: int) -> None:
+        """Record the routing hash for a (resident or incoming) hashed
+        key — every entry point that knows the GrainId calls this."""
+        if key_hash != uniform_hash:
+            self.route_hash[key_hash] = uniform_hash
+
+    def unowned_keys(self, still_owned) -> list[int]:
+        """Hashed-regime rows whose ring ownership left this silo (the
+        membership-change sweep's release set). A row surviving on an
+        ex-owner is a STALE COPY — if ownership ever returns, serving it
+        would fork the key's state from what the interim owner wrote
+        (and persisted); releasing forces recovery-on-first-touch from
+        storage instead. The host-tier analog is activation deactivation
+        on directory re-registration. Dense-regime rows are NOT swept
+        (their multi-silo re-range is the explicit reshard_dense path).
+        Keys with no recorded route hash use the key hash itself — exact
+        for non-int keys (whose key_hash IS the uniform hash) and for
+        every key that entered through a routed call; bulk-loaded int
+        keys must have had note_route called (the bridge does)."""
+        return [kh for kh in self.key_to_slot
+                if not still_owned(self.route_hash.get(kh, kh))]
 
     # -- growth -----------------------------------------------------------
     def grow(self, new_capacity: int) -> None:

@@ -257,6 +257,10 @@ class Dispatcher:
                     f"(schema-bound); got {len(args)} positional")
             key_hash = rt.key_hash_for(msg.target_grain.key,
                                        msg.target_grain.uniform_hash)
+            # record the routing hash so ownership sweeps can re-derive
+            # who owns this resident row after a membership change
+            rt.table(vcls).note_route(key_hash,
+                                      msg.target_grain.uniform_hash)
             bridge = getattr(self.silo, "vector_bridges", {}).get(vcls)
             if bridge is not None and \
                     self._vector_key_is_fresh(rt, vcls, key_hash):

@@ -30,6 +30,7 @@ from typing import TYPE_CHECKING, Iterable
 import jax
 import numpy as np
 
+from ..core.errors import InconsistentStateError
 from ..core.ids import GrainId, GrainType
 from .core import GrainStorage
 
@@ -39,6 +40,16 @@ if TYPE_CHECKING:
 __all__ = ["VectorCheckpointer", "VectorStorageBridge"]
 
 
+class _ConflictReleased(Exception):
+    """Internal flush marker: this key's etag conflicted (another silo
+    flushed it since we last did), so the local row was released —
+    deactivate-and-rebuild, never overwrite. Not a flush failure."""
+
+    def __init__(self, key: int):
+        super().__init__(key)
+        self.key = key
+
+
 def _table_meta(tbl) -> dict:
     return {
         "capacity": tbl.capacity,
@@ -46,6 +57,7 @@ def _table_meta(tbl) -> dict:
         "dense_per_shard": tbl.dense_per_shard,
         "dense_active": [int(i) for i in np.flatnonzero(tbl.dense_active)],
         "key_to_slot": {str(k): list(v) for k, v in tbl.key_to_slot.items()},
+        "route_hash": {str(k): int(v) for k, v in tbl.route_hash.items()},
         "free": [list(f) for f in tbl.free],
     }
 
@@ -61,6 +73,8 @@ def _apply_meta(tbl, meta: dict) -> None:
         tbl.dense_active[np.asarray(meta["dense_active"], int)] = True
     tbl.key_to_slot = {int(k): tuple(v)
                        for k, v in meta["key_to_slot"].items()}
+    tbl.route_hash = {int(k): int(v)
+                      for k, v in meta.get("route_hash", {}).items()}
     tbl.free = [list(f) for f in meta["free"]]
 
 
@@ -176,6 +190,7 @@ class VectorStorageBridge:
         self.storage = storage
         self.grain_type = grain_class.__name__
         self._etags: dict[int, str | None] = {}
+        self.storage_conflicts = 0
 
     def _grain_id(self, key: int) -> GrainId:
         return GrainId.for_grain(GrainType.of(self.grain_type), int(key))
@@ -235,15 +250,42 @@ class VectorStorageBridge:
                 # the device row is the truth being flushed)
                 _, etag = await self.storage.read(
                     self.grain_type, self._grain_id(key))
-            etag = await self.storage.write(
-                self.grain_type, self._grain_id(key), state, etag)
+            try:
+                etag = await self.storage.write(
+                    self.grain_type, self._grain_id(key), state, etag)
+            except InconsistentStateError:
+                # another silo flushed this key since our last write: an
+                # ownership move happened (partition-era vote, failover,
+                # re-range). Reference semantics
+                # (InsideRuntimeClient.cs:390-402): the conflicted
+                # activation DEACTIVATES and rebuilds from storage on
+                # next touch — never overwrite. Overwriting would let a
+                # stale ex-owner silently REVERT durable state the live
+                # owner wrote (fatal once the key goes quiet: no later
+                # flush corrects it); releasing loses at most this
+                # silo's not-yet-durable tail, which is the documented
+                # write-behind loss window. The stale etag must also be
+                # dropped or it would wedge this key's flushes forever
+                self.storage_conflicts += 1
+                self._etags.pop(key, None)
+                if 0 <= key < tbl.dense_n:
+                    tbl.dense_active[key] = False
+                else:
+                    tbl.release(key)
+                logging.getLogger("orleans.vector").info(
+                    "write-behind: etag conflict on key %d — row "
+                    "released for rebuild from storage", key)
+                raise _ConflictReleased(key) from None
             self._etags[key] = etag
 
         results = await asyncio.gather(
             *(write_one(i, k) for i, k in enumerate(kept)),
             return_exceptions=True)
+        conflicts = [r.key for r in results
+                     if isinstance(r, _ConflictReleased)]
         failed = [k for k, r in zip(kept, results)
-                  if isinstance(r, BaseException)]
+                  if isinstance(r, BaseException)
+                  and not isinstance(r, _ConflictReleased)]
         if failed:
             self.runtime._mark_dirty(self.grain_class, failed)
             first = next(r for r in results if isinstance(r, BaseException))
@@ -255,7 +297,7 @@ class VectorStorageBridge:
                 # demanded completeness — the final stop() drain): surface
                 # the failure instead of reporting partial success
                 raise first
-        return len(kept) - len(failed)
+        return len(kept) - len(failed) - len(conflicts)
 
     async def load(self, keys: Iterable[int]) -> list[int]:
         """Resume: read stored rows and scatter them into the table.
@@ -278,10 +320,14 @@ class VectorStorageBridge:
         for k, _, e in found:
             self._etags[k] = e
         fkeys = [k for k, _, _ in found]
-        # claim slots for hashed keys that have no activation yet
+        # claim slots for hashed keys that have no activation yet, and
+        # record their routing hash (ownership sweeps need it for rows
+        # that never entered through a routed call)
         for k in fkeys:
-            if not (0 <= k < tbl.dense_n) and tbl.lookup(k) is None:
-                tbl.lookup_or_allocate(k)
+            if not (0 <= k < tbl.dense_n):
+                if tbl.lookup(k) is None:
+                    tbl.lookup_or_allocate(k)
+                tbl.note_route(k, self._grain_id(k).uniform_hash)
         if tbl.dense_active.size:
             dense = [k for k in fkeys if 0 <= k < tbl.dense_n]
             if dense:

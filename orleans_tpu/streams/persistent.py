@@ -474,8 +474,10 @@ class PullingManager:
                 self.agents.pop(q).stop()
         for q in mine:
             if q not in self.agents:
-                agent = PullingAgent(p, q, p.pull_period, p.max_batch,
-                                     cache_capacity=p.cache_capacity)
+                agent = PullingAgent(
+                    p, q, p.pull_period, p.max_batch,
+                    max_delivery_attempts=p.max_delivery_attempts,
+                    cache_capacity=p.cache_capacity)
                 agent.start()
                 self.agents[q] = agent
 
@@ -488,11 +490,16 @@ class PersistentStreamProvider(StreamProvider):
                  failure_handler: Callable | None = None,
                  balancer: "QueueBalancer | None" = None,
                  cache_capacity: int = 256,
-                 rebalance_period: float = 2.0):
+                 rebalance_period: float = 2.0,
+                 max_delivery_attempts: int = 3):
         super().__init__(silo, name)
         self.adapter = adapter
         self.pull_period = pull_period
         self.max_batch = max_batch
+        # per-batch delivery retries before the failure handler takes the
+        # batch (StreamPubSubMatch retry discipline): size this to outlast
+        # expected partition/failover windows when zero loss is required
+        self.max_delivery_attempts = max_delivery_attempts
         self.failure_handler = failure_handler
         self.balancer = balancer or DeploymentBasedBalancer()
         self.cache_capacity = cache_capacity

@@ -263,6 +263,7 @@ async def _deliver_scalar_item(silo: "Silo", rt, vcls: type, method: str,
     owner = silo.locator.ring.owner(gid.uniform_hash) or me
     if owner == me:
         kh = rt.key_hash_for(key, gid.uniform_hash)
+        rt.table(vcls).note_route(kh, gid.uniform_hash)
         await rt.call(vcls, kh, method, **kwargs)
         return 1
     sub = {"keys": np.asarray([key]),

@@ -50,8 +50,20 @@ async def test_gpstracker_harness():
 
 def test_chirper_fanout_harness():
     # 8-shard CPU mesh: exercises expand → all_to_all → ranked ring append
+    # (fused: a scan of ticks per launch, the round-4 RPC-amortization)
     r = chirper_fanout.run(n_accounts=1024, followers_per=4,
                            chirps_per_tick=64, timeline_len=8,
-                           seconds=0.3, n_devices=8)
+                           seconds=0.3, n_devices=8, fuse=2, reps=1)
     _check(r)
     assert r["extra"]["devices"] == 8
+    assert r["extra"]["ticks_per_launch"] == 2
+    assert r["extra"]["pipeline_depth"] == 1  # multi-shard: sequential
+
+
+def test_mxu_handler_harness():
+    from benchmarks import mxu_handler
+
+    r = mxu_handler.run(n_actors=128, fuse=2, seconds=0.3, reps=1)
+    _check(r)
+    assert r["extra"]["flops_per_actor_round"] > 1e6
+    assert r["extra"]["verified_rounds"] >= 2
