@@ -140,6 +140,13 @@ def main() -> None:
     n_dev = mesh.devices.size
     cap = -(-N_PLAYERS // n_dev)
     rt = VectorRuntime(mesh=mesh, capacity_per_shard=cap)
+    # scan-unroll: amortizes the per-scan-step fixed cost that leaves a
+    # 1M-actor round partly overhead-bound (59% of HBM peak at unroll 1
+    # in BENCH_r04 vs 97.7% at 4M actors, where the same fixed cost is
+    # amortized by 4x-larger rounds)
+    # measured sweep at 1M actors (BENCH_r05): unroll 1 → 53.9% of HBM
+    # peak, 4 → 98.4%, 8 → 86.4% (code bloat) — 4 is the default
+    rt.scan_unroll = int(os.environ.get("BENCH_UNROLL", "4"))
     tbl = rt.table(PlayerGrain)
     tbl.ensure_dense(N_PLAYERS)
 

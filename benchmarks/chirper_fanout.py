@@ -196,7 +196,8 @@ def run(n_accounts: int = 65536, followers_per: int = 16,
             d = int(np.asarray(inflight.pop(0)).sum())
             completions.append((time.perf_counter(), d))
     for dd in inflight:
-        completions.append((time.perf_counter(), int(np.asarray(dd).sum())))
+        d = int(np.asarray(dd).sum())  # blocks; stamp AFTER the sync
+        completions.append((time.perf_counter(), d))
     comp = np.asarray([t for t, _ in completions])
     if len(comp) > 1:
         # the measured window spans the intervals BETWEEN completions,
@@ -214,7 +215,9 @@ def run(n_accounts: int = 65536, followers_per: int = 16,
     bufs = {}
 
     def run_blocking(s: int) -> float:
-        b = bufs.setdefault(s, staged(s))
+        if s not in bufs:  # NOT setdefault: eager default would rebuild
+            bufs[s] = staged(s)  # + re-upload the staged batch every call
+        b = bufs[s]
         t0 = time.perf_counter()
         ntls, npos, _, _ = fused(state["tls"], state["pos"], d_foll, d_fc,
                                  *b)

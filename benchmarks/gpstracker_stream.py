@@ -144,17 +144,27 @@ def bench_device_tier(n_devices: int, rounds: int, iters: int,
     plan = rt.make_dense_plan(DeviceVectorGrain, keys)
     rng = np.random.default_rng(0)
 
-    def staged(k: int) -> np.ndarray:
-        return rng.random((k, n_devices, 2),
-                          np.float32).astype(np.float16)
+    def staged(k: int):
+        # device-resident: a host payload would re-transfer per launch
+        # through the tunnel, swamping both throughput and the fit
+        import jax.numpy as jnp
+        return jnp.asarray(rng.random((k, n_devices, 2),
+                                      np.float32).astype(np.float16))
 
     pos_rounds = staged(rounds)
 
     @jax.jit
     def notify(regions):  # [K, n, B] — per-region delivery counts
-        flat = regions.reshape(-1)
-        return segment_sum_onehot(jnp.ones_like(flat, jnp.float32),
-                                  flat, N_REGIONS)
+        # per-round MXU segment sums (each region count <= B < 2^24 stays
+        # exact in f32), then an int32 reduction over rounds — one flat
+        # f32 accumulation would round once a region passes 2^24 events
+        flat = regions.reshape(regions.shape[0], -1)
+
+        def one(r):
+            return segment_sum_onehot(jnp.ones_like(r, jnp.float32), r,
+                                      N_REGIONS)
+
+        return jnp.sum(jax.vmap(one)(flat).astype(jnp.int32), axis=0)
 
     def super_round(buf):
         out = rt.call_batch_rounds(DeviceVectorGrain, "fix", keys,
@@ -164,7 +174,7 @@ def bench_device_tier(n_devices: int, rounds: int, iters: int,
 
     counts = super_round(pos_rounds)
     jax.block_until_ready(counts)
-    assert float(jnp.sum(counts)) == rounds * plan.B  # all fixes bucketed
+    assert int(jnp.sum(counts)) == rounds * plan.B  # all fixes bucketed
     t0 = time.perf_counter()
     for _ in range(iters):
         counts = super_round(pos_rounds)
@@ -176,12 +186,17 @@ def bench_device_tier(n_devices: int, rounds: int, iters: int,
     bufs = {}
 
     def run_blocking(k: int) -> float:
-        buf = bufs.setdefault(k, staged(k))
+        if k not in bufs:  # NOT setdefault: its default arg would eager-
+            bufs[k] = staged(k)  # evaluate a host RNG + upload every call
+        buf = bufs[k]
         t0 = time.perf_counter()
         jax.block_until_ready(super_round(buf))
         return time.perf_counter() - t0
 
-    s_a = max(8, rounds)
+    # S_A = 64 floor: one fix round is sub-0.2 ms of device time, so a
+    # shorter lever arm leaves the slope below tunnel noise (the same
+    # S_A>=8 rule bench.py applies to heartbeats, scaled to this kernel)
+    s_a = max(64, rounds)
     fit = two_point_fit(run_blocking, s_a, 2 * s_a, reps=reps)
     # per event: pos read+write (2*8 B f32) + fixes r/w (2*4) + staged
     # fix read (2*2) + region emit (4) + notify re-read (4); the one-hot
@@ -202,7 +217,7 @@ def bench_device_tier(n_devices: int, rounds: int, iters: int,
 
 
 async def run(n_devices: int = 64, batch: int = 64, seconds: float = 3.0,
-              vec_devices: int = 100_000, vec_rounds: int = 8,
+              vec_devices: int = 100_000, vec_rounds: int = 64,
               vec_iters: int = 10) -> list[dict]:
     host = await bench_host_streams(n_devices, batch, seconds)
     dev = bench_device_tier(vec_devices, vec_rounds, vec_iters)

@@ -65,9 +65,12 @@ def _make_grain(seed: int = 0):
         def step(state, args):
             a = jnp.tanh(state["h"] @ w1 + args["x"].astype(jnp.bfloat16)
                          @ win)
-            out = jnp.tanh(a @ w2)
+            # square (not a second tanh): nonlinear, so XLA cannot fold
+            # the sum through the readout matmul and delete it, but ~10x
+            # cheaper on the VPU — the MXU stays the bottleneck
+            out = a @ w2
             new = {"h": a.astype(jnp.bfloat16), "n": state["n"] + 1}
-            return new, jnp.sum(out.astype(jnp.float32))
+            return new, jnp.sum(jnp.square(out.astype(jnp.float32)))
 
     return CellGrain
 
@@ -93,13 +96,19 @@ def run(n_actors: int = 65536, fuse: int | None = None,
     plan = rt.make_dense_plan(CellGrain, keys)
     rng = np.random.default_rng(1)
 
-    def staged(k: int) -> np.ndarray:
-        return rng.standard_normal((k, n_actors, DIN)).astype(np.float16)
+    def staged(k: int):
+        # DEVICE-resident staged rounds: through the dev tunnel a
+        # host-side payload would re-transfer ~1 MB/round per launch and
+        # swamp both throughput and the fit (bench.py stages the same way)
+        return jnp.asarray(
+            rng.standard_normal((k, n_actors, DIN)).astype(np.float16))
 
     depth = rt.validate_pipeline_depth(pipeline_depth)
     payload = staged(fuse)
+    dispatched = {"rounds": 0}
 
     def launch(buf):
+        dispatched["rounds"] += int(buf.shape[0])
         return rt.call_batch_rounds(CellGrain, "step", keys, {"x": buf},
                                     plan=plan, device_results=True)
 
@@ -126,22 +135,26 @@ def run(n_actors: int = 65536, fuse: int | None = None,
     actor_rounds = (len(comp) - 1) * fuse * n_actors
     per_sec = actor_rounds / elapsed if elapsed > 0 else 0.0
 
-    # correctness: every actor saw every dispatched round exactly once
-    n_rounds = int(np.asarray(tbl.read_row(0)["n"]))
-    want_rounds = (launches + 1) * fuse  # +1 warmup
-    assert n_rounds == want_rounds, (n_rounds, want_rounds)
-
     # ---- attribution: two-point blocking fit over round counts -------
     bufs = {}
 
     def run_blocking(k: int) -> float:
-        buf = bufs.setdefault(k, staged(k))
+        if k <= fuse:
+            buf = payload[:k]
+        else:
+            if k not in bufs:  # cache: regenerating would re-upload and
+                bufs[k] = staged(k)  # overlap the timed launch
+            buf = bufs[k]
         t0 = time.perf_counter()
         jax.block_until_ready(launch(buf))
         return time.perf_counter() - t0
 
     s_a = max(8, fuse // 2)
     fit = two_point_fit(run_blocking, s_a, 2 * s_a, reps=reps)
+
+    # correctness: every actor saw every dispatched round exactly once
+    n_rounds = int(np.asarray(tbl.read_row(0)["n"]))
+    assert n_rounds == dispatched["rounds"], (n_rounds, dispatched)
     roof = roofline_fields(
         fit,
         bytes_per_unit=BYTES_PER_ACTOR_ROUND * n_actors,

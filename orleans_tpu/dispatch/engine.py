@@ -185,6 +185,12 @@ class VectorRuntime:
         # stateless-worker (mesh-replicated) hosts per class — see
         # dispatch.replicated (StatelessWorkerPlacement.cs:6 on device)
         self._replicated_hosts: dict[type, Any] = {}
+        # lax.scan unroll for scanned (call_batch_rounds) kernels: each
+        # scan step carries a fixed per-iteration cost (loop bookkeeping,
+        # staged-payload dynamic slicing) that dominates small-population
+        # rounds; unrolling amortizes it across U rounds per step at the
+        # cost of a longer compile. 1 = plain scan
+        self.scan_unroll = 1
 
     def validate_pipeline_depth(self, depth: int,
                                 allow_unproven: bool = False) -> int:
@@ -549,7 +555,27 @@ class VectorRuntime:
             d_fresh = d_zeros
         args_b = {}
         for fname, (dtype, shape) in m.args_schema.items():
-            a = np.asarray(args_rounds[fname])
+            a = args_rounds[fname]
+            if isinstance(a, jax.Array) and plan.identity \
+                    and (M == tbl.n_shards * plan.B
+                         or tbl.n_shards == 1):
+                # DEVICE-resident staged payload on an identity plan: the
+                # [K, M, ...] → [K, n, B, ...] layout is a reshape (plus
+                # an on-device zero-pad to the bucket size when single-
+                # shard), so keep it on device. The host path below would
+                # round-trip the whole payload through the tunnel
+                # (device→host gather + repack + re-upload — seconds per
+                # launch at 1 MB/round), which is what the streaming hot
+                # path exists to avoid
+                a2 = a.astype(dtype)
+                pad = tbl.n_shards * plan.B - M
+                if pad:
+                    a2 = jnp.pad(
+                        a2, ((0, 0), (0, pad)) + ((0, 0),) * len(shape))
+                args_b[fname] = tbl._put_rounds(
+                    a2.reshape(K, tbl.n_shards, plan.B, *shape))
+                continue
+            a = np.asarray(a)
             packed = np.stack([plan.pack(a[k], dtype, shape)
                                for k in range(K)])
             args_b[fname] = tbl._put_rounds(jnp.asarray(packed))
@@ -572,7 +598,7 @@ class VectorRuntime:
                      contiguous: bool = False):
         tbl = self.tables[cls]
         key = ("scan", cls, method, B, K, tbl.capacity, tbl.n_shards,
-               contiguous)
+               contiguous, self.scan_unroll)
         k = self._kernel_cache.get(key)
         if k is None:
             k = self._build_kernel(cls, method, scan_rounds=K,
@@ -852,7 +878,8 @@ class VectorRuntime:
                     st, out = local_step(carry, slots, khash, no_fresh,
                                          valid, args_k)
                     return st, out
-                return lax.scan(one, state, args_rounds)
+                return lax.scan(one, state, args_rounds,
+                                unroll=max(1, self.scan_unroll))
 
             body = scanned
         else:

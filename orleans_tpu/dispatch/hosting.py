@@ -94,6 +94,10 @@ def add_vector_grains(builder, *grain_classes: type[VectorGrain],
         import asyncio
         import logging
 
+        # strong refs: the loop holds tasks weakly, and a GC'd sweep
+        # would silently skip the release this mechanism exists for
+        sweep_tasks: set = set()
+
         def on_view_change(alive, dead) -> None:
             async def sweep() -> None:
                 await asyncio.sleep(0)  # after the locator applies the view
@@ -131,7 +135,9 @@ def add_vector_grains(builder, *grain_classes: type[VectorGrain],
                         "released %d device-tier rows after ownership "
                         "re-range", n)
 
-            asyncio.get_running_loop().create_task(sweep())
+            t = asyncio.get_running_loop().create_task(sweep())
+            sweep_tasks.add(t)
+            t.add_done_callback(sweep_tasks.discard)
 
         def start() -> None:
             if silo.membership is not None:

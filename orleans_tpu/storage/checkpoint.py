@@ -288,7 +288,9 @@ class VectorStorageBridge:
                   and not isinstance(r, _ConflictReleased)]
         if failed:
             self.runtime._mark_dirty(self.grain_class, failed)
-            first = next(r for r in results if isinstance(r, BaseException))
+            first = next(r for r in results
+                         if isinstance(r, BaseException)
+                         and not isinstance(r, _ConflictReleased))
             logging.getLogger("orleans.vector").warning(
                 "write-behind: %d/%d key writes failed (re-marked): %r",
                 len(failed), len(kept), first)
