@@ -72,3 +72,20 @@ def timed(fn) -> float:
     t0 = time.perf_counter()
     fn()
     return time.perf_counter() - t0
+
+
+def staged_cache(make):
+    """Lazily-cached staged payload buffers for the blocking fit:
+    ``get(k)`` builds via ``make(k)`` once per k. A bare
+    ``dict.setdefault(k, make(k))`` would EAGER-evaluate make on every
+    call — host RNG + a device upload overlapping the timed launch —
+    which silently biased early fits; this helper is the one correct
+    implementation."""
+    bufs: dict = {}
+
+    def get(k: int):
+        if k not in bufs:
+            bufs[k] = make(k)
+        return bufs[k]
+
+    return get

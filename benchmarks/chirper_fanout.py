@@ -137,7 +137,8 @@ def run(n_accounts: int = 65536, followers_per: int = 16,
         reps: int = 3) -> dict:
     import os
 
-    from benchmarks.attribution import roofline_fields, two_point_fit
+    from benchmarks.attribution import (roofline_fields, staged_cache,
+                                        two_point_fit)
 
     fuse = fuse if fuse is not None else int(
         os.environ.get("CHIRPER_FUSE", "32"))
@@ -212,12 +213,10 @@ def run(n_accounts: int = 65536, followers_per: int = 16,
     # blocking fit over tick counts separates device execution from the
     # per-dispatch host/tunnel cost (benchmarks/attribution.py)
     state = {"tls": timelines, "pos": tl_pos}
-    bufs = {}
+    get_staged = staged_cache(staged)
 
     def run_blocking(s: int) -> float:
-        if s not in bufs:  # NOT setdefault: eager default would rebuild
-            bufs[s] = staged(s)  # + re-upload the staged batch every call
-        b = bufs[s]
+        b = get_staged(s)
         t0 = time.perf_counter()
         ntls, npos, _, _ = fused(state["tls"], state["pos"], d_foll, d_fc,
                                  *b)

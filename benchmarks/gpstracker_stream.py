@@ -115,7 +115,8 @@ def bench_device_tier(n_devices: int, rounds: int, iters: int,
     import jax
     import jax.numpy as jnp
 
-    from benchmarks.attribution import roofline_fields, two_point_fit
+    from benchmarks.attribution import (roofline_fields, staged_cache,
+                                        two_point_fit)
     from orleans_tpu.dispatch import VectorGrain, VectorRuntime, actor_method
     from orleans_tpu.ops import segment_sum_onehot
     from orleans_tpu.parallel import make_mesh
@@ -183,12 +184,10 @@ def bench_device_tier(n_devices: int, rounds: int, iters: int,
     events = iters * rounds * n_devices
 
     # ---- attribution + roofline (benchmarks/attribution.py) ----------
-    bufs = {}
+    get_staged = staged_cache(staged)
 
     def run_blocking(k: int) -> float:
-        if k not in bufs:  # NOT setdefault: its default arg would eager-
-            bufs[k] = staged(k)  # evaluate a host RNG + upload every call
-        buf = bufs[k]
+        buf = get_staged(k)
         t0 = time.perf_counter()
         jax.block_until_ready(super_round(buf))
         return time.perf_counter() - t0

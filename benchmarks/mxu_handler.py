@@ -39,7 +39,8 @@ if __package__ in (None, ""):
 import jax
 import jax.numpy as jnp
 
-from benchmarks.attribution import roofline_fields, two_point_fit
+from benchmarks.attribution import (roofline_fields, staged_cache,
+                                    two_point_fit)
 from orleans_tpu.dispatch import VectorGrain, VectorRuntime, actor_method
 from orleans_tpu.parallel import make_mesh
 
@@ -136,15 +137,10 @@ def run(n_actors: int = 65536, fuse: int | None = None,
     per_sec = actor_rounds / elapsed if elapsed > 0 else 0.0
 
     # ---- attribution: two-point blocking fit over round counts -------
-    bufs = {}
+    get_staged = staged_cache(staged)
 
     def run_blocking(k: int) -> float:
-        if k <= fuse:
-            buf = payload[:k]
-        else:
-            if k not in bufs:  # cache: regenerating would re-upload and
-                bufs[k] = staged(k)  # overlap the timed launch
-            buf = bufs[k]
+        buf = payload[:k] if k <= fuse else get_staged(k)
         t0 = time.perf_counter()
         jax.block_until_ready(launch(buf))
         return time.perf_counter() - t0

@@ -99,6 +99,14 @@ class AdaptiveDirectoryCache:
         while len(self._d) > self.size:
             self._d.popitem(last=False)
 
+    def peek(self, gid):
+        """Raw resident entry (silo), ignoring TTL and WITHOUT touching
+        hit/access bookkeeping — a conflict hint for fast paths: even an
+        expired entry naming another silo means this silo's knowledge of
+        the grain's address is contested and the full lookup must run."""
+        e = self._d.get(gid)
+        return e.silo if e is not None else None
+
     def pop(self, gid, default=None):
         e = self._d.pop(gid, None)
         return default if e is None else e.silo

@@ -525,6 +525,27 @@ class Dispatcher:
     def send_message(self, msg: Message, grain_class: type | None = None) -> None:
         """AsyncSendMessage:645 — address if needed, then transmit."""
         if msg.target_silo is None:
+            # catalog-first addressing (the reference's local activation-
+            # table hit before directory work, Dispatcher.cs targeting):
+            # a live local activation IS the registered address — the
+            # catalog registers in the directory before exposing the
+            # activation — so gateway ingress for grains active HERE
+            # skips the locator entirely (+15% measured on host ping).
+            # Interception (vector/GSI) still runs: transmit loops back
+            # through receive_message. Guard: the shortcut needs the
+            # directory cache to AFFIRMATIVELY name this silo (placement
+            # wrote that entry; TTL is ignored — residency is enough).
+            # Any other state — another silo (usurped duplicate from a
+            # re-range race) or a popped entry (invalidation is the
+            # healing signal) — falls through to the locator so callers
+            # converge on the registered winner and a stale local
+            # activation can idle out
+            if self.silo.catalog.by_grain.get(msg.target_grain) and \
+                    self.silo.locator.cache.peek(msg.target_grain) \
+                    == self.silo.silo_address:
+                msg.target_silo = self.silo.silo_address
+                self.transmit(msg)
+                return
             # sync fast path: cache hits / local-owner placements resolve
             # without an addressing task (the common case by far)
             try:
