@@ -203,8 +203,8 @@ async def attribution(seconds: float = 3.0, concurrency: int = 100
         "messaging semantics (~40 frames/call), with no serialization "
         "on the in-proc path; closing it needs a native dispatch "
         "pipeline, not asyncio tuning. Catalog-first addressing "
-        "(dispatcher.send_message) already removed the per-call "
-        "locator work (+15%).")
+        "(dispatcher.send_message) already trimmed the per-call "
+        "locator work (+5-15% depending on machine noise).")
     return {"metric": "ping_host_attribution", "value": base,
             "unit": "calls/sec", "vs_baseline": None, "extra": out}
 

@@ -99,13 +99,16 @@ class AdaptiveDirectoryCache:
         while len(self._d) > self.size:
             self._d.popitem(last=False)
 
-    def peek(self, gid):
-        """Raw resident entry (silo), ignoring TTL and WITHOUT touching
-        hit/access bookkeeping — a conflict hint for fast paths: even an
-        expired entry naming another silo means this silo's knowledge of
-        the grain's address is contested and the full lookup must run."""
+    def valid_silo(self, gid):
+        """TTL-checked entry WITHOUT the hit/access/LRU bookkeeping — the
+        dispatcher's catalog-first guard calls this per message, and the
+        expired→miss contract is what bounds a usurped duplicate to one
+        TTL (the fall-through slow path re-resolves and re-arms); the
+        bookkeeping belongs to the resolution path, not the guard."""
         e = self._d.get(gid)
-        return e.silo if e is not None else None
+        if e is None or self.clock() >= e.expires:
+            return None
+        return e.silo
 
     def pop(self, gid, default=None):
         e = self._d.pop(gid, None)

@@ -530,18 +530,22 @@ class Dispatcher:
             # a live local activation IS the registered address — the
             # catalog registers in the directory before exposing the
             # activation — so gateway ingress for grains active HERE
-            # skips the locator entirely (+15% measured on host ping).
+            # skips the full locator path (measured +5-15% on host ping
+            # depending on machine noise).
             # Interception (vector/GSI) still runs: transmit loops back
-            # through receive_message. Guard: the shortcut needs the
-            # directory cache to AFFIRMATIVELY name this silo (placement
-            # wrote that entry; TTL is ignored — residency is enough).
-            # Any other state — another silo (usurped duplicate from a
-            # re-range race) or a popped entry (invalidation is the
-            # healing signal) — falls through to the locator so callers
-            # converge on the registered winner and a stale local
-            # activation can idle out
+            # through receive_message. Guard: the shortcut needs a
+            # TTL-VALID cache entry affirmatively naming this silo
+            # (placement wrote it; the slow path re-arms it on each
+            # expiry). TTL-aware on purpose: a usurped duplicate's own
+            # stale entry also names this silo, so an unexpiring check
+            # would pin callers to the duplicate forever — expiry forces
+            # a periodic re-resolution against the directory, bounding
+            # any split-brain to one cache TTL exactly as the
+            # pre-shortcut try_locate_sync path did. Popped entries
+            # (invalidation) and entries naming another silo fall
+            # through the same way
             if self.silo.catalog.by_grain.get(msg.target_grain) and \
-                    self.silo.locator.cache.peek(msg.target_grain) \
+                    self.silo.locator.cache.valid_silo(msg.target_grain) \
                     == self.silo.silo_address:
                 msg.target_silo = self.silo.silo_address
                 self.transmit(msg)

@@ -166,3 +166,25 @@ async def test_maintainer_suppresses_forward_hops_under_churn():
                                         refresh_period=0.25)
     assert baseline >= 12, f"churn harness produced no staleness: {baseline}"
     assert adaptive <= baseline // 4, (adaptive, baseline)
+
+
+def test_accessed_set_stays_bounded_without_maintainer():
+    """ADVICE r4: with no maintainer draining it, the accessed-marks set
+    must stay bounded by the cache size over unbounded distinct-gid
+    traffic — and a steady-state working set must KEEP its marks."""
+    from orleans_tpu.directory.adaptive_cache import AdaptiveDirectoryCache
+
+    c = AdaptiveDirectoryCache(size=8)
+    for i in range(1000):
+        c.put(i, "silo-a")
+        c.get(i)
+        assert len(c._accessed) <= 8
+    # steady state: repeated gets of the resident set never wipe marks
+    resident = list(c._d)
+    c._accessed.clear()
+    for gid in resident:
+        c.get(gid)
+    marked = set(c._accessed)
+    for gid in resident:
+        c.get(gid)
+    assert set(c._accessed) == marked  # re-gets kept the same marks

@@ -318,3 +318,45 @@ async def test_rewind_beyond_cache_replays_durable_history(tmp_path):
         assert sorted(uniq.values()) == list(range(40))
     finally:
         await _stop(silos, client)
+
+
+async def test_sqlite_token_continuity_after_full_drain(tmp_path):
+    """ADVICE r4: retention can DELETE every row of a drained queue; the
+    per-queue watermark must keep the next token sequence monotone so a
+    restart never re-mints already-delivered tokens."""
+    from orleans_tpu.streams import SqliteQueueAdapter
+    from orleans_tpu.streams.core import StreamId
+
+    path = str(tmp_path / "wm.db")
+    a = SqliteQueueAdapter(path, n_queues=1, retention=0)  # keep nothing
+    sid = StreamId("dq", "ns", "k")
+    for i in range(3):
+        await a.queue_message_batch(0, sid, [f"a{i}", f"b{i}"])
+    recv = a.create_receiver(0)
+    batches = await recv.get_messages(10)
+    assert [b.seq for b in batches] == [0, 2, 4]
+    for b in batches:
+        await recv.ack(b)  # retention=0: every acked row is deleted
+    a.close()
+
+    # fresh adapter over the drained db: tokens must CONTINUE, not restart
+    b2 = SqliteQueueAdapter(path, n_queues=1, retention=0)
+    await b2.queue_message_batch(0, sid, ["post-drain"])
+    got = await b2.create_receiver(0).get_messages(10)
+    assert [x.seq for x in got] == [6], [x.seq for x in got]
+    b2.close()
+
+
+async def test_group_commit_flush_failure_fails_every_waiter(tmp_path):
+    """A flush-group commit failure must reject every produce that rode
+    the group — none may report durable success."""
+    from orleans_tpu.streams import SqliteQueueAdapter
+    from orleans_tpu.streams.core import StreamId
+
+    a = SqliteQueueAdapter(str(tmp_path / "gc.db"), n_queues=1)
+    sid = StreamId("dq", "ns", "k")
+    a._db.close()  # storage dies before the group commits
+    results = await asyncio.gather(
+        *(a.queue_message_batch(0, sid, [i]) for i in range(8)),
+        return_exceptions=True)
+    assert all(isinstance(r, Exception) for r in results), results

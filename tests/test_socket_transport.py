@@ -380,3 +380,24 @@ async def test_version_map_exchanged_across_fabrics(tmp_path):
             await client.close_async()
         await silo1.stop()
         await silo2.stop()
+
+
+async def test_garbled_handshake_reply_fails_the_dial():
+    """ADVICE r4: a garbled/truncated handshake reply leaves the stream
+    misaligned — negotiation must raise into the redial path, never keep
+    reading frames from the corrupt stream."""
+    from orleans_tpu.runtime.socket_fabric import _read_peer_codec
+
+    async def feed(data: bytes):
+        reader = asyncio.StreamReader()
+        reader.feed_data(data)
+        reader.feed_eof()
+        return reader
+
+    # garbage bytes: unreadable frame -> ConnectionError (OSError)
+    with pytest.raises(ConnectionError):
+        await _read_peer_codec(await feed(b"\xff\xfe garbage not a frame"))
+    # truncated (EOF mid-frame) -> same
+    with pytest.raises(ConnectionError):
+        await _read_peer_codec(await feed(b"\x00"))
+

@@ -533,3 +533,27 @@ async def test_stream_signal_rejected_as_data():
                 pass
     finally:
         await stop_all(silos, client)
+
+
+async def test_replay_progress_dropped_on_unsubscribe():
+    """ADVICE r4 (medium): per-(stream, handle) delivery floors must be
+    dropped when the subscription is actually removed — long-lived silos
+    with subscription churn must not leak progress entries."""
+    RECEIVED.clear()
+    fabric, adapter, silos, client = await start_cluster(1)
+    try:
+        consumer = client.get_grain(ConsumerGrain, 41)
+        await consumer.join("queue", "leak", "s")
+        await client.get_grain(ProducerGrain, 1).publish(
+            "queue", "leak", "s", "x")
+        await wait_received((41, "explicit"), 1)
+        provider = silos[0].stream_providers["queue"]
+        assert any(k[0].key == "s" for k in provider.replay_progress), \
+            provider.replay_progress
+        await consumer.leave("queue", "leak", "s")
+        deadline = time.monotonic() + 8
+        while any(k[0].key == "s" for k in provider.replay_progress):
+            assert time.monotonic() < deadline, provider.replay_progress
+            await asyncio.sleep(0.05)
+    finally:
+        await stop_all(silos, client)
