@@ -55,6 +55,18 @@ def _make_grain(seed: int = 0):
     w2 = jnp.asarray(rng.standard_normal((D, D)) * scale, jnp.bfloat16)
     win = jnp.asarray(rng.standard_normal((DIN, D)), jnp.bfloat16)
 
+    def cell(h, x):
+        """The ONE cell definition — the grain handler and the bare
+        ceiling kernel both call this, so engine_tax_factor can never
+        silently measure two different computations. Square (not a
+        second tanh) on the readout: nonlinear, so XLA cannot fold the
+        sum through the matmul and delete it, but ~10x cheaper on the
+        VPU — the MXU stays the bottleneck."""
+        a = jnp.tanh(h @ w1 + x.astype(jnp.bfloat16) @ win)
+        out = a @ w2
+        return (a.astype(jnp.bfloat16),
+                jnp.sum(jnp.square(out.astype(jnp.float32)), axis=-1))
+
     class CellGrain(VectorGrain):
         STATE = {"h": (jnp.bfloat16, (D,)), "n": (jnp.int32, ())}
 
@@ -64,16 +76,10 @@ def _make_grain(seed: int = 0):
 
         @actor_method(args={"x": (jnp.float16, (DIN,))})
         def step(state, args):
-            a = jnp.tanh(state["h"] @ w1 + args["x"].astype(jnp.bfloat16)
-                         @ win)
-            # square (not a second tanh): nonlinear, so XLA cannot fold
-            # the sum through the readout matmul and delete it, but ~10x
-            # cheaper on the VPU — the MXU stays the bottleneck
-            out = a @ w2
-            new = {"h": a.astype(jnp.bfloat16), "n": state["n"] + 1}
-            return new, jnp.sum(jnp.square(out.astype(jnp.float32)))
+            a, emit = cell(state["h"], args["x"])
+            return {"h": a, "n": state["n"] + 1}, emit
 
-    return CellGrain
+    return CellGrain, cell
 
 
 # per actor-round: h@W1 + x@Win + a@W2 (2 FLOPs per MAC)
@@ -88,7 +94,7 @@ def run(n_actors: int = 65536, fuse: int | None = None,
         reps: int = 3) -> dict:
     fuse = fuse if fuse is not None else int(
         os.environ.get("MXU_FUSE", "64"))
-    CellGrain = _make_grain()
+    CellGrain, cell = _make_grain()
     mesh = make_mesh(1)
     rt = VectorRuntime(mesh=mesh, capacity_per_shard=n_actors)
     tbl = rt.table(CellGrain)
@@ -151,6 +157,32 @@ def run(n_actors: int = 65536, fuse: int | None = None,
     # correctness: every actor saw every dispatched round exactly once
     n_rounds = int(np.asarray(tbl.read_row(0)["n"]))
     assert n_rounds == dispatched["rounds"], (n_rounds, dispatched)
+
+    # ---- engine tax: the BARE cell as the hardware ceiling ------------
+    # the same math without actor semantics (no slot gather/scatter, no
+    # fresh-init select, no valid masking, no per-round emit packing):
+    # its fitted per-round time is what THIS computation can do on this
+    # chip, so device_unit_ms / bare_unit_ms is the measured price of
+    # dispatch semantics — the residual below MXU peak is then split
+    # into (engine tax) x (bare-kernel efficiency)
+    @jax.jit
+    def bare(h, xs):
+        return jax.lax.scan(cell, h, xs)
+
+    h0 = jnp.zeros((n_actors, D), jnp.bfloat16)
+
+    def bare_blocking(k: int) -> float:
+        xs = (payload[:k] if k <= fuse else get_staged(k))
+        t0 = time.perf_counter()
+        jax.block_until_ready(bare(h0, xs))
+        return time.perf_counter() - t0
+
+    bare_fit = two_point_fit(bare_blocking, s_a, 2 * s_a, reps=reps)
+    bare_ms = bare_fit["device_unit_ms"]
+    bare_roof = roofline_fields(
+        bare_fit, flops_per_unit=FLOPS_PER_ACTOR_ROUND * n_actors)
+    tax = round(fit["device_unit_ms"] / bare_ms, 2) \
+        if bare_ms > 0 and fit["device_unit_ms"] > 0 else None
     roof = roofline_fields(
         fit,
         bytes_per_unit=BYTES_PER_ACTOR_ROUND * n_actors,
@@ -166,6 +198,9 @@ def run(n_actors: int = 65536, fuse: int | None = None,
         "flops_per_actor_round": FLOPS_PER_ACTOR_ROUND,
         "bytes_per_actor_round": BYTES_PER_ACTOR_ROUND,
         "verified_rounds": n_rounds,
+        "bare_cell_ms_per_round": bare_ms,
+        "bare_cell_pct_of_mxu_peak": bare_roof.get("pct_of_mxu_peak"),
+        "engine_tax_factor": tax,
         **fit, **roof,
     }
     extra.pop("device_unit_s", None)
