@@ -579,8 +579,14 @@ class VectorRuntime:
             packed = np.stack([plan.pack(a[k], dtype, shape)
                                for k in range(K)])
             args_b[fname] = tbl._put_rounds(jnp.asarray(packed))
-        kern = self._scan_kernel(grain_class, method, plan.B, K,
-                                 contiguous=self._plan_contiguous(tbl, plan))
+        kern = self._scan_kernel(
+            grain_class, method, plan.B, K,
+            contiguous=self._plan_contiguous(tbl, plan),
+            # static select-elision is ONLY safe when every lane is real:
+            # a padded lane in contiguous mode addresses by position, and
+            # an unmasked write there could corrupt a hashed activation's
+            # slot beyond the dense range
+            all_valid=bool(plan.valid_b.all()))
         new_state, results = kern(
             tbl.state, d_slots, d_khash, d_fresh, d_valid, args_b)
         if not m.read_only:
@@ -595,14 +601,15 @@ class VectorRuntime:
             results)
 
     def _scan_kernel(self, cls: type, method: str, B: int, K: int,
-                     contiguous: bool = False):
+                     contiguous: bool = False, all_valid: bool = False):
         tbl = self.tables[cls]
         key = ("scan", cls, method, B, K, tbl.capacity, tbl.n_shards,
-               contiguous, self.scan_unroll)
+               contiguous, self.scan_unroll, all_valid)
         k = self._kernel_cache.get(key)
         if k is None:
             k = self._build_kernel(cls, method, scan_rounds=K,
-                                   contiguous=contiguous)
+                                   contiguous=contiguous,
+                                   scan_all_valid=all_valid)
             self._kernel_cache[key] = k
         return k
 
@@ -800,7 +807,8 @@ class VectorRuntime:
         return k
 
     def _build_kernel(self, cls: type, method: str, scan_rounds: int = 0,
-                      contiguous: bool = False):
+                      contiguous: bool = False,
+                      scan_all_valid: bool = False):
         tbl = self.tables[cls]
         m = tbl.methods[method]
         handler = m.fn
@@ -868,16 +876,45 @@ class VectorRuntime:
                     st, init_rows, rows)
                 return jax.tree_util.tree_map(lambda a: a[None], new_st)
 
+            def scan_step(carry, slots, valid, args_k):
+                """The per-round scan body, statically specialized: the
+                init pass already ran, so fresh-init is GONE by
+                construction (not a runtime-zero mask the simplifier
+                must fold), and when the plan covers every lane
+                (scan_all_valid) the per-field validity select — a full
+                extra read+where of each state field per round, a
+                measurable slice of the MXU-handler engine tax — is
+                dropped statically too."""
+                state_l = jax.tree_util.tree_map(lambda a: a[0], carry)
+                slots_l = slots[0]
+                args_l = jax.tree_util.tree_map(lambda a: a[0], args_k)
+                read, write_at = make_access(slots_l)
+                rows = jax.tree_util.tree_map(read, state_l)
+                new_rows, results = jax.vmap(handler)(rows, args_l)
+                if read_only:
+                    out_state = carry
+                else:
+                    if scan_all_valid:
+                        new_state_l = jax.tree_util.tree_map(
+                            write_at, state_l, new_rows)
+                    else:
+                        valid_l = valid[0]
+                        new_state_l = jax.tree_util.tree_map(
+                            lambda f, nr, r: write_at(
+                                f, sel(valid_l, nr, r)),
+                            state_l, new_rows, rows)
+                    out_state = jax.tree_util.tree_map(
+                        lambda a: a[None], new_state_l)
+                return out_state, jax.tree_util.tree_map(
+                    lambda a: a[None], results)
+
             def scanned(state, slots, khash, fresh, valid, args_rounds):
                 # args_rounds leaves: [K, n, B, ...] — scan over K ticks;
                 # tick k+1 reads the state tick k wrote (serial turns)
                 state = init_pass(state, slots, khash, fresh, valid)
-                no_fresh = jnp.zeros_like(fresh)
 
                 def one(carry, args_k):
-                    st, out = local_step(carry, slots, khash, no_fresh,
-                                         valid, args_k)
-                    return st, out
+                    return scan_step(carry, slots, valid, args_k)
                 return lax.scan(one, state, args_rounds,
                                 unroll=max(1, self.scan_unroll))
 
