@@ -16,6 +16,7 @@ if __package__ in (None, ""):
 from benchmarks import (
     chirper_fanout,
     gpstracker_stream,
+    mxu_handler,
     mapreduce,
     ping,
     ping_socket,
@@ -42,6 +43,8 @@ def main() -> None:
                                              n_grains=200, tmpdir=td)):
             print(json.dumps(r))
     print(json.dumps(chirper_fanout.run(seconds=5.0)))
+    print(json.dumps(mxu_handler.run(n_actors=512, fuse=2, seconds=1.0,
+                                     reps=1)))
     for r in asyncio.run(gpstracker_stream.run(seconds=2.0)):
         print(json.dumps(r))
     print(json.dumps(asyncio.run(streams_vector.run(n_keys=50_000))))
