@@ -32,7 +32,7 @@ from jax.sharding import PartitionSpec as P
 
 from orleans_tpu.ops.route import rank_dense_keys
 from orleans_tpu.parallel import make_mesh
-from orleans_tpu.parallel.mesh import SILO_AXIS
+from orleans_tpu.parallel.mesh import SILO_AXIS, shard_map_compat
 from orleans_tpu.parallel.transport import build_exchange
 
 
@@ -86,10 +86,10 @@ def build_tick(mesh, n_accounts: int, timeline_len: int,
         return new_tls[None], new_pos[None], delivered[None]
 
     if n > 1:
-        expand = jax.shard_map(expand_local, mesh=mesh,
+        expand = shard_map_compat(expand_local, mesh=mesh,
                                in_specs=(spec,) * 5, out_specs=(spec,) * 4,
                                check_vma=False)
-        deliver = jax.shard_map(deliver_local, mesh=mesh,
+        deliver = shard_map_compat(deliver_local, mesh=mesh,
                                 in_specs=(spec,) * 5,
                                 out_specs=(spec,) * 3, check_vma=False)
     else:

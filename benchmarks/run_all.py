@@ -20,6 +20,7 @@ from benchmarks import (
     mapreduce,
     ping,
     ping_socket,
+    rebalance,
     serialization,
     streams_durable,
     streams_vector,
@@ -50,6 +51,8 @@ def main() -> None:
     print(json.dumps(asyncio.run(streams_vector.run(n_keys=50_000))))
     for r in asyncio.run(streams_durable.run(seconds=3.0)):
         print(json.dumps(r))
+    print(json.dumps(asyncio.run(rebalance.run(n_grains=32, concurrency=16,
+                                               seconds=1.0))))
 
 
 if __name__ == "__main__":

@@ -24,8 +24,8 @@ log = logging.getLogger("orleans.options")
 __all__ = [
     "ClusterOptions", "MessagingOptions", "SchedulingOptions",
     "GrainCollectionOptions", "MembershipOptions", "DirectoryOptions",
-    "LoadSheddingOptions", "DispatchOptions", "flatten", "apply_options",
-    "validate_options", "log_options",
+    "LoadSheddingOptions", "DispatchOptions", "RebalanceOptions",
+    "flatten", "apply_options", "validate_options", "log_options",
 ]
 
 
@@ -163,6 +163,28 @@ class DirectoryOptions:
 
 
 @dataclass
+class RebalanceOptions:
+    """Live activation migration & load-aware rebalancing
+    (orleans_tpu.rebalance — the DeploymentLoadPublisher +
+    activation-repartitioning trajectory of the reference): plan/execute
+    cadence, per-round migration budget, and the imbalance hysteresis."""
+
+    period: float = 0.0            # seconds between rounds; 0 disables
+    budget: int = 8                # max migrations per round (both tiers)
+    imbalance_ratio: float = 1.2   # rebalance only when hot > ratio * mean
+
+    def validate(self) -> None:
+        _positive(self, "budget")
+        if self.period < 0:
+            raise ConfigurationError(
+                "rebalance period must be >= 0 (0 disables the loop)")
+        if self.imbalance_ratio < 1.0:
+            raise ConfigurationError(
+                "rebalance imbalance_ratio must be >= 1.0 — a threshold "
+                "below the mean would migrate on every round forever")
+
+
+@dataclass
 class DispatchOptions:
     """TPU vector-dispatch tier (no reference analog — the batched engine's
     knobs): per-shard slot-pool capacity and exchange lane capacity."""
@@ -203,6 +225,9 @@ _FLAT_MAP = {
                                        "cache_refresh_period"),
     "load_shedding_enabled": (LoadSheddingOptions, "enabled"),
     "load_shedding_limit": (LoadSheddingOptions, "limit"),
+    "rebalance_period": (RebalanceOptions, "period"),
+    "rebalance_budget": (RebalanceOptions, "budget"),
+    "rebalance_imbalance_ratio": (RebalanceOptions, "imbalance_ratio"),
 }
 
 

@@ -56,6 +56,21 @@ class DirectoryTarget:
     async def dir_register(self, address: ActivationAddress):
         return self.locator.local_register(address)
 
+    async def dir_migrate_register(self, address: ActivationAddress,
+                                   prev_activation):
+        return self.locator.local_migrate_register(address, prev_activation)
+
+    async def dir_cache_invalidate(self, grain_id: GrainId) -> bool:
+        """Drop a stale LRU cache entry on THIS silo — the receive half of
+        invalidation-on-forward (the reference piggybacks the invalidation
+        on the forwarded message's response path; here it is an explicit
+        one-way system message from the forwarding silo). Without this, a
+        sender whose cache points at an activation's PREVIOUS silo (e.g.
+        after a live migration) pays a forward hop on every message until
+        the entry's TTL expires."""
+        self.locator.cache.pop(grain_id, None)
+        return True
+
     async def dir_unregister(self, address: ActivationAddress):
         self.locator.local_unregister(address)
         return True
@@ -230,8 +245,41 @@ class DistributedLocator:
             except Exception:  # noqa: BLE001 — owner may be mid-death
                 log.debug("remote unregister failed for %s", address.grain)
 
+    async def migrate_register(self, address: ActivationAddress,
+                               prev_activation) -> ActivationAddress:
+        """Re-register a grain mid-migration: REPLACE the registration the
+        migrating activation holds with the new address (ordinary
+        ``register`` is first-wins and would keep pointing at the source).
+        ``prev_activation``: the ActivationId being migrated away — the
+        guard that a racing re-creation's registration is never usurped.
+        Returns the winning address (≠ ``address`` means the migration
+        lost and must abort)."""
+        owner = self.ring.owner(address.grain.uniform_hash)
+        self.cache.pop(address.grain, None)
+        if owner is None or owner == self.silo.silo_address:
+            return self.local_migrate_register(address, prev_activation)
+        return await self._target_ref(owner, "dir_migrate_register",
+                                      address, prev_activation)
+
     def invalidate_cache(self, grain_id: GrainId) -> None:
         self.cache.pop(grain_id, None)
+
+    def notify_cache_invalidate(self, peer: SiloAddress,
+                                grain_id: GrainId) -> None:
+        """Invalidation-on-forward, cross-silo half: fire-and-forget a
+        cache drop to ``peer`` (the silo whose stale cache routed a
+        message here). Best-effort — a lost notice only costs the peer
+        forward hops until its entry's TTL expires."""
+        try:
+            self.silo.runtime_client.send_request(
+                target_grain=GrainId.system_target(_dir_type_code(), peer),
+                grain_class=DirectoryTarget,
+                interface_name=DIRECTORY_TARGET,
+                method_name="dir_cache_invalidate", args=(grain_id,),
+                kwargs={}, is_one_way=True, target_silo=peer,
+                category=Category.SYSTEM)
+        except Exception:  # noqa: BLE001 — peer may be mid-death
+            log.debug("cache-invalidate notice to %s failed", peer)
 
     async def unregister_after_nonexistent(self, grain_id: GrainId) -> None:
         """This silo received a message for ``grain_id`` but hosts no such
@@ -304,6 +352,23 @@ class DistributedLocator:
         if cur is not None and cur.silo in self.alive_set:
             return cur
         self.partition[address.grain] = address
+        return address
+
+    def local_migrate_register(self, address: ActivationAddress,
+                               prev_activation) -> ActivationAddress:
+        """Owner-side migrate re-registration: replaces the entry when it
+        names the migrating activation (or is dead/absent); an unrelated
+        LIVE registration wins instead — same first-wins discipline as
+        AddSingleActivation, with the migrating activation's claim carried
+        by ``prev_activation``. The owner's own cache entry is dropped so
+        lookups it answers from cache never resurrect the old address."""
+        cur = self.partition.get(address.grain)
+        if cur is not None and cur.silo in self.alive_set and \
+                cur.activation != prev_activation and \
+                cur.activation != address.activation:
+            return cur
+        self.partition[address.grain] = address
+        self.cache.pop(address.grain, None)
         return address
 
     def local_unregister(self, address: ActivationAddress) -> None:

@@ -35,7 +35,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..core.ids import GrainId
-from ..parallel.mesh import SILO_AXIS, make_mesh
+from ..parallel.mesh import SILO_AXIS, make_mesh, shard_map_compat
 from .table import ShardedActorTable
 from .vector_grain import ActorMethod, VectorGrain
 
@@ -182,6 +182,14 @@ class VectorRuntime:
         # bulk tick is pure overhead unless a storage bridge consumes it)
         self.track_dirty = False
         self._dirty: dict[type, list[np.ndarray]] = {}
+        # hot-spot load tracking (off by default, same rationale): when on,
+        # every tick folds its batch into the table's on-device per-slot
+        # hit counters — the telemetry feed of orleans_tpu.rebalance.
+        # conflicts_deferred is the cumulative same-slot deferral count
+        # (SiloControl's vector stats lens; always maintained, it's one
+        # integer add on an already-deferring path)
+        self.track_load = False
+        self.conflicts_deferred = 0
         # stateless-worker (mesh-replicated) hosts per class — see
         # dispatch.replicated (StatelessWorkerPlacement.cs:6 on device)
         self._replicated_hosts: dict[type, Any] = {}
@@ -248,6 +256,8 @@ class VectorRuntime:
                 self.tables[cls] = ShardedActorTable(
                     cls, self.mesh,
                     capacity_per_shard or self.capacity_per_shard)
+                if self.track_load:
+                    self.tables[cls].enable_hit_tracking()
 
     def table(self, cls: type) -> ShardedActorTable:
         if cls not in self.tables:
@@ -309,6 +319,29 @@ class VectorRuntime:
     def enable_dirty_tracking(self) -> None:
         self.track_dirty = True
 
+    # -- hot-spot load telemetry (consumed by orleans_tpu.rebalance) -----
+    def enable_load_tracking(self) -> None:
+        self.track_load = True
+        for tbl in self.tables.values():
+            tbl.enable_hit_tracking()
+
+    def queue_depth(self) -> int:
+        """Invocations queued for future ticks (incl. conflict-deferred) —
+        the device tier's inbound-queue-depth load signal."""
+        return sum(len(v) for v in self.pending.values())
+
+    def pending_key_hashes(self, cls: type) -> set[int]:
+        """Keys with queued invocations for ``cls``. Queued ``_Pending``
+        entries cache their (shard, slot), so these keys are FENCED: a
+        migration moving one mid-flight would let the next tick scatter
+        into the abandoned source row."""
+        return {p.key_hash for (c, _m), items in self.pending.items()
+                if c is cls for p in items}
+
+    def shard_loads(self) -> dict[type, np.ndarray]:
+        """Per-class per-shard invocation totals since the last reset."""
+        return {cls: tbl.shard_hits() for cls, tbl in self.tables.items()}
+
     def _mark_dirty(self, cls: type, keys) -> None:
         if self.track_dirty:
             self._dirty.setdefault(cls, []).append(
@@ -368,6 +401,7 @@ class VectorRuntime:
             loc = (p.shard, p.slot)
             if loc in claimed:
                 self.pending.setdefault((cls, method), []).append(p)
+                self.conflicts_deferred += 1
                 continue
             claimed.add(loc)
             ready.append(p)
@@ -414,6 +448,8 @@ class VectorRuntime:
             self._mark_dirty(cls, np.fromiter(
                 (p.key_hash for p in ready), dtype=np.int64,
                 count=len(ready)))
+        if self.track_load:
+            tbl.record_hits(slots, valid)
         # resolve futures from the result batch
         host = jax.tree_util.tree_map(np.asarray, results)
         for s, ps in enumerate(per_shard):
@@ -507,6 +543,8 @@ class VectorRuntime:
         if not m.read_only:
             tbl.state = new_state
             self._mark_dirty(grain_class, plan.keys)
+        if self.track_load:
+            tbl.record_hits(d_slots, d_valid)
         self.ticks += 1
         self.messages_processed += M
         if device_results:
@@ -592,6 +630,8 @@ class VectorRuntime:
         if not m.read_only:
             tbl.state = new_state
             self._mark_dirty(grain_class, plan.keys)
+        if self.track_load:
+            tbl.record_hits(d_slots, d_valid, scale=K)
         self.ticks += K
         self.messages_processed += K * M
         if device_results:
@@ -632,6 +672,10 @@ class VectorRuntime:
             tbl.state, slots_b, khash_b, fresh_b, valid_b, args_b)
         if not m.read_only:
             tbl.state = new_state
+        if self.track_load:
+            # device-resident masks fold without a host sync — the
+            # telemetry stays all-device exactly like the exchange flow
+            tbl.record_hits(slots_b, valid_b)
         self.ticks += 1
         if isinstance(valid_b, np.ndarray):
             self.messages_processed += int(valid_b.sum())
@@ -760,7 +804,7 @@ class VectorRuntime:
 
             if tbl.n_shards > 1:
                 spec = P(SILO_AXIS)
-                local = jax.shard_map(
+                local = shard_map_compat(
                     local, mesh=self.mesh,
                     in_specs=(spec, spec, spec, P(), P(), P()),
                     out_specs=(spec, spec, spec), check_vma=False)
@@ -784,7 +828,7 @@ class VectorRuntime:
 
         if tbl.n_shards > 1:
             spec = P(SILO_AXIS)
-            local = jax.shard_map(
+            local = shard_map_compat(
                 local, mesh=self.mesh, in_specs=(spec, spec),
                 out_specs=(spec, spec, spec), check_vma=False)
         slots, applied, khash = jax.jit(local)(recv_keys, recv_valid)
@@ -925,7 +969,7 @@ class VectorRuntime:
         if tbl.n_shards > 1:
             spec = P(SILO_AXIS)
             pspec = P(None, SILO_AXIS) if scan_rounds else spec
-            body = jax.shard_map(
+            body = shard_map_compat(
                 body, mesh=mesh,
                 in_specs=(spec, spec, spec, spec, spec, pspec),
                 out_specs=(spec, P(None, SILO_AXIS) if scan_rounds else spec),

@@ -38,7 +38,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from ..parallel.mesh import SILO_AXIS, replicated_spec, shard_spec
+from ..parallel.mesh import (SILO_AXIS, replicated_spec, shard_map_compat,
+                             shard_spec)
 from .engine import _validate_args
 from .vector_grain import VectorGrain, vector_methods
 
@@ -234,7 +235,7 @@ class ReplicatedWorkerHost:
 
         if self.n_shards > 1:
             spec = P(SILO_AXIS)
-            local = jax.shard_map(
+            local = shard_map_compat(
                 local, mesh=self.mesh,
                 in_specs=(spec, spec, spec, spec, spec),
                 out_specs=(spec, spec), check_vma=False)
@@ -283,7 +284,7 @@ class ReplicatedWorkerHost:
             return jax.tree_util.tree_map(lambda a: a[None], rows)
 
         if self.n_shards > 1:
-            local = jax.shard_map(
+            local = shard_map_compat(
                 local, mesh=self.mesh, in_specs=(P(SILO_AXIS), P()),
                 out_specs=P(None), check_vma=False)
 

@@ -23,6 +23,7 @@ Two key regimes:
 
 from __future__ import annotations
 
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -37,6 +38,31 @@ from .vector_grain import VectorGrain, vector_methods
 _LOC_STRIDE = 1 << 20
 
 __all__ = ["ShardedActorTable"]
+
+
+@partial(jax.jit, donate_argnums=0)
+def _accumulate_hits(hits, slots_b, valid_b, scale):
+    """Per-slot invocation counters, accumulated ON DEVICE as part of the
+    dispatch tick (the hot-spot telemetry feed of orleans_tpu.rebalance):
+    one masked scatter-add per tick — padding lanes address the sink row,
+    so no host sync and no data-dependent shapes."""
+    n = hits.shape[0]
+    shard = jnp.arange(n, dtype=jnp.int32)[:, None]
+    return hits.at[shard, slots_b].add(
+        valid_b.astype(jnp.int32) * scale)
+
+
+@jax.jit
+def _move_state_rows(state, src_shard, src_slot, dst_shard, dst_slot):
+    """Copy state rows (src_shard[i], src_slot[i]) → (dst_shard[i],
+    dst_slot[i]) across every field — the device half of a live
+    shard-to-shard migration. Purely functional (NO donation): the caller
+    keeps the old arrays as the implicit rollback snapshot until the swap
+    commits."""
+    def one(arr):
+        rows = arr[src_shard, src_slot]
+        return arr.at[dst_shard, dst_slot].set(rows)
+    return jax.tree_util.tree_map(one, state)
 
 
 class ShardedActorTable:
@@ -81,6 +107,11 @@ class ShardedActorTable:
             self.state[name] = self._put(
                 jnp.zeros((self.n_shards, self.capacity + 1, *shape),
                           dtype=dtype))
+        # hot-spot telemetry: per-slot invocation counters, [n_shards,
+        # capacity+1] with the sink row absorbing padding lanes. Off by
+        # default (an extra scatter-add per tick is pure overhead unless a
+        # rebalancer consumes it) — see enable_hit_tracking.
+        self.hits: jax.Array | None = None
 
     # ------------------------------------------------------------------
     def _put(self, arr):
@@ -105,6 +136,44 @@ class ShardedActorTable:
         (dense pre-provisioning reserves keyspace; activation is first
         touch — the dense_active bitmap)."""
         return len(self.key_to_slot) + int(self.dense_active.sum())
+
+    # -- hot-spot telemetry (consumed by orleans_tpu.rebalance) -----------
+    def enable_hit_tracking(self) -> None:
+        if self.hits is None:
+            self.hits = self._put(
+                jnp.zeros((self.n_shards, self.capacity + 1), jnp.int32))
+
+    def record_hits(self, slots_b, valid_b, scale: int = 1) -> None:
+        """Fold one tick's [n_shards, B] batch into the per-slot counters
+        (no-op until enable_hit_tracking). ``scale``: messages per lane —
+        K for a scanned K-round kernel."""
+        if self.hits is None:
+            return
+        self.hits = _accumulate_hits(
+            self.hits, jnp.asarray(slots_b, jnp.int32),
+            jnp.asarray(valid_b), jnp.int32(scale))
+
+    def shard_hits(self) -> np.ndarray:
+        """[n_shards] invocation totals since the last reset (sink row
+        excluded) — the load view a rebalance planner reads."""
+        if self.hits is None:
+            return np.zeros(self.n_shards, dtype=np.int64)
+        return np.asarray(
+            jnp.sum(self.hits[:, :self.capacity], axis=1)).astype(np.int64)
+
+    def slot_hits(self) -> np.ndarray:
+        """Host copy of the per-slot counters [n_shards, capacity+1]
+        (planner-rate readout, not tick-rate)."""
+        if self.hits is None:
+            return np.zeros((self.n_shards, self.capacity + 1), np.int32)
+        return np.asarray(self.hits)
+
+    def reset_hits(self) -> None:
+        """Zero the counters (each rebalance round plans against the load
+        observed since the previous round)."""
+        if self.hits is not None:
+            self.hits = self._put(
+                jnp.zeros((self.n_shards, self.capacity + 1), jnp.int32))
 
     # -- dense regime -----------------------------------------------------
     def ensure_dense(self, n: int) -> None:
@@ -197,6 +266,62 @@ class ShardedActorTable:
         self.route_hash.pop(key_hash, None)
         return True
 
+    def move_rows(self, keys, dest_shards) -> int:
+        """Live-migrate hashed-regime rows to new shards: extract the state
+        rows, insert them at freshly-allocated slots on the destination
+        shards, and atomically re-point the host directory maps + the
+        on-device DeviceDirectory64 (the executor half of
+        orleans_tpu.rebalance; the reference's activation repartitioning
+        move, re-expressed as one batched gather+scatter).
+
+        Keys not resident, already on their destination, or whose
+        destination shard has no free slot are skipped. The caller is
+        responsible for fencing (no pending invocation may hold a stale
+        (shard, slot) for a moving key). Returns the number of rows moved;
+        on device failure nothing is mutated (the copy is functional and
+        the slot/directory bookkeeping only commits after it succeeds)."""
+        src_sh, src_sl, dst_sh, dst_sl, moved_keys = [], [], [], [], []
+        taken: dict[int, int] = {}  # dest shard → slots claimed this call
+        seen: set[int] = set()  # a duplicate key would free its source
+        # slot twice and leak a destination slot — skip repeats
+        for key, dest in zip(keys, dest_shards):
+            key, dest = int(key), int(dest)
+            loc = self.key_to_slot.get(key)
+            if key in seen or loc is None or loc[0] == dest or \
+                    not (0 <= dest < self.n_shards):
+                continue
+            seen.add(key)
+            n_taken = taken.get(dest, 0)
+            if n_taken >= len(self.free[dest]):
+                continue  # destination full: skip, never grow mid-move
+            taken[dest] = n_taken + 1
+            src_sh.append(loc[0])
+            src_sl.append(loc[1])
+            dst_sh.append(dest)
+            # peek (no pop) so failure below leaves the free lists intact
+            dst_sl.append(self.free[dest][-1 - n_taken])
+            moved_keys.append(key)
+        if not moved_keys:
+            return 0
+        idx = (jnp.asarray(src_sh, jnp.int32), jnp.asarray(src_sl, jnp.int32),
+               jnp.asarray(dst_sh, jnp.int32), jnp.asarray(dst_sl, jnp.int32))
+        new_state = _move_state_rows(self.state, *idx)
+        if self.hits is not None:
+            # counters travel with the row (the planner's next view must
+            # see the key's heat at its new home, not a ghost at the old)
+            moved_hits = self.hits[idx[0], idx[1]]
+            self.hits = self.hits.at[idx[2], idx[3]].set(moved_hits) \
+                .at[idx[0], idx[1]].set(0)
+        self.state = new_state  # commit point
+        for key, s_sh, s_sl, d_sh, d_sl in zip(
+                moved_keys, src_sh, src_sl, dst_sh, dst_sl):
+            self.free[d_sh].remove(d_sl)
+            self.free[s_sh].append(s_sl)
+            self.key_to_slot[key] = (d_sh, d_sl)
+            self.device_dir.remove(key)
+            self.device_dir.insert(key, self._encode_loc(d_sh, d_sl))
+        return len(moved_keys)
+
     def note_route(self, key_hash: int, uniform_hash: int) -> None:
         """Record the routing hash for a (resident or incoming) hashed
         key — every entry point that knows the GrainId calls this."""
@@ -234,6 +359,11 @@ class ShardedActorTable:
             # old sink row (index `old`) is junk; copy only real rows
             grown = grown.at[:, :old].set(arr[:, :old])
             self.state[name] = self._put(grown)
+        if self.hits is not None:
+            grown_hits = jnp.zeros((self.n_shards, new_capacity + 1),
+                                   jnp.int32)
+            self.hits = self._put(
+                grown_hits.at[:, :old].set(self.hits[:, :old]))
         for s in range(self.n_shards):
             self.free[s] = list(range(new_capacity - 1, old - 1, -1)) + self.free[s]
         self.capacity = new_capacity

@@ -37,6 +37,8 @@ class SiloControl:
             "ticks": rt.ticks,
             "messages_processed": rt.messages_processed,
             "exchange_lanes": rt.exchange_lanes,
+            "conflicts_deferred": rt.conflicts_deferred,
+            "queue_depth": rt.queue_depth(),
             "classes": {cls.__name__: tbl.active_count()
                         for cls, tbl in rt.tables.items()},
         }

@@ -51,10 +51,17 @@ class DeploymentLoadPublisher:
         self._task: asyncio.Task | None = None
 
     def load_of(self, silo: SiloAddress) -> int | None:
+        report = self.report_of(silo)
+        # stale/absent: None — caller falls back to the fabric read
+        return None if report is None else report["activation_count"]
+
+    def report_of(self, silo: SiloAddress) -> dict | None:
+        """Full freshest report for a peer (activation count, queue depth,
+        per-class device-shard heat) — the rebalance planner's view."""
         report = self.view.get(silo)
         if report is None or time.time() - report["ts"] > 10 * self.period:
-            return None  # stale/absent: caller falls back to fabric read
-        return report["activation_count"]
+            return None
+        return report
 
     def start(self) -> None:
         self._task = asyncio.get_running_loop().create_task(self._loop())
@@ -73,10 +80,13 @@ class DeploymentLoadPublisher:
             await asyncio.sleep(self.period)
 
     def _publish(self) -> None:
-        report = {
-            "activation_count": self.silo.catalog.activation_count(),
-            "ts": time.time(),
-        }
+        # the extended load report (activation count + queue depth +
+        # per-class device-shard heat) comes from rebalance.telemetry so
+        # planners on every peer see one consistent schema
+        from ..rebalance.telemetry import load_report
+
+        report = load_report(self.silo)
+        report["ts"] = time.time()
         me = self.silo.silo_address
         self.view[me] = report
         for peer in self.silo.locator.alive_list:

@@ -2,4 +2,4 @@
 (reference L13)."""
 
 from .profiling import Profiler, StepTimer, annotate, traced  # noqa: F401
-from .stats import Histogram, StatsRegistry  # noqa: F401
+from .stats import REBALANCE_STATS, Histogram, StatsRegistry  # noqa: F401

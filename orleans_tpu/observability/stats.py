@@ -12,7 +12,26 @@ import bisect
 import time
 from typing import Callable
 
-__all__ = ["StatsRegistry", "Histogram"]
+__all__ = ["StatsRegistry", "Histogram", "REBALANCE_STATS"]
+
+# Canonical rebalancer counter/gauge names (orleans_tpu.rebalance wires
+# its per-round outcomes here; tests and the management surface read them
+# by these names rather than re-deriving strings).
+REBALANCE_STATS = {
+    "rounds": "rebalance.rounds",                  # counter: rounds run
+    "planned": "rebalance.planned",                # counter: moves planned
+    "migrated": "rebalance.activations.migrated",  # counter: host moves done
+    "rows_moved": "rebalance.rows.moved",          # counter: device rows
+    "rolled_back": "rebalance.rolled_back",        # counter: failed+undone
+    "refused": "rebalance.refused",                # counter: dest refused
+    "dropped": "rebalance.dropped",                # counter: over budget
+    "last_moved": "rebalance.last_round.moved",    # gauge: last round total
+    "last_imbalance": "rebalance.last_round.imbalance",  # gauge: hot/mean
+    # gauge: cluster-wide device-shard heat ratio (hottest silo's per-class
+    # hit total / cluster mean), computed from peers' broadcast vector_hits
+    # — the early-warning signal for the cross-silo row-migration follow-on
+    "device_hot_ratio": "rebalance.cluster.device_hot_ratio",
+}
 
 
 class Histogram:
@@ -66,6 +85,16 @@ class StatsRegistry:
 
     def register_gauge(self, name: str, fn: Callable[[], float]) -> None:
         self.gauges[name] = fn
+
+    def set_gauge(self, name: str, value: float) -> None:
+        """Point-in-time gauge write (IntValueStatistic set-style use —
+        e.g. a rebalance round records its outcome once per round rather
+        than registering a live callable)."""
+        self.gauges[name] = lambda: value
+
+    def gauge(self, name: str) -> float:
+        fn = self.gauges.get(name)
+        return fn() if fn is not None else 0.0
 
     def histogram(self, name: str) -> Histogram:
         h = self.histograms.get(name)

@@ -13,9 +13,23 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["SILO_AXIS", "make_mesh", "shard_spec", "replicated_spec"]
+__all__ = ["SILO_AXIS", "make_mesh", "shard_spec", "replicated_spec",
+           "shard_map_compat"]
 
 SILO_AXIS = "silo"
+
+
+def shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """``jax.shard_map`` across jax versions: new jax exposes it top-level
+    with ``check_vma``; 0.4.x keeps it in ``jax.experimental.shard_map``
+    under ``check_rep``. One shim so every kernel builder stays on the
+    current-API spelling."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)
 
 
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:

@@ -1,3 +1,8 @@
 """Placement strategies (reference L7)."""
 
-from .strategies import PlacementDirector, PlacementManager  # noqa: F401
+from .strategies import (  # noqa: F401
+    ActivationCountP2CPlacement,
+    ActivationCountPlacement,
+    PlacementDirector,
+    PlacementManager,
+)

@@ -156,6 +156,79 @@ class Catalog:
             act.activating_backlog.clear()
 
     # ------------------------------------------------------------------
+    # Live migration, inbound half (orleans_tpu.rebalance — the
+    # reference's activation-repartitioning rehydrate: Orleans 7 grain
+    # migration dehydrates state at the source and rehydrates here)
+    # ------------------------------------------------------------------
+    async def rehydrate_activation(self, grain_id: GrainId,
+                                   grain_class: type, state_payload,
+                                   prev_activation) -> ActivationData:
+        """Create a VALID activation carrying migrated in-memory state.
+
+        Mirrors ``_create_activation`` + ``_init_activation`` with three
+        deltas: registration goes through the locator's migrate path
+        (REPLACING the source's entry instead of losing first-wins to it);
+        storage is still read first so the etag arms, but the migrated
+        state overwrites the stored snapshot (the in-memory rows are newer
+        than the last persisted write); and the method is awaited by the
+        migration RPC, so the source only destroys its copy after this
+        silo is serving. Raises on any failure — the source rolls back."""
+        from ..core.errors import OrleansError
+
+        if self.by_grain.get(grain_id):
+            raise OrleansError(
+                f"{grain_id} already has an activation on this silo")
+        act = ActivationData(grain_id, self.silo.runtime, grain_class,
+                             max_enqueued=self.silo.config.max_enqueued_requests)
+        act.state = ActivationState.ACTIVATING
+        self.by_activation[act.activation_id] = act
+        self.by_grain.setdefault(grain_id, []).append(act)
+        registered = False
+        try:
+            winner = await self.silo.locator.migrate_register(
+                act.address, prev_activation)
+            if winner is not None and \
+                    winner.activation != act.activation_id:
+                raise OrleansError(
+                    f"migration of {grain_id} lost to a live "
+                    f"registration on {winner.silo}")
+            registered = True
+            instance = self.silo.registry.construct(grain_class)
+            instance._activation = act
+            act.grain_instance = instance
+            if isinstance(instance, StatefulGrain):
+                act.storage_bridge = self.silo.storage_manager.bridge_for(act)
+                await instance.read_state()  # arm the etag
+                if state_payload is not None:
+                    instance.state = state_payload
+            await self.silo.dispatcher_scoped(act, instance.on_activate)
+            act.state = ActivationState.VALID
+            self.silo.stats.increment("catalog.activations.migrated_in")
+        except BaseException:
+            self._destroy(act)
+            if registered:
+                # surrender the claimed entry so the source's rollback
+                # re-registration wins cleanly instead of losing
+                # first-wins to our dead claim
+                try:
+                    await self.silo.locator.unregister(act.address)
+                except Exception:  # noqa: BLE001 — stale-entry heal covers
+                    pass
+            # requests that raced in while we were ACTIVATING re-address
+            # against the directory (which still/again names the source)
+            for m in act.activating_backlog:
+                m.target_silo = None
+                m.target_activation = None
+                self.silo.dispatcher.send_message(m)
+            act.activating_backlog.clear()
+            raise
+        backlog, act.activating_backlog = \
+            act.activating_backlog, type(act.activating_backlog)()
+        for m in backlog:
+            self.silo.dispatcher.receive_request(act, m)
+        return act
+
+    # ------------------------------------------------------------------
     # Deactivation (Catalog.cs:780-917)
     # ------------------------------------------------------------------
     def schedule_deactivation(self, act: ActivationData,

@@ -622,6 +622,17 @@ class Dispatcher:
             msg.target_silo = None
             msg.target_activation = None
             self.silo.locator.invalidate_cache(msg.target_grain)
+            # invalidation-on-forward, outward half: the SENDER's stale
+            # cache routed this message here (e.g. the grain live-migrated
+            # away) — without telling it, every subsequent send pays the
+            # same forward hop until the sender's TTL expires
+            sender = msg.sending_silo
+            notify = getattr(self.silo.locator, "notify_cache_invalidate",
+                             None)
+            if notify is not None and sender is not None and \
+                    sender != self.silo.silo_address and \
+                    sender in self.silo.locator.alive_set:
+                notify(sender, msg.target_grain)
             # hot-path statistics discipline (MessagingStatisticsGroup):
             # forward rate is THE staleness signal the adaptive directory
             # cache exists to suppress — it must be observable

@@ -47,6 +47,8 @@ _eager_refs: dict[int, tuple[int, Any]] = {}
 
 
 def _install_eager_factory(loop: asyncio.AbstractEventLoop) -> None:
+    if not hasattr(asyncio, "eager_task_factory"):
+        return  # pre-3.12 runtime: turns run through the ordinary factory
     key = id(loop)
     if key in _eager_refs:
         n, prev = _eager_refs[key]
@@ -117,6 +119,13 @@ class SiloConfig:
     directory_cache_max_ttl: float = 120.0
     directory_cache_refresh_period: float = 2.0
     turn_warning_length: float = 0.2  # TurnWarningLengthThreshold
+    # live rebalancer (orleans_tpu.rebalance): plan/execute period in
+    # seconds (0 disables the loop even when the service is installed),
+    # per-round migration budget, and the hot/mean load ratio below which
+    # a round is a no-op (hysteresis: don't churn a balanced cluster)
+    rebalance_period: float = 0.0
+    rebalance_budget: int = 8
+    rebalance_imbalance_ratio: float = 1.2
     # run new turn tasks eagerly to their first suspension
     # (asyncio.eager_task_factory): a turn that completes without awaiting
     # skips the event-loop round trip entirely — the asyncio analog of the

@@ -117,6 +117,17 @@ class TestClusterBuilder:
             lambda b: add_transactions(b, **kw))
         return self
 
+    def with_rebalancer(self, period: float = 0.2, budget: int | None = None,
+                        imbalance_ratio: float | None = None
+                        ) -> "TestClusterBuilder":
+        """Live rebalancer on every silo (rebalance.add_rebalancer) with a
+        test-fast round period."""
+        from ..rebalance import add_rebalancer
+        self._silo_configurators.append(
+            lambda b: add_rebalancer(b, period=period, budget=budget,
+                                     imbalance_ratio=imbalance_ratio))
+        return self
+
     def with_vector_grains(self, *grain_classes: type,
                            **kw) -> "TestClusterBuilder":
         """Device-tier grains on every silo (dispatch.add_vector_grains):

@@ -27,6 +27,13 @@ import inspect  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # tier-1 runs with `-m 'not slow'`; register the marker so slow-marked
+    # soaks (rebalance convergence, chaos) don't warn
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 fast suite")
+
+
 @pytest.hookimpl(tryfirst=True)
 def pytest_pyfunc_call(pyfuncitem):
     fn = pyfuncitem.obj

@@ -67,3 +67,12 @@ def test_mxu_handler_harness():
     _check(r)
     assert r["extra"]["flops_per_actor_round"] > 1e6
     assert r["extra"]["verified_rounds"] >= 2
+
+
+async def test_rebalance_harness():
+    from benchmarks import rebalance
+    r = await rebalance.run(n_grains=16, concurrency=4, seconds=0.2,
+                            budget=8)
+    assert r["activations_moved"] > 0
+    assert max(r["counts_after"]) < r["skew_before"]
+    assert r["throughput_balanced"] > 0

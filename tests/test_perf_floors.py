@@ -8,7 +8,22 @@ while a real regression (2x slowdown) still trips. Each check takes the
 best of two short runs for the same reason. The >=1M events/sec stream
 floor lives in test_vector_streams.py."""
 
+import asyncio
+
+import pytest
+
 from benchmarks import ping, ping_socket, transactions
+
+# The documented bands were measured with eager turn execution
+# (asyncio.eager_task_factory, Python >= 3.12): every non-suspending turn
+# skips an event-loop round trip. On older interpreters that machinery
+# does not exist and the whole hot path runs ~2-4x slower for structural
+# reasons, so the floors cannot distinguish a regression from the
+# missing-feature baseline — skip rather than fail on noise.
+pytestmark = pytest.mark.skipif(
+    not hasattr(asyncio, "eager_task_factory"),
+    reason="perf floors calibrated with asyncio.eager_task_factory "
+           "(Python >= 3.12); this interpreter lacks it")
 
 # floor, documented band (single shared core, JAX_PLATFORMS=cpu)
 TXN_FLOOR = 2_500          # band 3.7-4.7k @ c=32 (RESULTS_r4, 5 runs)

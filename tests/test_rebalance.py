@@ -1,0 +1,378 @@
+"""Live activation migration & load-aware rebalancing (orleans_tpu.rebalance):
+device-tier hot-shard drains, host-tier cross-silo activation migration
+under concurrent traffic (zero lost/duplicated invocations), placement
+variants, and invalidation-on-forward."""
+
+import asyncio
+
+import jax.numpy as jnp
+import pytest
+
+from orleans_tpu.core.ids import SiloAddress
+from orleans_tpu.dispatch import VectorGrain, actor_method, add_vector_grains
+from orleans_tpu.observability.stats import REBALANCE_STATS
+from orleans_tpu.parallel import make_mesh
+from orleans_tpu.placement.strategies import (
+    ActivationCountP2CPlacement,
+    ActivationCountPlacement,
+    PlacementManager,
+)
+from orleans_tpu.rebalance import add_rebalancer
+from orleans_tpu.runtime import ClusterClient, SiloBuilder, StatefulGrain
+from orleans_tpu.testing import TestClusterBuilder
+
+
+class CounterVec(VectorGrain):
+    STATE = {"count": (jnp.int32, ())}
+
+    @staticmethod
+    def initial_state(key_hash):
+        return {"count": jnp.int32(0)}
+
+    @actor_method(args={"x": (jnp.int32, ())})
+    def bump(state, args):
+        new = {"count": state["count"] + args["x"]}
+        return new, new["count"]
+
+
+class HotGrain(StatefulGrain):
+    """Host-tier counter; placement pinned in tests via a custom director."""
+
+    __orleans_placement__ = "pin_first"
+
+    async def incr(self) -> int:
+        self.state["n"] = self.state.get("n", 0) + 1
+        await self.write_state()
+        return self.state["n"]
+
+    async def where(self) -> str:
+        return str(self.runtime.silo_address)
+
+
+class PinFirstDirector:
+    """Everything lands on one silo — the skew generator."""
+
+    def __init__(self, pinned: SiloAddress):
+        self.pinned = pinned
+
+    def place(self, grain_id, requester, silos):
+        return self.pinned if self.pinned in silos else silos[0]
+
+
+def _pin_placement(cluster, pinned) -> None:
+    for s in cluster.silos:
+        s.locator.placement.directors["pin_first"] = PinFirstDirector(pinned)
+
+
+# ----------------------------------------------------------------------
+# Device tier: hot-shard telemetry + live row migration
+# ----------------------------------------------------------------------
+async def test_device_hot_shard_drains_to_cool_shards():
+    """Hashed keys engineered onto one shard; after a rebalance round the
+    hot shard's row count drops and every key's state row survives."""
+    b = SiloBuilder().with_name("dev-rebalance").with_config(
+        rebalance_budget=16, rebalance_imbalance_ratio=1.1)
+    add_vector_grains(b, CounterVec, mesh=make_mesh(8),
+                      capacity_per_shard=64)
+    add_rebalancer(b)  # period 0: manual rounds
+    silo = b.build()
+    await silo.start()
+    silo.vector.enable_load_tracking()  # manual rounds: opt in explicitly
+    client = await ClusterClient(silo.fabric).connect()
+    try:
+        n_keys, n_shards = 12, 8
+        keys = [k * n_shards for k in range(n_keys)]  # all hash to shard 0
+        for rep in range(3):
+            out = await asyncio.gather(*(
+                client.get_grain(CounterVec, k).bump(x=1) for k in keys))
+            assert [int(v) for v in out] == [rep + 1] * n_keys
+        tbl = silo.vector.table(CounterVec)
+        assert all(tbl.key_to_slot[k][0] == 0 for k in keys)
+        assert int(tbl.shard_hits()[0]) == 3 * n_keys  # on-device counters
+        outcome = await silo.rebalancer.run_round()
+        assert outcome["rows_moved"] > 0
+        shards_after = {tbl.key_to_slot[k][0] for k in keys}
+        assert len(shards_after) > 1, "hot shard did not drain"
+        on_hot = sum(1 for k in keys if tbl.key_to_slot[k][0] == 0)
+        assert on_hot < n_keys
+        # state rows carried exactly: counts continue from 3
+        out = await asyncio.gather(*(
+            client.get_grain(CounterVec, k).bump(x=1) for k in keys))
+        assert [int(v) for v in out] == [4] * n_keys
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_device_move_fences_pending_invocations():
+    """A key with a queued invocation must not move mid-flight (the queued
+    _Pending caches its (shard, slot))."""
+    b = SiloBuilder().with_name("dev-fence").with_config(
+        rebalance_budget=16, rebalance_imbalance_ratio=1.1)
+    add_vector_grains(b, CounterVec, mesh=make_mesh(8),
+                      capacity_per_shard=64)
+    add_rebalancer(b)
+    silo = b.build()
+    await silo.start()
+    silo.vector.enable_load_tracking()
+    client = await ClusterClient(silo.fabric).connect()
+    try:
+        rt = silo.vector
+        keys = [k * 8 for k in range(10)]
+        await asyncio.gather(*(
+            client.get_grain(CounterVec, k).bump(x=1) for k in keys))
+        tbl = rt.table(CounterVec)
+        # queue an invocation for keys[0] but do NOT let the tick run yet
+        fut = rt.call(CounterVec, keys[0], "bump", x=jnp.int32(5))
+        assert keys[0] in rt.pending_key_hashes(CounterVec)
+        loc_before = tbl.key_to_slot[keys[0]]
+        await silo.rebalancer.run_round()
+        assert tbl.key_to_slot[keys[0]] == loc_before, "fenced key moved"
+        assert int(await fut) == 6  # the queued call still lands correctly
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+# ----------------------------------------------------------------------
+# Host tier: the two-silo skewed-workload acceptance scenario
+# ----------------------------------------------------------------------
+async def test_two_silo_skewed_workload_rebalances_live():
+    """Skew every HotGrain onto silo A, drive traffic concurrently with
+    the rebalancer loop: at least one migration round runs, silo A's
+    activation count decreases, silo B's increases, and NO invocation is
+    lost or duplicated (every grain's counter stays gap-free and
+    monotonic through its migration)."""
+    n_grains, n_rounds = 16, 12
+    cluster = (TestClusterBuilder(2).add_grains(HotGrain)
+               .with_rebalancer(period=0.15, budget=6, imbalance_ratio=1.1)
+               .build())
+    async with cluster:
+        silo_a, silo_b = cluster.silos
+        _pin_placement(cluster, silo_a.silo_address)
+        grains = [cluster.grain(HotGrain, f"hot-{i}") for i in range(n_grains)]
+        # settle all activations on A
+        first = await asyncio.gather(*(g.incr() for g in grains))
+        assert first == [1] * n_grains
+        count_a_before = silo_a.catalog.activation_count()
+        assert count_a_before >= n_grains
+        assert silo_b.catalog.activation_count() == 0
+
+        # concurrent traffic while migration rounds run underneath
+        for r in range(2, n_rounds + 2):
+            out = await asyncio.gather(*(g.incr() for g in grains))
+            assert out == [r] * n_grains, f"lost/duplicated call at round {r}"
+            await asyncio.sleep(0.05)
+
+        await cluster.wait_until(
+            lambda: silo_b.catalog.activation_count() > 0
+            and silo_a.catalog.activation_count() < count_a_before,
+            timeout=10.0, msg="a migration round to drain silo A")
+
+        # traffic after the move still lands exactly-once
+        out = await asyncio.gather(*(g.incr() for g in grains))
+        assert out == [n_rounds + 2] * n_grains
+        hosts = await asyncio.gather(*(g.where() for g in grains))
+        assert str(silo_b.silo_address) in hosts, "no grain serving from B"
+
+        # migration counters are visible in observability.stats
+        assert silo_a.stats.get(REBALANCE_STATS["migrated"]) > 0
+        assert silo_a.stats.get("catalog.activations.migrated_out") > 0
+        assert silo_b.stats.get("catalog.activations.migrated_in") > 0
+        assert silo_a.stats.gauge(REBALANCE_STATS["last_imbalance"]) > 0
+
+
+async def test_migration_mid_flight_messages_redispatch():
+    """Messages that race a migration (arrive during the fence) park at
+    the source and re-address to the destination — none lost, none run
+    twice."""
+    cluster = (TestClusterBuilder(2).add_grains(HotGrain)
+               .with_rebalancer(period=0.0)  # manual: we drive the executor
+               .build())
+    async with cluster:
+        silo_a, silo_b = cluster.silos
+        _pin_placement(cluster, silo_a.silo_address)
+        g = cluster.grain(HotGrain, "racer")
+        assert await g.incr() == 1
+        act = silo_a.catalog.by_grain[g.grain_id][0]
+        # start the migration and race a burst of increments against it
+        mig = asyncio.ensure_future(
+            silo_a.rebalancer.executor.migrate_activation(
+                act, silo_b.silo_address))
+        burst = [asyncio.ensure_future(g.incr()) for _ in range(8)]
+        assert await mig is True
+        vals = await asyncio.gather(*burst)
+        assert sorted(vals) == list(range(2, 10)), vals
+        assert silo_b.catalog.by_grain.get(g.grain_id), "not serving on B"
+        assert not silo_a.catalog.by_grain.get(g.grain_id)
+        assert await g.incr() == 10  # state carried exactly
+
+
+async def test_migration_rolls_back_when_destination_refuses():
+    """Transfer failure (class unknown on the destination) leaves the
+    source activation serving with its registration intact."""
+    cluster = (TestClusterBuilder(2).add_grains(HotGrain)
+               .with_rebalancer(period=0.0).build())
+    async with cluster:
+        silo_a, silo_b = cluster.silos
+        _pin_placement(cluster, silo_a.silo_address)
+        g = cluster.grain(HotGrain, "stayer")
+        assert await g.incr() == 1
+        act = silo_a.catalog.by_grain[g.grain_id][0]
+        # sabotage the destination: it cannot resolve the class
+        silo_b.registry._classes.pop("HotGrain")
+        ok = await silo_a.rebalancer.executor.migrate_activation(
+            act, silo_b.silo_address)
+        assert ok is False
+        assert silo_a.stats.get(REBALANCE_STATS["rolled_back"]) + \
+            silo_a.stats.get(REBALANCE_STATS["refused"]) > 0
+        from orleans_tpu.runtime.activation import ActivationState
+        assert act.state == ActivationState.VALID
+        assert await g.incr() == 2  # still serving locally, no state loss
+
+
+# ----------------------------------------------------------------------
+# Satellites: placement variants + invalidation-on-forward
+# ----------------------------------------------------------------------
+def test_activation_count_placement_full_scan_and_p2c():
+    silos = [SiloAddress(f"s{i}", 1000 + i, 1) for i in range(5)]
+    loads = {s: i * 10 for i, s in enumerate(silos)}
+    full = ActivationCountPlacement(lambda s: loads[s])
+    # full scan: always the global minimum
+    for _ in range(10):
+        assert full.place(None, silos[3], silos) == silos[0]
+    p2c = ActivationCountP2CPlacement(lambda s: loads[s])
+    picks = {p2c.place(None, silos[4], silos) for _ in range(50)}
+    # p2c: least-loaded of the sampled pair (+requester) — never the
+    # requester (heaviest) unless sampled alone, never worse than sampled
+    assert silos[4] not in picks
+    assert silos[0] in picks  # min is sampled eventually
+
+
+def test_placement_manager_exposes_p2c_by_name():
+    mgr = PlacementManager(lambda s: 0)
+    assert isinstance(mgr.director_by_name("activation_count"),
+                      ActivationCountPlacement)
+    assert isinstance(mgr.director_by_name("activation_count_p2c"),
+                      ActivationCountP2CPlacement)
+    # the p2c director is not the full-scan one
+    assert type(mgr.director_by_name("activation_count")) is \
+        ActivationCountPlacement
+
+
+async def test_forward_notifies_sender_cache_invalidation():
+    """After a migration, a peer whose LRU cache still names the old host
+    gets its entry dropped by the forwarding silo (invalidation-on-forward
+    now heals OTHER silos, not just the forwarder)."""
+    cluster = (TestClusterBuilder(3).add_grains(HotGrain)
+               .with_rebalancer(period=0.0).build())
+    async with cluster:
+        silo_a, silo_b, silo_c = cluster.silos
+        _pin_placement(cluster, silo_a.silo_address)
+        g = cluster.grain(HotGrain, "cached")
+        assert await g.incr() == 1
+        gid = g.grain_id
+        act = silo_a.catalog.by_grain[gid][0]
+        # plant a warm cache entry on C naming A (as a prior call would)
+        silo_c.locator.cache.put(gid, silo_a.silo_address)
+        ok = await silo_a.rebalancer.executor.migrate_activation(
+            act, silo_b.silo_address)
+        assert ok is True
+        # C sends with its stale cache → lands on A → A forwards to B and
+        # notifies C; the call must still succeed (exactly once)
+        ref = silo_c.grain_factory.get_grain(HotGrain, "cached")
+        assert await ref.incr() == 2
+        await cluster.wait_until(
+            lambda: silo_c.locator.cache.get(gid) != silo_a.silo_address,
+            timeout=5.0, msg="stale cache entry on C to be invalidated")
+
+
+async def test_rebalance_round_is_noop_when_balanced():
+    cluster = (TestClusterBuilder(2).add_grains(HotGrain)
+               .with_rebalancer(period=0.0).build())
+    async with cluster:
+        silo_a, _ = cluster.silos
+        outcome = await silo_a.rebalancer.run_round()
+        assert outcome["planned"] == 0
+        assert silo_a.stats.get(REBALANCE_STATS["rounds"]) == 1
+        assert silo_a.stats.gauge(REBALANCE_STATS["last_moved"]) == 0
+
+
+@pytest.mark.slow
+async def test_three_silo_convergence_soak():
+    """Multi-round convergence: a heavily skewed 3-silo cluster converges
+    to within the imbalance ratio over several rebalance rounds, without
+    thrashing activations back and forth (>5s: marked slow)."""
+    n_grains = 30
+    cluster = (TestClusterBuilder(3).add_grains(HotGrain)
+               .with_rebalancer(period=0.2, budget=5, imbalance_ratio=1.2)
+               .build())
+    async with cluster:
+        silo_a = cluster.silos[0]
+        _pin_placement(cluster, silo_a.silo_address)
+        grains = [cluster.grain(HotGrain, f"soak-{i}")
+                  for i in range(n_grains)]
+        assert await asyncio.gather(*(g.incr() for g in grains)) \
+            == [1] * n_grains
+
+        def converged() -> bool:
+            counts = [s.catalog.activation_count() for s in cluster.silos]
+            live = [c for c in counts]
+            mean = sum(live) / len(live)
+            return mean > 0 and max(live) <= 1.3 * mean
+
+        await cluster.wait_until(converged, timeout=20.0,
+                                 msg="cluster load convergence")
+        # steady traffic through the whole soak stayed exactly-once
+        out = await asyncio.gather(*(g.incr() for g in grains))
+        assert out == [2] * n_grains
+        total_moves = sum(s.stats.get(REBALANCE_STATS["migrated"])
+                          for s in cluster.silos)
+        assert total_moves >= n_grains // 3  # real redistribution happened
+        assert total_moves <= n_grains * 3   # and no migration thrash
+
+
+async def test_device_rebalance_string_keys_63bit_hashes():
+    """String keys ride the full 63-bit uniform hash; the plan pack must
+    carry them losslessly (bit 62 is set for ~half of them — an int32
+    split would mangle the key and silently skip the move)."""
+    from orleans_tpu.core.ids import GrainId, GrainType
+
+    gt = GrainType.of("CounterVec")
+    names, i = [], 0
+    while len(names) < 10:
+        key = f"user-{i}"
+        i += 1
+        if GrainId.for_grain(gt, key).uniform_hash % 8 == 0:
+            names.append(key)
+    hashes = [GrainId.for_grain(gt, k).uniform_hash for k in names]
+    assert any(h >> 62 for h in hashes), "want at least one bit-62 hash"
+
+    b = SiloBuilder().with_name("dev-strkeys").with_config(
+        rebalance_budget=16, rebalance_imbalance_ratio=1.1)
+    add_vector_grains(b, CounterVec, mesh=make_mesh(8),
+                      capacity_per_shard=64)
+    add_rebalancer(b)
+    silo = b.build()
+    await silo.start()
+    silo.vector.enable_load_tracking()
+    client = await ClusterClient(silo.fabric).connect()
+    try:
+        for rep in range(2):
+            out = await asyncio.gather(*(
+                client.get_grain(CounterVec, k).bump(x=1) for k in names))
+            assert [int(v) for v in out] == [rep + 1] * len(names)
+        tbl = silo.vector.table(CounterVec)
+        assert all(tbl.key_to_slot[h][0] == 0 for h in hashes)
+        outcome = await silo.rebalancer.run_round()
+        assert outcome["rows_moved"] > 0, "63-bit keys were not moved"
+        assert len({tbl.key_to_slot[h][0] for h in hashes}) > 1
+        # the broadcast heat consumer surfaced a cluster gauge this round
+        assert silo.stats.gauge(
+            REBALANCE_STATS["device_hot_ratio"]) >= 1.0
+        out = await asyncio.gather(*(
+            client.get_grain(CounterVec, k).bump(x=1) for k in names))
+        assert [int(v) for v in out] == [3] * len(names)
+    finally:
+        await client.close_async()
+        await silo.stop()
