@@ -36,10 +36,19 @@ class EchoGrain(Grain):
 
 
 async def bench_host_tier(n_grains: int, concurrency: int,
-                          seconds: float) -> dict:
-    silo = SiloBuilder().with_name("ping-silo").add_grains(EchoGrain).build()
+                          seconds: float,
+                          trace_sample: float | None = None) -> dict:
+    """``trace_sample``: None runs untraced (no collector installed);
+    a float enables distributed tracing at that head-sampling rate — the
+    overhead-tracking variant wired into run_all and the perf floor."""
+    b = SiloBuilder().with_name("ping-silo").add_grains(EchoGrain)
+    if trace_sample is not None:
+        b = b.with_config(trace_enabled=True, trace_sample_rate=trace_sample)
+    silo = b.build()
     await silo.start()
     client = await ClusterClient(silo.fabric).connect()
+    if trace_sample is not None:
+        client.enable_tracing(trace_sample)
     grains = [client.get_grain(EchoGrain, k) for k in range(n_grains)]
 
     # warmup: activate every grain
@@ -68,7 +77,8 @@ async def bench_host_tier(n_grains: int, concurrency: int,
     await client.close_async()
     await silo.stop()
     return {
-        "metric": "ping_host_calls_per_sec",
+        "metric": ("ping_host_calls_per_sec" if trace_sample is None
+                   else "ping_host_traced_calls_per_sec"),
         "value": round(calls / elapsed, 1),
         "unit": "calls/sec",
         "vs_baseline": None,
@@ -76,6 +86,7 @@ async def bench_host_tier(n_grains: int, concurrency: int,
             "n_grains": n_grains,
             "concurrency": concurrency,
             "calls": calls,
+            "trace_sample": trace_sample,
             "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
             "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
         },

@@ -32,6 +32,10 @@ def main() -> None:
     for r in asyncio.run(ping.run(n_grains=10_000, concurrency=100,
                                   seconds=3.0, rounds=30)):
         print(json.dumps(r))
+    # traced-ping variant: full-rate sampling — the worst-case tracing
+    # overhead, tracked in BENCH output against the untraced figure above
+    print(json.dumps(asyncio.run(ping.bench_host_tier(
+        n_grains=1000, concurrency=100, seconds=3.0, trace_sample=1.0))))
     print(json.dumps(asyncio.run(mapreduce.run())))
     for r in serialization.run():
         print(json.dumps(r))

@@ -25,6 +25,7 @@ __all__ = [
     "ClusterOptions", "MessagingOptions", "SchedulingOptions",
     "GrainCollectionOptions", "MembershipOptions", "DirectoryOptions",
     "LoadSheddingOptions", "DispatchOptions", "RebalanceOptions",
+    "TracingOptions",
     "flatten", "apply_options", "validate_options", "log_options",
 ]
 
@@ -185,6 +186,25 @@ class RebalanceOptions:
 
 
 @dataclass
+class TracingOptions:
+    """Distributed request tracing (observability.tracing): enable flag,
+    head-based sampling rate (the ROOT of each trace rolls once; 0 keeps
+    the collector installed but records nothing), and the per-silo span
+    ring-buffer capacity."""
+
+    enabled: bool = False
+    sample_rate: float = 1.0
+    buffer_size: int = 4096
+
+    def validate(self) -> None:
+        _positive(self, "buffer_size")
+        if not (0.0 <= self.sample_rate <= 1.0):
+            raise ConfigurationError(
+                f"trace sample_rate must be within [0, 1], got "
+                f"{self.sample_rate!r}")
+
+
+@dataclass
 class DispatchOptions:
     """TPU vector-dispatch tier (no reference analog — the batched engine's
     knobs): per-shard slot-pool capacity and exchange lane capacity."""
@@ -228,6 +248,9 @@ _FLAT_MAP = {
     "rebalance_period": (RebalanceOptions, "period"),
     "rebalance_budget": (RebalanceOptions, "budget"),
     "rebalance_imbalance_ratio": (RebalanceOptions, "imbalance_ratio"),
+    "trace_enabled": (TracingOptions, "enabled"),
+    "trace_sample_rate": (TracingOptions, "sample_rate"),
+    "trace_buffer_size": (TracingOptions, "buffer_size"),
 }
 
 

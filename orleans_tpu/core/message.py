@@ -88,6 +88,7 @@ class Message:
         "expires_at", "call_chain", "is_read_only", "is_always_interleave",
         "is_unordered", "immutable", "cache_invalidation", "request_context",
         "is_new_placement", "transaction_info", "interface_version",
+        "received_at",
     )
 
     category: Category
@@ -120,6 +121,10 @@ class Message:
     # caller's compiled-against interface version (Runtime/Versions/
     # enforcement at addressing, Dispatcher.cs:725-732)
     interface_version: int
+    # local monotonic arrival stamp (queue-wait attribution for tracing;
+    # stamped on delivery only when a tracer is installed, never crosses
+    # the wire — see runtime.wire._HEADER_SLOTS)
+    received_at: float | None
 
     # ------------------------------------------------------------------
     @property
@@ -142,6 +147,7 @@ class Message:
             False, True, None,             # unordered, immutable, cache_inval
             None, False, self.transaction_info,  # ctx, new_placement, txn
             self.interface_version,
+            None,                          # received_at (stamped on arrival)
         )
 
 
@@ -180,6 +186,7 @@ def make_request(
         False, immutable, None,
         request_context, False, None,
         interface_version,
+        None,
     )
 
 
@@ -205,6 +212,7 @@ def make_request_fast(
         False, False, None,
         request_context, False, None,
         interface_version,
+        None,
     )
 
 

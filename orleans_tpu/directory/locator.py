@@ -191,9 +191,28 @@ class DistributedLocator:
             return None  # remote directory hop — async path
         placement_name = getattr(grain_class, "__orleans_placement__",
                                  None) if grain_class else None
-        silo, is_new = self.local_lookup_or_place(
-            grain_id, placement_name, self.silo.silo_address,
-            msg.interface_name, msg.interface_version)
+        # traced directory work: the remote hop records as a client span
+        # of the DirectoryTarget RPC; this locally-owned lookup/placement
+        # would otherwise be invisible to the trace ("directory lookup on
+        # first call" must show up either way)
+        dspan = None
+        tracer = getattr(self.silo, "tracer", None)
+        if tracer is not None:
+            from ..observability.tracing import context_from_headers
+            hdr = context_from_headers(msg.request_context)
+            if hdr is not None:
+                dspan = tracer.open("directory.lookup_or_place",
+                                    "directory", hdr[0], hdr[1])
+        try:
+            silo, is_new = self.local_lookup_or_place(
+                grain_id, placement_name, self.silo.silo_address,
+                msg.interface_name, msg.interface_version)
+        except BaseException:
+            if dspan is not None:
+                tracer.close(dspan, error=True)
+            raise
+        if dspan is not None:
+            tracer.close(dspan, placed=is_new, host=str(silo))
         msg.is_new_placement = is_new
         self._cache_put(grain_id, silo)
         return silo

@@ -190,6 +190,11 @@ class VectorRuntime:
         # integer add on an already-deferring path)
         self.track_load = False
         self.conflicts_deferred = 0
+        # distributed-tracing collector (observability.tracing), set by
+        # dispatch.hosting when the owning silo traces: each batch records
+        # a "device_tick" span AND opens a jax.profiler.TraceAnnotation so
+        # XLA kernels nest under the logical tick on a profiler capture
+        self.tracer = None
         # stateless-worker (mesh-replicated) hosts per class — see
         # dispatch.replicated (StatelessWorkerPlacement.cs:6 on device)
         self._replicated_hosts: dict[type, Any] = {}
@@ -431,14 +436,32 @@ class VectorRuntime:
                     args_stacked[fname][s, i] = p.args[fname]
         if inferred:
             m.args_schema = schema  # needed by the kernel builder
+        tracer = self.tracer
+        tick_span = None
         try:
-            new_state, results = self._kernel(cls, method, B)(
+            kernel = self._kernel(cls, method, B)
+            kernel_args = (
                 tbl.state, jnp.asarray(slots), jnp.asarray(khash),
                 jnp.asarray(fresh), jnp.asarray(valid),
                 {k: jnp.asarray(v) for k, v in args_stacked.items()})
+            if tracer is not None and tracer.sample():
+                tick_span = tracer.open(
+                    f"tick {cls.__name__}.{method}", "device_tick",
+                    tracer.device_trace_id, None)
+                # the TraceAnnotation bridges host tracing to the XLA
+                # timeline: on a jax.profiler capture, this tick's
+                # kernels nest under a span named like the logical tick
+                # span. Gated on the SAMPLED tick so unsampled/untraced
+                # silos pay nothing extra per batch flush.
+                with jax.profiler.TraceAnnotation(tick_span.name):
+                    new_state, results = kernel(*kernel_args)
+            else:
+                new_state, results = kernel(*kernel_args)
         except BaseException:
             if inferred:
                 m.args_schema = None  # do not poison the class schema
+            if tick_span is not None:
+                tracer.close(tick_span, batch=len(ready), error=True)
             raise
         if not m.read_only:
             tbl.state = new_state
@@ -452,6 +475,12 @@ class VectorRuntime:
             tbl.record_hits(slots, valid)
         # resolve futures from the result batch
         host = jax.tree_util.tree_map(np.asarray, results)
+        if tick_span is not None:
+            # close AFTER the host transfer: jax dispatch is async, so
+            # the np.asarray sync above is where device execution is
+            # actually paid — closing at kernel return would record ~0
+            # for exactly the hot ticks tracing exists to attribute
+            tracer.close(tick_span, batch=len(ready))
         for s, ps in enumerate(per_shard):
             for i, p in enumerate(ps):
                 if not p.future.done():

@@ -60,6 +60,8 @@ def add_vector_grains(builder, *grain_classes: type[VectorGrain],
             silo.vector = VectorRuntime(
                 mesh=mesh, capacity_per_shard=capacity_per_shard,
                 options=options)
+        if silo.tracer is not None:
+            silo.vector.tracer = silo.tracer  # device ticks join the traces
         silo.vector.register(*grain_classes)
         for cls in grain_classes:
             silo.vector_interfaces[cls.__name__] = cls

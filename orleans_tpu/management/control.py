@@ -103,6 +103,27 @@ class SiloControl:
         self.silo.locator.invalidate_cache(grain_id)
         return True
 
+    # -- distributed tracing (observability.tracing) ----------------------
+    async def ctl_trace_spans(self, trace_id: int | None = None,
+                              limit: int | None = None) -> list[dict]:
+        """This silo's collected spans (optionally one trace); [] when
+        tracing is disabled. The ManagementGrain merges these
+        cluster-wide for breakdowns and Perfetto export."""
+        tracer = self.silo.tracer
+        return [] if tracer is None else tracer.snapshot(trace_id, limit)
+
+    async def ctl_trace_breakdown(self, trace_id: int | None = None) -> dict:
+        """Critical-path breakdown over THIS silo's spans (per-silo view;
+        the cluster-wide one lives on the ManagementGrain)."""
+        from ..observability.tracing import critical_path_breakdown
+        return critical_path_breakdown(await self.ctl_trace_spans(trace_id))
+
+    async def ctl_histogram(self, name: str) -> dict | None:
+        """One named histogram's summary (with per-bucket counts so the
+        ManagementGrain can merge silos losslessly); None if unknown."""
+        h = self.silo.stats.histograms.get(name)
+        return None if h is None else h.summary()
+
     async def ctl_multicluster_stamp(self) -> float | None:
         """This silo's view of the current multi-cluster configuration
         stamp (None = no config / no oracle) — the ManagementGrain's

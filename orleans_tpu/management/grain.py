@@ -81,6 +81,35 @@ class ManagementGrain(Grain):
                                          selector: str | None = None) -> None:
         await self._fan_out("ctl_set_compatibility_strategy", compat, selector)
 
+    # -- distributed tracing (observability.tracing) ----------------------
+    async def get_trace_spans(self, trace_id: int | None = None,
+                              limit: int | None = None) -> list[dict]:
+        """Cluster-wide span merge: every silo's collector, one list
+        (client-process spans live in the client's own collector — the
+        breakdown tolerates their absence by using the span extent)."""
+        per_silo = await self._fan_out("ctl_trace_spans", trace_id, limit)
+        return [s for spans in per_silo.values() for s in spans]
+
+    async def get_trace_breakdown(self, trace_id: int | None = None) -> dict:
+        """Critical-path breakdown for one trace (or everything buffered):
+        queue / exec / network / directory / device / migration seconds
+        and fractions of the trace extent, cluster-wide."""
+        from ..observability.tracing import critical_path_breakdown
+        return critical_path_breakdown(await self.get_trace_spans(trace_id))
+
+    async def get_cluster_histogram(self, name: str) -> dict | None:
+        """One named latency histogram aggregated across every silo
+        (Histogram.merge over the per-bucket counts each SiloControl
+        reports); None when no silo has observed it."""
+        from ..observability.stats import Histogram
+        per_silo = await self._fan_out("ctl_histogram", name)
+        agg = None
+        for snap in per_silo.values():
+            if snap is not None:
+                h = Histogram.from_snapshot(snap)
+                agg = h if agg is None else agg.merge(h)
+        return None if agg is None else agg.summary()
+
     # -- multi-cluster administration (ManagementGrain.cs:387-427) --------
     async def get_multicluster_configuration(self) -> dict | None:
         """The active admin-injected configuration, or None when the

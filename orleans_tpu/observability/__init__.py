@@ -1,5 +1,13 @@
-"""Observability: statistics, device profiling, management surface
-(reference L13)."""
+"""Observability: statistics, device profiling, distributed tracing,
+management surface (reference L13)."""
 
+from .export import chrome_trace_events, write_chrome_trace  # noqa: F401
 from .profiling import Profiler, StepTimer, annotate, traced  # noqa: F401
 from .stats import REBALANCE_STATS, Histogram, StatsRegistry  # noqa: F401
+from .tracing import (  # noqa: F401
+    TRACE_KEY,
+    Span,
+    SpanCollector,
+    critical_path_breakdown,
+    current_trace,
+)

@@ -20,6 +20,8 @@ device-side lens:
 from __future__ import annotations
 
 import contextlib
+import functools
+import inspect
 import logging
 import time
 from typing import TYPE_CHECKING, Iterator
@@ -43,12 +45,22 @@ def annotate(name: str) -> Iterator[None]:
 
 
 def traced(name: str):
-    """Decorator form of :func:`annotate`."""
+    """Decorator form of :func:`annotate`. Coroutine-aware: wrapping an
+    ``async def`` keeps the annotation open across the whole awaited turn
+    (a naive wrapper would return the coroutine object and close the span
+    before the turn ever ran). Function metadata is preserved."""
     def wrap(fn):
+        if inspect.iscoroutinefunction(fn):
+            @functools.wraps(fn)
+            async def inner(*args, **kwargs):
+                with jax.profiler.TraceAnnotation(name):
+                    return await fn(*args, **kwargs)
+            return inner
+
+        @functools.wraps(fn)
         def inner(*args, **kwargs):
             with jax.profiler.TraceAnnotation(name):
                 return fn(*args, **kwargs)
-        inner.__name__ = getattr(fn, "__name__", name)
         return inner
     return wrap
 

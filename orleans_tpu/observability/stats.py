@@ -68,6 +68,35 @@ class Histogram:
     def mean(self) -> float:
         return self.sum / self.total if self.total else 0.0
 
+    def merge(self, other: "Histogram") -> "Histogram":
+        """Fold another histogram in (same fixed buckets) — the
+        management grain aggregates per-silo histograms cluster-wide
+        with this."""
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        self.total += other.total
+        self.sum += other.sum
+        return self
+
+    def summary(self) -> dict:
+        """The snapshot form (per-bucket counts ride along so summaries
+        merge losslessly via :meth:`from_snapshot`)."""
+        return {"count": self.total, "sum": self.sum, "mean": self.mean,
+                "p50": self.percentile(0.5), "p95": self.percentile(0.95),
+                "p99": self.percentile(0.99), "buckets": list(self.counts)}
+
+    @classmethod
+    def from_snapshot(cls, d: dict) -> "Histogram":
+        """Rebuild from a :meth:`summary` dict (cross-silo aggregation:
+        snapshots travel the wire, histogram objects do not)."""
+        h = cls()
+        counts = d.get("buckets")
+        if counts and len(counts) == len(h.counts):
+            h.counts = [int(c) for c in counts]
+        h.total = int(d.get("count", sum(h.counts)))
+        h.sum = float(d.get("sum", 0.0))
+        return h
+
 
 class StatsRegistry:
     """Named counters/gauges/histograms (CounterStatistic registry)."""
@@ -110,10 +139,7 @@ class StatsRegistry:
         return {
             "counters": dict(self.counters),
             "gauges": {k: fn() for k, fn in self.gauges.items()},
-            "histograms": {
-                k: {"count": h.total, "mean": h.mean,
-                    "p50": h.percentile(0.5), "p99": h.percentile(0.99)}
-                for k, h in self.histograms.items()
-            },
+            "histograms": {k: h.summary()
+                           for k, h in self.histograms.items()},
             "ts": time.time(),
         }

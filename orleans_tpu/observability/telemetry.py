@@ -123,6 +123,21 @@ class Watchdog:
         self.last_lag = 0.0
         self.max_lag = 0.0
         self._task: asyncio.Task | None = None
+        # live loop-health gauges: the histogram alone cannot answer "is
+        # the loop stalled RIGHT NOW", so the management surface reads
+        # these from the registry snapshot. max_lag is max-since-last-
+        # snapshot: reading it resets the window, so each telemetry flush
+        # reports the worst stall of its own period. Destructive read by
+        # design — concurrent snapshot readers (a management poll racing
+        # the telemetry flush) share one window, and whichever reads
+        # first gets the stall; the loop_lag histogram keeps the full
+        # record either way.
+        silo.stats.register_gauge("watchdog.last_lag", lambda: self.last_lag)
+        silo.stats.register_gauge("watchdog.max_lag", self._drain_max_lag)
+
+    def _drain_max_lag(self) -> float:
+        v, self.max_lag = self.max_lag, 0.0
+        return v
 
     def start(self) -> None:
         self._task = asyncio.get_running_loop().create_task(self._loop())

@@ -1,0 +1,263 @@
+"""End-to-end distributed request tracing (L13).
+
+The reference's tracing story is ActivityId correlation riding message
+headers plus hot-path counters (SURVEY §5 "Tracing / profiling" —
+RequestContext carries the ActivityId; Message.DebugContext stamps hops).
+This module grows that into a W3C-style trace/span model:
+
+* a **trace context** ``(trace_id, parent_span_id, sent_at)`` rides the
+  existing ``RequestContext`` message headers under :data:`TRACE_KEY`, so
+  one logical request keeps one ``trace_id`` across silo hops, forwarded
+  (post-migration) hops, directory RPCs, and device-tier ticks;
+* spans are opened automatically at the call sites the runtime owns —
+  client invoke (``runtime_client``), server turn with queue-wait vs.
+  execution split (``runtime/dispatcher``), the network leg (stamped
+  send-side, measured receive-side), directory lookups
+  (``directory/locator``), device ticks (``dispatch/engine``, bridged to
+  ``jax.profiler.TraceAnnotation`` so XLA kernels nest under the logical
+  span), and rebalance migration legs (``rebalance/executor``);
+* a per-silo :class:`SpanCollector` ring buffer holds finished spans with
+  a head-based sampling knob (``config.TracingOptions`` /
+  ``trace_sample_rate``): the ROOT of a trace rolls the sampling die once
+  and unsampled requests carry no header and record nothing downstream —
+  at ``sample_rate=0`` the hot path pays one attribute check per call
+  (guarded by ``tests/test_perf_floors.py::test_floor_trace_overhead``).
+
+Consumers: the management surface (``SiloControl.ctl_trace_spans`` +
+``ManagementGrain.get_trace_breakdown``) for cluster-wide critical-path
+queries, and :mod:`orleans_tpu.observability.export` for Chrome-trace/
+Perfetto timeline files merging every silo of a cluster.
+
+Span ``start`` times are wall-clock (``time.time()``) so spans from
+different silos/processes merge onto one timeline; durations are measured
+with the monotonic clock.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import random
+import time
+from collections import deque
+
+__all__ = [
+    "TRACE_KEY", "Span", "SpanCollector", "current_trace",
+    "new_trace_id", "new_span_id", "critical_path_breakdown",
+]
+
+# RequestContext/message-header key the trace context rides under (the
+# ActivityId header analog): (trace_id, parent_span_id, sent_at_wall).
+# Present if and only if the trace is sampled — head-based sampling.
+TRACE_KEY = "orleans.trace"
+
+# The span context ambient to the running turn/callsite: (trace_id,
+# span_id) of the span any nested outgoing call should parent under.
+# None outside sampled traces (the common case — one ContextVar.get on
+# the send path is the whole cost of disabled tracing there).
+current_trace: contextvars.ContextVar[tuple[int, int] | None] = (
+    contextvars.ContextVar("orleans_current_trace", default=None)
+)
+
+# span kinds a collector records; critical_path_breakdown buckets by these
+SPAN_KINDS = ("client", "server", "network", "directory", "device",
+              "device_tick", "migration")
+
+
+def new_trace_id() -> int:
+    """63-bit random id (unique across silos without coordination)."""
+    return random.getrandbits(63) or 1
+
+
+def new_span_id() -> int:
+    return random.getrandbits(63) or 1
+
+
+class Span:
+    """One timed operation. ``start`` is wall-clock seconds; ``duration``
+    is a monotonic-clock delta (set by :meth:`SpanCollector.close`)."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "name", "kind",
+                 "silo", "start", "duration", "attrs", "_t0")
+
+    def __init__(self, trace_id: int, span_id: int, parent_id: int | None,
+                 name: str, kind: str, silo: str, start: float,
+                 duration: float = 0.0, attrs: dict | None = None):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.kind = kind
+        self.silo = silo
+        self.start = start
+        self.duration = duration
+        self.attrs = attrs
+        self._t0 = 0.0
+
+    def to_dict(self) -> dict:
+        """Wire/JSON form (what ``ctl_trace_spans`` and the exporter see)."""
+        return {
+            "trace_id": self.trace_id, "span_id": self.span_id,
+            "parent_id": self.parent_id, "name": self.name,
+            "kind": self.kind, "silo": self.silo, "start": self.start,
+            "duration": self.duration, "attrs": self.attrs or {},
+        }
+
+    def __repr__(self) -> str:  # pragma: no cover — debugging aid
+        return (f"<Span {self.kind} {self.name!r} {self.duration * 1e3:.3f}ms"
+                f" trace={self.trace_id:x}>")
+
+
+class SpanCollector:
+    """Per-silo (or per-client) span sink: bounded ring buffer + the
+    head-based sampling decision. Cheap enough for the hot path — an
+    unsampled call never reaches it, and a sampled span costs two clock
+    reads, one random id, and a deque append."""
+
+    def __init__(self, name: str, sample_rate: float = 1.0,
+                 buffer_size: int = 4096):
+        self.name = name
+        self.sample_rate = sample_rate
+        self.spans: deque[Span] = deque(maxlen=buffer_size)
+        # synthetic trace grouping device ticks not tied to one request
+        self.device_trace_id = new_trace_id()
+
+    # -- sampling (root decision; propagated via header presence) --------
+    def sample(self) -> bool:
+        r = self.sample_rate
+        if r >= 1.0:
+            return True
+        if r <= 0.0:
+            return False
+        return random.random() < r
+
+    def new_trace_id(self) -> int:
+        return new_trace_id()
+
+    # -- span lifecycle ---------------------------------------------------
+    def open(self, name: str, kind: str, trace_id: int,
+             parent_id: int | None) -> Span:
+        span = Span(trace_id, new_span_id(), parent_id, name, kind,
+                    self.name, time.time())
+        span._t0 = time.monotonic()
+        return span
+
+    def close(self, span: Span, duration: float | None = None,
+              **attrs) -> Span:
+        span.duration = (time.monotonic() - span._t0
+                         if duration is None else duration)
+        if attrs:
+            span.attrs = attrs
+        self.spans.append(span)
+        return span
+
+    def record(self, trace_id: int, parent_id: int | None, name: str,
+               kind: str, start: float, duration: float, **attrs) -> Span:
+        """Record a span whose timing was measured externally (e.g. the
+        network leg: stamped send-side, observed receive-side)."""
+        span = Span(trace_id, new_span_id(), parent_id, name, kind,
+                    self.name, start, max(0.0, duration), attrs or None)
+        self.spans.append(span)
+        return span
+
+    # -- reads -------------------------------------------------------------
+    def snapshot(self, trace_id: int | None = None,
+                 limit: int | None = None) -> list[dict]:
+        spans = list(self.spans)
+        if trace_id is not None:
+            spans = [s for s in spans if s.trace_id == trace_id]
+        if limit is not None and len(spans) > limit:
+            spans = spans[-limit:]
+        return [s.to_dict() for s in spans]
+
+    def trace_ids(self) -> list[int]:
+        seen: dict[int, None] = {}
+        for s in self.spans:
+            seen.setdefault(s.trace_id, None)
+        return list(seen)
+
+    def clear(self) -> None:
+        self.spans.clear()
+
+
+def context_from_headers(request_context: dict | None
+                         ) -> tuple[int, int, float] | None:
+    """Extract ``(trace_id, parent_span_id, sent_at)`` from message
+    baggage; None when the request is untraced/unsampled OR the header is
+    malformed. RequestContext is app-writable, so every runtime consumer
+    parses through this single hardened path — garbage baggage must never
+    break a turn or drop a message, it just goes untraced."""
+    if not request_context:
+        return None
+    hdr = request_context.get(TRACE_KEY)
+    if hdr is None:
+        return None
+    try:
+        # tolerate list-decoded tuples from portable codecs
+        t, p, s = hdr
+        return (int(t), int(p), float(s))
+    except (TypeError, ValueError):
+        return None
+
+
+def restamp_header(request_context: dict | None) -> dict | None:
+    """Refresh the header's ``sent_at`` for a message leaving AGAIN
+    (transparent resend, forward hop): without this the receiver's
+    network span would absorb retry backoff and the previous silo's
+    handling time — mis-attributing exactly the slow requests tracing
+    exists to explain. Returns a new dict (headers may be shared)."""
+    ctx = context_from_headers(request_context)
+    if ctx is None:
+        return request_context
+    out = dict(request_context)
+    out[TRACE_KEY] = (ctx[0], ctx[1], time.time())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Critical-path breakdown
+# ---------------------------------------------------------------------------
+
+_BREAKDOWN_KEYS = ("queue", "exec", "network", "directory", "device",
+                   "migration")
+
+
+def critical_path_breakdown(spans) -> dict:
+    """Where a trace's wall time went, as seconds and fractions of the
+    trace extent: queue wait vs. turn execution (from server-span attrs),
+    network legs, directory lookups, device ticks, and migration legs.
+
+    ``spans``: Span objects or ``to_dict`` forms, typically one trace
+    (pre-filter by trace_id) but tolerant of mixed input — the management
+    grain feeds it the cluster-wide merge. Fractions can overlap (a
+    directory RPC's network leg counts in both) and need not sum to 1;
+    each answers "how much of the trace extent did this layer occupy".
+    """
+    dicts = [s if isinstance(s, dict) else s.to_dict() for s in spans]
+    if not dicts:
+        return {"total_s": 0.0, "span_count": 0,
+                "seconds": {k: 0.0 for k in _BREAKDOWN_KEYS},
+                "fractions": {k: 0.0 for k in _BREAKDOWN_KEYS}}
+    t0 = min(s["start"] for s in dicts)
+    t1 = max(s["start"] + s["duration"] for s in dicts)
+    total = max(t1 - t0, 1e-9)
+    seconds = {k: 0.0 for k in _BREAKDOWN_KEYS}
+    for s in dicts:
+        kind = s["kind"]
+        if kind == "server":
+            attrs = s.get("attrs") or {}
+            seconds["queue"] += attrs.get("queue_s", 0.0)
+            seconds["exec"] += attrs.get("exec_s", s["duration"])
+        elif kind == "network":
+            seconds["network"] += s["duration"]
+        elif kind == "directory":
+            seconds["directory"] += s["duration"]
+        elif kind in ("device", "device_tick"):
+            seconds["device"] += s["duration"]
+        elif kind == "migration":
+            seconds["migration"] += s["duration"]
+    return {
+        "total_s": total,
+        "span_count": len(dicts),
+        "seconds": seconds,
+        "fractions": {k: min(1.0, v / total) for k, v in seconds.items()},
+    }

@@ -58,7 +58,22 @@ class MigrationExecutor:
         """Live-migrate one local activation to silo ``dest``. Returns
         True on commit; False leaves the activation serving locally (or,
         if a racing re-creation won the directory while we were fenced,
-        completes the deactivation instead)."""
+        completes the deactivation instead). Each leg records a
+        "migration" span when the silo traces, so rebalance cost shows on
+        the same timeline as the request latency it perturbs."""
+        tracer = self.silo.tracer
+        if tracer is None or not tracer.sample():
+            return await self._migrate_activation(act, dest)
+        span = tracer.open(f"migrate {act.grain_id}", "migration",
+                           tracer.new_trace_id(), None)
+        committed = False
+        try:
+            committed = await self._migrate_activation(act, dest)
+            return committed
+        finally:
+            tracer.close(span, dest=str(dest), committed=committed)
+
+    async def _migrate_activation(self, act, dest) -> bool:
         silo = self.silo
         if act.state != ActivationState.VALID or \
                 act.grain_id.is_system_target() or act.is_stateless_worker:
@@ -174,11 +189,21 @@ class MigrationExecutor:
         keep = [i for i, k in enumerate(moves.keys) if int(k) not in fenced]
         if not keep:
             return 0
+        tracer = self.silo.tracer
+        span = None
+        if tracer is not None and tracer.sample():
+            span = tracer.open(f"shard_moves {moves.cls.__name__}",
+                               "migration", tracer.new_trace_id(), None)
         try:
-            return tbl.move_rows(moves.keys[keep], moves.dest_shards[keep])
+            n = tbl.move_rows(moves.keys[keep], moves.dest_shards[keep])
+            if span is not None:
+                tracer.close(span, rows=n)
+            return n
         except Exception:  # noqa: BLE001 — move_rows only commits its
             # bookkeeping after the device copy succeeds, so a failure
             # here left the table untouched; count and carry on
             log.exception("shard move failed for %s", moves.cls.__name__)
             self.silo.stats.increment(REBALANCE_STATS["rolled_back"])
+            if span is not None:
+                tracer.close(span, rows=0, error=True)
             return 0

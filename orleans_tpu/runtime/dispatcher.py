@@ -33,6 +33,11 @@ from ..core.message import (
     make_rejection,
     make_response,
 )
+from ..observability.tracing import (
+    context_from_headers,
+    current_trace,
+    restamp_header,
+)
 from .cancellation import CANCEL_METHOD, maybe_intern_tokens
 from .context import TXN_KEY
 from ..core.serialization import copy_result
@@ -103,6 +108,10 @@ class Dispatcher:
         if msg.direction == Direction.RESPONSE:
             self.silo.runtime_client.receive_response(msg)
             return
+        if self.silo.tracer is not None and msg.received_at is None:
+            # arrival stamp for queue-wait attribution (covers the
+            # loopback path; fabric arrivals are stamped at deliver)
+            msg.received_at = time.monotonic()
         vcls = self.silo.vector_interfaces.get(msg.interface_name)
         if vcls is not None:
             # device-tier interface: the north-star interception — instead
@@ -280,6 +289,17 @@ class Dispatcher:
             if msg.direction != Direction.ONE_WAY:
                 self.send_response(msg, make_error_response(msg, e))
             return
+        tracer = self.silo.tracer
+        if tracer is not None:
+            hdr = context_from_headers(msg.request_context)
+            if hdr is not None:
+                # device span: enqueue → tick-resolved future (the host
+                # view of the batched kernel turn; the engine's own tick
+                # spans + TraceAnnotation carry the per-tick detail)
+                vspan = tracer.open(
+                    f"{msg.interface_name}.{msg.method_name}", "device",
+                    hdr[0], hdr[1])
+                fut.add_done_callback(lambda f, s=vspan: tracer.close(s))
         if msg.direction == Direction.ONE_WAY:
             return
 
@@ -374,6 +394,28 @@ class Dispatcher:
         token_a = current_activation.set(activation)
         RequestContext.import_(msg.request_context)
         t0 = time.monotonic()
+        # server span: header presence == sampled (head-based sampling at
+        # the root). Covers queue wait (arrival stamp → turn start) plus
+        # execution, recorded separately; the network leg is derived from
+        # the sender's wall-clock stamp. Nested sends from inside the turn
+        # parent under this span via the current_trace contextvar.
+        tracer = self.silo.tracer
+        tspan = ttoken = None
+        t_queue = 0.0
+        if tracer is not None:
+            hdr = context_from_headers(msg.request_context)
+            if hdr is not None:
+                trace_id, parent_id, sent_at = hdr
+                if msg.received_at is not None:
+                    t_queue = max(0.0, t0 - msg.received_at)
+                recv_wall = time.time() - (time.monotonic() - t0) - t_queue
+                tracer.record(trace_id, parent_id, "network", "network",
+                              sent_at, recv_wall - sent_at)
+                tspan = tracer.open(
+                    f"{msg.interface_name}.{msg.method_name}", "server",
+                    trace_id, parent_id)
+                tspan.start = recv_wall
+                ttoken = current_trace.set((trace_id, tspan.span_id))
         try:
             result = await self.invoke(activation, msg)
             if msg.direction == Direction.REQUEST:
@@ -410,6 +452,10 @@ class Dispatcher:
                             activation.grain_id)
             elif not n & 7:
                 self.silo.stats.observe("scheduler.turn_length", elapsed)
+            if tspan is not None:
+                current_trace.reset(ttoken)
+                tracer.close(tspan, duration=t_queue + elapsed,
+                             queue_s=t_queue, exec_s=elapsed)
             RequestContext.clear()
             current_activation.reset(token_a)
             activation.reset_running(msg)
@@ -576,6 +622,14 @@ class Dispatcher:
     async def _address_and_send(self, msg: Message,
                                 grain_class: type | None) -> None:
         """AddressMessage:715 — placement director + directory lookup."""
+        token = None
+        if self.silo.tracer is not None:
+            hdr = context_from_headers(msg.request_context)
+            if hdr is not None:
+                # gateway-addressed ingress has no ambient trace context;
+                # adopt the message's so the directory RPC below records
+                # as a child "directory" span of the caller's client span
+                token = current_trace.set((hdr[0], hdr[1]))
         try:
             target = await self.silo.locator.locate(msg, grain_class)
             msg.target_silo = target
@@ -588,6 +642,9 @@ class Dispatcher:
                 resp = make_error_response(msg, e)
                 resp.target_silo = msg.sending_silo
                 self.transmit(resp)
+        finally:
+            if token is not None:
+                current_trace.reset(token)
 
     def transmit(self, msg: Message) -> None:
         """Hand to the message center: loopback locally, network otherwise."""
@@ -621,6 +678,12 @@ class Dispatcher:
             msg.forward_count += 1
             msg.target_silo = None
             msg.target_activation = None
+            if self.silo.tracer is not None:
+                # the message leaves again: reset the arrival stamp and
+                # refresh the header's sent_at so the NEXT silo's queue/
+                # network spans measure only their own leg, not ours
+                msg.received_at = None
+                msg.request_context = restamp_header(msg.request_context)
             self.silo.locator.invalidate_cache(msg.target_grain)
             # invalidation-on-forward, outward half: the SENDER's stale
             # cache routed this message here (e.g. the grain live-migrated

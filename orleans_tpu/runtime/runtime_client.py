@@ -28,6 +28,7 @@ from ..core.message import (
     make_request_fast,
 )
 from ..core.serialization import copy_call_body, deep_copy
+from ..observability.tracing import TRACE_KEY, current_trace
 from .cancellation import register_outgoing_tokens
 from .context import TXN_KEY, RequestContext, current_activation
 
@@ -37,6 +38,18 @@ if TYPE_CHECKING:
 log = logging.getLogger("orleans.rpc")
 
 MAX_RESEND_COUNT = 3  # SiloMessagingOptions.MaxResendCount analog
+
+
+async def _finish_span_after(tracer, span, res):
+    """Close the client span when the RPC settles (success or error) —
+    the span covers the full round trip including transparent resends."""
+    try:
+        result = await res
+    except BaseException as e:
+        tracer.close(span, error=type(e).__name__)
+        raise
+    tracer.close(span)
+    return result
 
 
 def _resolve_future(fut: asyncio.Future, value, exc) -> None:
@@ -77,6 +90,18 @@ class RuntimeClient:
         # via ClusterClient.add_outgoing_call_filter)
         self.outgoing_call_filters: list = []
         self._filter_tasks: set[asyncio.Task] = set()
+        # distributed-tracing collector (observability.tracing): None on
+        # the hot path unless tracing is enabled — silo-side wired from
+        # SiloConfig.trace_*, client-side via enable_tracing()
+        self.tracer = None
+
+    def enable_tracing(self, sample_rate: float = 1.0,
+                       buffer_size: int = 4096, name: str = "client"):
+        """Install a SpanCollector so calls through this client open
+        root client spans (head-based sampling at ``sample_rate``)."""
+        from ..observability.tracing import SpanCollector
+        self.tracer = SpanCollector(name, sample_rate, buffer_size)
+        return self.tracer
 
     def try_direct_interleave(self, grain_id, method_name: str,
                               args: tuple, kwargs: dict):
@@ -203,6 +228,32 @@ class RuntimeClient:
         # _targetGrainReferences bookkeeping)
         register_outgoing_tokens(self, target_grain, grain_class,
                                  args, kwargs)
+        # client span (the ActivityId-correlation upgrade): the ROOT of a
+        # trace rolls head-based sampling here; unsampled calls carry no
+        # header and pay only this None/ContextVar check. SYSTEM traffic
+        # never roots a trace (membership probes would spam the buffer)
+        # but joins an ambient sampled one — so a traced app call's
+        # directory RPC shows up as a child "directory" span.
+        req_ctx = RequestContext.export()
+        span = None
+        tracer = self.tracer
+        if tracer is not None:
+            tctx = current_trace.get()
+            if tctx is not None:
+                trace_id, parent_id = tctx
+            elif (category is None or category == Category.APPLICATION) \
+                    and tracer.sample():
+                trace_id, parent_id = tracer.new_trace_id(), None
+            else:
+                trace_id = None
+            if trace_id is not None:
+                span = tracer.open(
+                    f"{interface_name}.{method_name}",
+                    "directory" if interface_name == "DirectoryTarget"
+                    else "client",
+                    trace_id, parent_id)
+                req_ctx = dict(req_ctx) if req_ctx else {}
+                req_ctx[TRACE_KEY] = (trace_id, span.span_id, span.start)
         # Copy-isolate arguments at send time (SerializationManager.DeepCopy
         # for in-silo calls): caller mutations after the call cannot leak into
         # the callee. Immutable-wrapped args pass by reference.
@@ -219,10 +270,26 @@ class RuntimeClient:
             else copy_call_body(args, kwargs),
             (time.monotonic() + timeout) if timeout is not None else None,
             call_chain, is_read_only, is_always_interleave,
-            RequestContext.export(),
+            req_ctx,
             getattr(grain_class, "__orleans_version__", 0),
         )
-        return self._send(msg, is_one_way, timeout)
+        if span is None:
+            return self._send(msg, is_one_way, timeout)
+        # addressing work triggered inside transmit (directory lookups,
+        # placement) runs in tasks that copy the context NOW — parent them
+        # under this call's span, then restore the caller's ambient trace
+        token = current_trace.set((span.trace_id, span.span_id))
+        try:
+            res = self._send(msg, is_one_way, timeout)
+        except BaseException as e:
+            tracer.close(span, error=type(e).__name__)
+            raise
+        finally:
+            current_trace.reset(token)
+        if res is None:  # one-way: the span covers the local send only
+            tracer.close(span, one_way=True)
+            return None
+        return _finish_span_after(tracer, span, res)
 
     def _send(self, msg: Message, is_one_way: bool, timeout: float | None):
         if is_one_way:
@@ -315,6 +382,17 @@ class RuntimeClient:
 
                 def _resend(mid=msg.id, m=cb.message):
                     if mid in self.callbacks:
+                        if self.tracer is not None:
+                            # the retry is a fresh hop: clear the arrival
+                            # stamp and refresh the header's sent_at NOW
+                            # (post-backoff) so the receiver's queue/
+                            # network spans exclude the backoff — the
+                            # client span still covers the whole call
+                            from ..observability.tracing import \
+                                restamp_header
+                            m.received_at = None
+                            m.request_context = restamp_header(
+                                m.request_context)
                         self.transmit(m)
 
                 asyncio.get_running_loop().call_later(delay, _resend)

@@ -18,6 +18,7 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
+import time
 from dataclasses import dataclass
 from typing import TYPE_CHECKING, Any, Callable
 
@@ -119,6 +120,15 @@ class SiloConfig:
     directory_cache_max_ttl: float = 120.0
     directory_cache_refresh_period: float = 2.0
     turn_warning_length: float = 0.2  # TurnWarningLengthThreshold
+    # distributed request tracing (observability.tracing /
+    # config.TracingOptions): when enabled, a SpanCollector on the silo
+    # records client/server/network/directory/device/migration spans for
+    # requests head-sampled at trace_sample_rate, into a ring buffer of
+    # trace_buffer_size spans (management surface + Perfetto export read
+    # it). Disabled: zero collector, one None-check per hot-path site.
+    trace_enabled: bool = False
+    trace_sample_rate: float = 1.0
+    trace_buffer_size: int = 4096
     # live rebalancer (orleans_tpu.rebalance): plan/execute period in
     # seconds (0 disables the loop even when the service is installed),
     # per-round migration budget, and the hot/mean load ratio below which
@@ -188,6 +198,10 @@ class MessageCenter:
         """Called by the fabric when a message arrives for this silo."""
         if not self.running:
             return
+        if self.silo.tracer is not None and msg.received_at is None:
+            # arrival stamp: queue-wait attribution measures from HERE
+            # (inbound queue + mailbox) to turn start
+            msg.received_at = time.monotonic()
         cfg = self.silo.config
         if (cfg.load_shedding_enabled
                 and msg.category == Category.APPLICATION
@@ -400,11 +414,20 @@ class Silo:
         self.storage_manager = storage
         self.silo_address = fabric.allocate_address(config.name)
         self.stats = StatsRegistry()
+        # distributed tracing (observability.tracing): None unless enabled
+        # — every hot-path site guards on that None
+        self.tracer = None
+        if config.trace_enabled:
+            from ..observability.tracing import SpanCollector
+            self.tracer = SpanCollector(config.name,
+                                        config.trace_sample_rate,
+                                        config.trace_buffer_size)
         # grain cancellation twins (CancellationSourcesExtension)
         self.cancellation_tokens = TokenInterner(self)
 
         # ctor wiring order mirrors Silo.cs:124-260
         self.runtime_client = InsideRuntimeClient(self)
+        self.runtime_client.tracer = self.tracer
         self.message_center = MessageCenter(self)
         self.dispatcher = Dispatcher(self)
         self.catalog = Catalog(self)

@@ -113,9 +113,11 @@ async def frame_stream(reader: asyncio.StreamReader, chunk_size: int = 1 << 16):
 # ---------------------------------------------------------------------------
 
 # Every Message slot except the lazily-decoded body (the headers/body split
-# of Message.HeadersContainer, Message.cs:725) and expires_at (rebased).
+# of Message.HeadersContainer, Message.cs:725), expires_at (rebased), and
+# received_at (a local monotonic arrival stamp, meaningless cross-process —
+# the receiver re-stamps on delivery).
 _HEADER_SLOTS = tuple(s for s in Message.__slots__
-                      if s not in ("body", "expires_at"))
+                      if s not in ("body", "expires_at", "received_at"))
 
 # Enum-typed header fields ride the wire as plain ints (the native codec's
 # scalar fast path; pickling an IntEnum writes a by-reference class lookup).
@@ -195,6 +197,7 @@ def decode_message(headers: bytes, body: bytes) -> Message:
     except Exception as e:  # noqa: BLE001 — headers must decode or the msg is lost
         raise WireDecodeError(f"undecodable message headers: {e}") from e
     msg.expires_at = None if ttl is None else time.monotonic() + ttl
+    msg.received_at = None  # local arrival stamp; tracing re-stamps
     try:
         msg.body = deserialize(body)
     except Exception as e:  # noqa: BLE001 — body failure is per-message

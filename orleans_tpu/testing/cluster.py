@@ -117,6 +117,17 @@ class TestClusterBuilder:
             lambda b: add_transactions(b, **kw))
         return self
 
+    def with_tracing(self, sample_rate: float = 1.0,
+                     buffer_size: int = 4096) -> "TestClusterBuilder":
+        """Distributed request tracing on every silo AND the test client
+        (the client is the root of most test traces); spans merge via
+        ``TestCluster.trace_spans`` / ``export_trace``."""
+        self.config.update(trace_enabled=True,
+                           trace_sample_rate=sample_rate,
+                           trace_buffer_size=buffer_size)
+        self._client_tracing = (sample_rate, buffer_size)
+        return self
+
     def with_rebalancer(self, period: float = 0.2, budget: int | None = None,
                         imbalance_ratio: float | None = None
                         ) -> "TestClusterBuilder":
@@ -167,6 +178,9 @@ class TestCluster:
         for _ in range(self.builder.n_silos):
             await self.start_additional_silo()
         self.client = await ClusterClient(self.fabric).connect()
+        tracing = getattr(self.builder, "_client_tracing", None)
+        if tracing is not None:
+            self.client.enable_tracing(*tracing)
         if self.builder.with_membership:
             await self.wait_for_liveness()
         return self
@@ -229,6 +243,32 @@ class TestCluster:
     # -- access ------------------------------------------------------------
     def grain(self, grain_class: type, key, key_ext: str | None = None):
         return self.client.get_grain(grain_class, key, key_ext)
+
+    # -- tracing ------------------------------------------------------------
+    def trace_spans(self, trace_id: int | None = None) -> list[dict]:
+        """Every span collected anywhere in the cluster (all silos + the
+        test client), optionally filtered to one trace."""
+        spans: list[dict] = []
+        for s in self.silos:
+            if getattr(s, "tracer", None) is not None:
+                spans.extend(s.tracer.snapshot(trace_id))
+        client_tracer = getattr(self.client, "tracer", None)
+        if client_tracer is not None:
+            spans.extend(client_tracer.snapshot(trace_id))
+        return spans
+
+    def clear_traces(self) -> None:
+        for s in self.silos:
+            if getattr(s, "tracer", None) is not None:
+                s.tracer.clear()
+        if getattr(self.client, "tracer", None) is not None:
+            self.client.tracer.clear()
+
+    def export_trace(self, path: str, trace_id: int | None = None) -> str:
+        """Merge spans from every silo + the client into one Chrome-trace/
+        Perfetto JSON timeline file; returns ``path``."""
+        from ..observability.export import write_chrome_trace
+        return write_chrome_trace(path, self.trace_spans(trace_id))
 
     @property
     def alive_silos(self) -> list:

@@ -125,14 +125,93 @@ async def test_telemetry_snapshots_and_watchdog_lag_detection():
         name, snap = consumer.snapshots[-1]
         assert snap["counters"].get("messaging.received.application", 0) > 0
 
-        # a blocking turn must trip both long-turn and watchdog-lag signals
+        # a blocking turn must trip both long-turn and watchdog-lag signals;
+        # loop health is now surfaced as LIVE gauges in the registry
+        # (max_lag is max-since-last-snapshot: reading resets the window,
+        # so assert on the flushed snapshots rather than the attribute)
         await client.get_grain(WorkGrain, 99).slow()
-        await asyncio.sleep(0.2)
+        await asyncio.sleep(0.3)
         silo = silos[0]
         assert silo.stats.get("scheduler.long_turns") >= 1
-        assert silo.watchdog.max_lag > 0.1
+        assert "watchdog.last_lag" in silo.stats.gauges
+        lag_seen = max(s["gauges"].get("watchdog.max_lag", 0.0)
+                       for _, s in consumer.snapshots)
+        assert lag_seen > 0.1, "watchdog lag never surfaced in a snapshot"
     finally:
         await stop_all(silos, client)
+
+
+async def test_watchdog_max_lag_resets_on_snapshot():
+    consumer = CapturingConsumer()
+    fabric, silos, client = await start_cluster(n=1, consumer=consumer)
+    try:
+        silo = silos[0]
+        silo.watchdog.max_lag = 0.7  # as if a stall was observed
+        snap = silo.stats.snapshot()
+        assert snap["gauges"]["watchdog.max_lag"] == 0.7
+        # the read drained the window: the next snapshot starts fresh
+        assert silo.stats.snapshot()["gauges"]["watchdog.max_lag"] == 0.0
+    finally:
+        await stop_all(silos, client)
+
+
+# ----------------------------------------------------------------------
+# Telemetry fan-out robustness + file sink round-trip
+# ----------------------------------------------------------------------
+class ExplodingConsumer(TelemetryConsumer):
+    def __init__(self):
+        self.attempts = 0
+
+    def record_snapshot(self, silo_name, snapshot):
+        self.attempts += 1
+        raise RuntimeError("sink down")
+
+    def track_event(self, name, properties):
+        raise RuntimeError("sink down")
+
+
+async def test_raising_consumer_does_not_starve_others_or_kill_loop():
+    """One consumer failing on every snapshot/event must neither stop the
+    TelemetryManager loop nor prevent later consumers from receiving."""
+    from orleans_tpu.runtime import InProcFabric, SiloBuilder
+    from orleans_tpu.storage import MemoryStorage
+    bad, good = ExplodingConsumer(), CapturingConsumer()
+    fabric = InProcFabric()
+    b = (SiloBuilder().with_name("tm0").with_fabric(fabric)
+         .add_grains(WorkGrain).with_storage("Default", MemoryStorage()))
+    add_telemetry(b, bad, good, period=0.05, watchdog_period=10.0)
+    silo = b.build()
+    await silo.start()
+    try:
+        await asyncio.sleep(0.25)
+        assert bad.attempts >= 2, "manager loop died after the first raise"
+        assert len(good.snapshots) >= 2, "good consumer starved by bad one"
+        silo.telemetry.track_event("deploy", version=3)
+        assert ("deploy", {"version": 3}) in good.events
+        assert not silo.telemetry._task.done(), "telemetry loop died"
+    finally:
+        await silo.stop()
+
+
+async def test_file_telemetry_consumer_jsonl_roundtrip(tmp_path):
+    import json
+    path = str(tmp_path / "telemetry.jsonl")
+    c = FileTelemetryConsumer(path)
+    from orleans_tpu.observability.stats import StatsRegistry
+    stats = StatsRegistry()
+    stats.increment("calls", 3)
+    stats.observe("lat", 0.002)
+    c.record_snapshot("silo-x", stats.snapshot())
+    c.track_event("rebalance", {"moved": 4})
+    c.close()
+    lines = [json.loads(line) for line in open(path)]
+    assert len(lines) == 2
+    snap, event = lines
+    assert snap["silo"] == "silo-x"
+    assert snap["counters"]["calls"] == 3
+    h = snap["histograms"]["lat"]
+    assert h["count"] == 1 and "p95" in h and sum(h["buckets"]) == 1
+    assert event == {"event": "rebalance", "moved": 4}
 
 
 async def test_load_publisher_feeds_placement_view():

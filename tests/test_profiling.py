@@ -32,6 +32,33 @@ def test_double_start_rejected(tmp_path):
     assert p.stop() is None  # idempotent
 
 
+async def test_traced_is_coroutine_aware_and_preserves_metadata():
+    """@traced on an async handler must await inside the annotation (the
+    old wrapper returned the coroutine with the span already closed) and
+    keep the function's metadata via functools.wraps."""
+    import asyncio
+    import inspect
+
+    @traced("async-work")
+    async def handler(x):
+        """docstring survives"""
+        await asyncio.sleep(0)
+        return x * 2
+
+    assert inspect.iscoroutinefunction(handler)
+    assert handler.__name__ == "handler"
+    assert handler.__doc__ == "docstring survives"
+    assert await handler(3) == 6
+
+    @traced("sync-work")
+    def sync_handler(x):
+        return x + 1
+
+    assert sync_handler.__name__ == "sync_handler"
+    assert sync_handler.__wrapped__(1) == 2  # functools.wraps marker
+    assert sync_handler(1) == 2
+
+
 def test_traced_decorator_and_step_timer():
     stats = StatsRegistry()
     timer = StepTimer(stats, "tick", warn_threshold=0.0)  # always slow

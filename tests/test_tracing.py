@@ -1,0 +1,340 @@
+"""End-to-end distributed request tracing (observability.tracing/export):
+trace propagation across a 2-silo TestCluster, queue-wait vs. execution
+split, critical-path breakdown via the management grain, forwarded
+(post-migration) hops keeping one trace_id, sampling semantics, and
+Chrome-trace/Perfetto export."""
+
+import asyncio
+import json
+
+from orleans_tpu.management import ManagementGrain
+from orleans_tpu.observability.stats import Histogram
+from orleans_tpu.observability.tracing import (
+    TRACE_KEY,
+    SpanCollector,
+    context_from_headers,
+    critical_path_breakdown,
+    restamp_header,
+)
+from orleans_tpu.runtime import Grain, StatefulGrain
+from orleans_tpu.testing import TestClusterBuilder
+
+
+class EchoGrain(Grain):
+    async def ping(self, x: int) -> int:
+        return x
+
+
+class ProxyGrain(Grain):
+    """Grain-to-grain hop: the relay forces a second client span from
+    inside a turn (and usually a cross-silo network leg)."""
+
+    async def relay(self, key: int, x: int) -> int:
+        return await self.get_grain(EchoGrain, key).ping(x)
+
+
+class MoverGrain(StatefulGrain):
+    __orleans_placement__ = "pin_first"
+
+    async def incr(self) -> int:
+        self.state["n"] = self.state.get("n", 0) + 1
+        await self.write_state()
+        return self.state["n"]
+
+
+class PinFirstDirector:
+    def __init__(self, pinned):
+        self.pinned = pinned
+
+    def place(self, grain_id, requester, silos):
+        return self.pinned if self.pinned in silos else silos[0]
+
+
+def _last_client_trace_id(cluster) -> int:
+    spans = [s for s in cluster.client.tracer.snapshot()
+             if s["kind"] == "client"]
+    assert spans, "client recorded no root span"
+    return spans[-1]["trace_id"]
+
+
+# ----------------------------------------------------------------------
+# Tentpole acceptance: one trace across a 2-silo grain-to-grain ping
+# ----------------------------------------------------------------------
+async def test_two_silo_trace_covers_client_network_queue_exec(tmp_path):
+    cluster = (TestClusterBuilder(2).add_grains(EchoGrain, ProxyGrain)
+               .with_tracing().build())
+    async with cluster:
+        assert await cluster.grain(ProxyGrain, 1).relay(2, 42) == 42
+        tid = _last_client_trace_id(cluster)
+        spans = cluster.trace_spans(tid)
+
+        # one trace_id end to end
+        assert {s["trace_id"] for s in spans} == {tid}
+        kinds = {s["kind"] for s in spans}
+        assert {"client", "server", "network", "directory"} <= kinds
+
+        # client invoke span is the root and covers the round trip
+        roots = [s for s in spans if s["parent_id"] is None]
+        assert len(roots) == 1 and roots[0]["kind"] == "client"
+        assert roots[0]["name"] == "ProxyGrain.relay"
+
+        # server spans record queue wait and execution separately; the
+        # grain-to-grain hop means turns on BOTH app grains appear
+        servers = [s for s in spans if s["kind"] == "server"]
+        assert {"ProxyGrain.relay", "EchoGrain.ping"} <= \
+            {s["name"] for s in servers}
+        for s in servers:
+            assert "queue_s" in s["attrs"] and "exec_s" in s["attrs"]
+            assert s["duration"] >= s["attrs"]["exec_s"]
+
+        # first call goes through directory lookup/placement
+        assert any(s["kind"] == "directory" for s in spans)
+
+        # parent links resolve within the trace (spans form one tree)
+        ids = {s["span_id"] for s in spans}
+        for s in spans:
+            assert s["parent_id"] is None or s["parent_id"] in ids
+
+        # critical-path breakdown is queryable via the management grain
+        mgmt = cluster.grain(ManagementGrain, 0)
+        bd = await mgmt.get_trace_breakdown(tid)
+        assert bd["span_count"] > 0 and bd["total_s"] > 0
+        assert bd["seconds"]["exec"] > 0
+        assert set(bd["fractions"]) == {"queue", "exec", "network",
+                                        "directory", "device", "migration"}
+        assert all(0.0 <= f <= 1.0 for f in bd["fractions"].values())
+
+        # Perfetto/Chrome export: valid JSON with complete events +
+        # process/thread naming metadata
+        path = cluster.export_trace(str(tmp_path / "trace.json"), tid)
+        data = json.load(open(path))
+        events = data["traceEvents"]
+        slices = [e for e in events if e["ph"] == "X"]
+        assert len(slices) == len(spans)
+        for e in slices:
+            assert e["dur"] > 0 and "pid" in e and "tid" in e
+        meta_names = [e["args"]["name"] for e in events if e["ph"] == "M"
+                      and e["name"] == "process_name"]
+        assert "client" in meta_names
+        assert any(n.startswith("silo") for n in meta_names)
+
+
+async def test_second_call_skips_directory_and_repeated_calls_share_nothing():
+    """Warm-path trace: the second call to an already-placed grain needs
+    no directory span, and distinct calls get distinct trace ids."""
+    cluster = (TestClusterBuilder(2).add_grains(EchoGrain)
+               .with_tracing().build())
+    async with cluster:
+        g = cluster.grain(EchoGrain, 7)
+        assert await g.ping(1) == 1
+        t1 = _last_client_trace_id(cluster)
+        cluster.clear_traces()
+        assert await g.ping(2) == 2
+        t2 = _last_client_trace_id(cluster)
+        assert t1 != t2
+        warm = cluster.trace_spans(t2)
+        assert not [s for s in warm if s["kind"] == "directory"], \
+            "warm call paid a directory hop"
+        assert any(s["kind"] == "server" for s in warm)
+
+
+# ----------------------------------------------------------------------
+# Forwarded (post-migration) hop keeps one trace_id
+# ----------------------------------------------------------------------
+async def test_forwarded_hop_after_migration_keeps_trace_id():
+    cluster = (TestClusterBuilder(2).add_grains(MoverGrain)
+               .with_rebalancer(period=0.0)  # hosts RebalanceTarget only
+               .with_tracing().build())
+    async with cluster:
+        silo_a, silo_b = cluster.silos
+        for s in cluster.silos:
+            s.locator.placement.directors["pin_first"] = \
+                PinFirstDirector(silo_a.silo_address)
+        g = cluster.grain(MoverGrain, "mover")
+        assert await g.incr() == 1
+        act = silo_a.catalog.by_grain[g.grain_id][0]
+        ok = await silo_a.rebalancer.executor.migrate_activation(
+            act, silo_b.silo_address)
+        assert ok is True
+        # the migration leg itself recorded a span on the source silo
+        migs = [s for s in silo_a.tracer.snapshot()
+                if s["kind"] == "migration"]
+        assert migs and migs[-1]["attrs"].get("committed") is True
+
+        # stale caches route the next call at A → forward hop to B; the
+        # trace header rides the forwarded message unchanged
+        fwd_before = sum(s.stats.get("messaging.forwarded")
+                         for s in cluster.silos)
+        cluster.clear_traces()
+        assert await g.incr() == 2
+        tid = _last_client_trace_id(cluster)
+        spans = cluster.trace_spans(tid)
+        assert {s["trace_id"] for s in spans} == {tid}
+        # the turn ran on B under the SAME trace id
+        b_servers = [s for s in spans if s["kind"] == "server"
+                     and s["silo"] == silo_b.config.name
+                     and "incr" in s["name"]]
+        assert b_servers, f"no server span on B in {spans}"
+        fwd_after = sum(s.stats.get("messaging.forwarded")
+                        for s in cluster.silos)
+        assert fwd_after > fwd_before, "call was not forwarded"
+
+
+# ----------------------------------------------------------------------
+# Sampling + collector semantics
+# ----------------------------------------------------------------------
+async def test_sample_zero_records_nothing_and_adds_no_headers():
+    cluster = (TestClusterBuilder(1).add_grains(EchoGrain)
+               .with_tracing(sample_rate=0.0).build())
+    async with cluster:
+        seen = {}
+
+        class Probe(Grain):
+            async def look(self):
+                from orleans_tpu.runtime.context import RequestContext
+                seen["hdr"] = RequestContext.get(TRACE_KEY)
+                return 1
+
+        cluster.silos[0].registry.register(Probe)
+        assert await cluster.grain(EchoGrain, 1).ping(5) == 5
+        assert await cluster.client.get_grain(Probe, 1).look() == 1
+        assert seen["hdr"] is None, "unsampled call leaked a trace header"
+        assert cluster.trace_spans() == []
+
+
+def test_span_ring_buffer_bounded_and_filterable():
+    c = SpanCollector("s", sample_rate=1.0, buffer_size=8)
+    for i in range(20):
+        c.close(c.open(f"op{i}", "client", trace_id=i % 2, parent_id=None))
+    assert len(c.spans) == 8  # ring bound
+    assert all(s["trace_id"] == 1 for s in c.snapshot(trace_id=1))
+    assert len(c.snapshot(limit=3)) == 3
+    c.clear()
+    assert c.snapshot() == []
+
+
+def test_malformed_trace_baggage_is_tolerated():
+    """RequestContext is app-writable: garbage under TRACE_KEY must parse
+    to None (untraced) everywhere, never break a turn."""
+    for bad in ([], "junk", 42, (1, 2), (1, "x", "y"), {"a": 1}, None):
+        assert context_from_headers({TRACE_KEY: bad}) is None, bad
+    assert context_from_headers(None) is None
+    assert context_from_headers({"other": 1}) is None
+    good = {TRACE_KEY: (7, 9, 123.5), "user": "x"}
+    assert context_from_headers(good) == (7, 9, 123.5)
+    # restamp refreshes sent_at in a COPY, preserving ids and baggage
+    out = restamp_header(good)
+    assert out is not good and out["user"] == "x"
+    assert out[TRACE_KEY][:2] == (7, 9) and out[TRACE_KEY][2] > 123.5
+    assert good[TRACE_KEY] == (7, 9, 123.5)  # original untouched
+    malformed = {TRACE_KEY: "junk"}
+    assert restamp_header(malformed) is malformed  # passthrough
+
+
+async def test_garbage_user_baggage_does_not_break_traced_calls():
+    from orleans_tpu.runtime.context import RequestContext
+
+    class BaggageGrain(Grain):
+        async def poke(self, x):
+            return x
+
+    state = {"hostile": None}
+
+    class HostileClientFilter:
+        async def __call__(self, ctx):
+            RequestContext.set(TRACE_KEY, state["hostile"])
+            await ctx.invoke()
+
+    # sample_rate=0 so the garbage header is NOT replaced by a real one
+    # at send time and actually reaches the silo-side parsers
+    cluster = (TestClusterBuilder(1).add_grains(BaggageGrain)
+               .with_tracing(sample_rate=0.0).build())
+    async with cluster:
+        cluster.client.add_outgoing_call_filter(HostileClientFilter())
+        for bad in ([], "junk", (1,), 3):
+            state["hostile"] = bad
+            assert await cluster.grain(BaggageGrain, 1).poke(5) == 5
+
+
+def test_critical_path_breakdown_empty_and_kinds():
+    empty = critical_path_breakdown([])
+    assert empty["span_count"] == 0 and empty["total_s"] == 0.0
+    c = SpanCollector("s")
+    c.record(1, None, "net", "network", start=0.0, duration=0.2)
+    sp = c.open("turn", "server", 1, None)
+    c.close(sp, duration=0.8, queue_s=0.3, exec_s=0.5)
+    sp.start = 0.2
+    bd = critical_path_breakdown(c.snapshot())
+    assert abs(bd["total_s"] - 1.0) < 1e-6
+    assert abs(bd["seconds"]["network"] - 0.2) < 1e-9
+    assert abs(bd["seconds"]["queue"] - 0.3) < 1e-9
+    assert abs(bd["seconds"]["exec"] - 0.5) < 1e-9
+
+
+# ----------------------------------------------------------------------
+# Device tier: vector requests join the trace; ticks record spans
+# ----------------------------------------------------------------------
+async def test_vector_request_records_device_span():
+    import jax.numpy as jnp
+
+    from orleans_tpu.dispatch import VectorGrain, actor_method
+
+    class CounterVec(VectorGrain):
+        STATE = {"count": (jnp.int32, ())}
+
+        @staticmethod
+        def initial_state(key_hash):
+            return {"count": jnp.int32(0)}
+
+        @actor_method(args={"x": (jnp.int32, ())})
+        def bump(state, args):
+            new = {"count": state["count"] + args["x"]}
+            return new, new["count"]
+
+    cluster = (TestClusterBuilder(1)
+               .with_vector_grains(CounterVec, capacity_per_shard=64)
+               .with_tracing().build())
+    async with cluster:
+        assert int(await cluster.grain(CounterVec, 3).bump(x=5)) == 5
+        tid = _last_client_trace_id(cluster)
+        spans = cluster.trace_spans(tid)
+        dev = [s for s in spans if s["kind"] == "device"]
+        assert dev and dev[0]["name"] == "CounterVec.bump"
+        # the engine's own tick span lands under the silo's device trace
+        silo = cluster.silos[0]
+        ticks = [s for s in silo.tracer.snapshot()
+                 if s["kind"] == "device_tick"]
+        assert ticks and ticks[0]["attrs"]["batch"] >= 1
+
+
+# ----------------------------------------------------------------------
+# Satellite: histogram aggregation consumed by the management surface
+# ----------------------------------------------------------------------
+def test_histogram_p95_buckets_merge_roundtrip():
+    a, b = Histogram(), Histogram()
+    for _ in range(90):
+        a.observe(0.0002)
+    for _ in range(10):
+        b.observe(2.0)
+    s = a.summary()
+    assert s["count"] == 90 and len(s["buckets"]) == len(Histogram.BOUNDS)
+    assert sum(s["buckets"]) == 90
+    merged = Histogram.from_snapshot(a.summary()).merge(
+        Histogram.from_snapshot(b.summary()))
+    assert merged.total == 100
+    assert merged.percentile(0.5) < 0.001   # p50 in the fast bucket
+    assert merged.summary()["p95"] >= 2.0   # p95 lands in the slow tail
+    assert abs(merged.sum - (90 * 0.0002 + 10 * 2.0)) < 1e-6
+
+
+async def test_management_grain_aggregates_cluster_histograms():
+    cluster = (TestClusterBuilder(2).add_grains(EchoGrain).build())
+    async with cluster:
+        cluster.silos[0].stats.observe("probe.latency", 0.001)
+        cluster.silos[0].stats.observe("probe.latency", 0.002)
+        cluster.silos[1].stats.observe("probe.latency", 4.0)
+        mgmt = cluster.grain(ManagementGrain, 0)
+        agg = await mgmt.get_cluster_histogram("probe.latency")
+        assert agg["count"] == 3
+        assert agg["p95"] >= 4.0  # the slow silo's tail survives the merge
+        assert await mgmt.get_cluster_histogram("no.such.histogram") is None

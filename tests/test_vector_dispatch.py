@@ -272,3 +272,34 @@ def test_pipeline_depth_guard_on_multi_shard_mesh():
     # single-shard meshes run no collectives: any depth pipelines freely
     solo = VectorRuntime(mesh=make_mesh(1))
     assert solo.validate_pipeline_depth(4) == 4
+
+
+async def test_bad_first_call_does_not_poison_inferred_schema():
+    """A schema-less method infers its args schema from the first batch,
+    committed only on success: a first call with a non-numeric arg must
+    fail ONCE and leave the schema unset, so the next valid call
+    re-infers and succeeds (the kernel build and device-put of the batch
+    run inside the same guard as the kernel launch)."""
+    import numpy as np
+    import pytest
+
+    class InferVec(VectorGrain):
+        STATE = {"n": (jnp.int32, ())}
+
+        @staticmethod
+        def initial_state(key_hash):
+            return {"n": jnp.int32(0)}
+
+        @actor_method
+        def bump(state, args):
+            new = {"n": state["n"] + args["x"]}
+            return new, new["n"]
+
+    rt = VectorRuntime(capacity_per_shard=16)
+    rt.register(InferVec)
+    with pytest.raises(TypeError):
+        await rt.call(InferVec, 1, "bump", x="abc")  # '<U3' is not jax-able
+    m = rt.table(InferVec).methods["bump"]
+    assert m.args_schema is None, f"schema poisoned: {m.args_schema}"
+    assert int(await rt.call(InferVec, 1, "bump", x=np.int32(5))) == 5
+    assert m.args_schema["x"][0] == np.dtype(np.int32)
