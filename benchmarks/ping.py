@@ -37,22 +37,28 @@ class EchoGrain(Grain):
 
 async def bench_host_tier(n_grains: int, concurrency: int,
                           seconds: float,
-                          trace_sample: float | None = None) -> dict:
+                          trace_sample: float | None = None,
+                          hot_lane: bool = True) -> dict:
     """``trace_sample``: None runs untraced (no collector installed);
     a float enables distributed tracing at that head-sampling rate — the
-    overhead-tracking variant wired into run_all and the perf floor."""
-    b = SiloBuilder().with_name("ping-silo").add_grains(EchoGrain)
+    overhead-tracking variant wired into run_all and the perf floor.
+    ``hot_lane=False`` forces every call onto the full messaging path
+    (the A/B lever for the hot-lane margin floor)."""
+    b = (SiloBuilder().with_name("ping-silo").add_grains(EchoGrain)
+         .with_config(hot_lane_enabled=hot_lane))
     if trace_sample is not None:
         b = b.with_config(trace_enabled=True, trace_sample_rate=trace_sample)
     silo = b.build()
     await silo.start()
     client = await ClusterClient(silo.fabric).connect()
+    client.hot_lane_enabled = hot_lane
     if trace_sample is not None:
         client.enable_tracing(trace_sample)
     grains = [client.get_grain(EchoGrain, k) for k in range(n_grains)]
 
     # warmup: activate every grain
     await asyncio.gather(*(g.ping(0) for g in grains))
+    hits0, falls0 = client.hot_hits, client.hot_fallbacks
 
     calls = 0
     lat: list[float] = []
@@ -74,6 +80,8 @@ async def bench_host_tier(n_grains: int, concurrency: int,
     counts = await asyncio.gather(*(worker(w) for w in range(concurrency)))
     elapsed = time.perf_counter() - t0
     calls = sum(counts)
+    hits = client.hot_hits - hits0
+    falls = client.hot_fallbacks - falls0
     await client.close_async()
     await silo.stop()
     return {
@@ -87,8 +95,41 @@ async def bench_host_tier(n_grains: int, concurrency: int,
             "concurrency": concurrency,
             "calls": calls,
             "trace_sample": trace_sample,
+            "hot_lane": hot_lane,
+            "hotlane_hit_ratio": round(hits / (hits + falls), 4)
+            if hits + falls else None,
             "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
             "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
+        },
+    }
+
+
+async def bench_hotlane(n_grains: int = 256, concurrency: int = 100,
+                        seconds: float = 2.0) -> dict:
+    """Hot-lane A/B: the same ping workload with the hot lane on vs forced
+    onto the full messaging path, reporting the speedup and the hit ratio.
+    Asserts the lane actually engaged (a silent 0% hit ratio would report
+    a meaningless speedup of ~1.0 and hide a regression)."""
+    hot = await bench_host_tier(n_grains, concurrency, seconds,
+                                hot_lane=True)
+    cold = await bench_host_tier(n_grains, concurrency, seconds,
+                                 hot_lane=False)
+    ratio = hot["extra"]["hotlane_hit_ratio"]
+    assert ratio is not None and ratio > 0.95, \
+        f"hot lane engaged on only {ratio} of warm local calls"
+    return {
+        "metric": "ping_hotlane_calls_per_sec",
+        "value": hot["value"],
+        "unit": "calls/sec",
+        "vs_baseline": None,
+        "extra": {
+            "messaging_calls_per_sec": cold["value"],
+            "speedup": round(hot["value"] / cold["value"], 2),
+            "hotlane_hit_ratio": ratio,
+            "n_grains": n_grains,
+            "concurrency": concurrency,
+            "p50_ms": hot["extra"]["p50_ms"],
+            "p99_ms": hot["extra"]["p99_ms"],
         },
     }
 
@@ -226,6 +267,8 @@ async def run(n_grains: int = 10_000, concurrency: int = 100,
     results = [
         await bench_host_tier(host_grains or min(n_grains, 1000),
                               concurrency, seconds),
+        await bench_hotlane(host_grains or min(n_grains, 256),
+                            concurrency, min(seconds, 2.0)),
         await bench_vector_tier(n_grains, rounds),
     ]
     return results
@@ -240,9 +283,16 @@ def main() -> None:
     ap.add_argument("--attribution", action="store_true",
                     help="host-tier time-split attribution instead of "
                          "the throughput benchmarks")
+    ap.add_argument("--hotlane", action="store_true",
+                    help="hot-lane A/B only: collapsed inline dispatch vs "
+                         "the full messaging path, with hit ratio")
     a = ap.parse_args()
     if a.attribution:
         print(json.dumps(asyncio.run(attribution(a.seconds, a.concurrency))))
+        return
+    if a.hotlane:
+        print(json.dumps(asyncio.run(bench_hotlane(
+            min(a.grains, 256), a.concurrency, a.seconds))))
         return
     for r in asyncio.run(run(a.grains, a.concurrency, a.seconds, a.rounds)):
         print(json.dumps(r))

@@ -36,6 +36,10 @@ def main() -> None:
     # overhead, tracked in BENCH output against the untraced figure above
     print(json.dumps(asyncio.run(ping.bench_host_tier(
         n_grains=1000, concurrency=100, seconds=3.0, trace_sample=1.0))))
+    # hot-lane A/B: collapsed inline dispatch vs the full messaging path,
+    # with the hit ratio asserted in the harness (PR 3)
+    print(json.dumps(asyncio.run(ping.bench_hotlane(
+        n_grains=256, concurrency=100, seconds=2.0))))
     print(json.dumps(asyncio.run(mapreduce.run())))
     for r in serialization.run():
         print(json.dumps(r))

@@ -27,7 +27,7 @@ from .ids import ActivationId, GrainId, SiloAddress
 __all__ = [
     "Category", "Direction", "ResponseKind", "RejectionType",
     "Message", "make_request", "make_response", "make_error_response",
-    "make_rejection",
+    "make_rejection", "recycle_message",
 ]
 
 
@@ -89,6 +89,9 @@ class Message:
         "is_unordered", "immutable", "cache_invalidation", "request_context",
         "is_new_placement", "transaction_info", "interface_version",
         "received_at",
+        # freelist bookkeeping only — NOT a dataclass field (no annotation),
+        # never crosses the wire (excluded from runtime.wire._HEADER_SLOTS)
+        "_pool_free",
     )
 
     category: Category
@@ -136,7 +139,7 @@ class Message:
         (``MessageFactory.CreateResponseMessage``). Positional args in
         field order — this runs once per request on the hot path and the
         kwarg-matching cost of 28 fields is measurable."""
-        return Message(
+        return _fresh_message(
             self.category, Direction.RESPONSE, self.id,
             self.target_silo, self.target_grain, self.target_activation,
             self.sending_silo, self.sending_grain, self.sending_activation,
@@ -149,6 +152,49 @@ class Message:
             self.interface_version,
             None,                          # received_at (stamped on arrival)
         )
+
+
+# ---------------------------------------------------------------------------
+# Message freelist (the BufferPool.cs discipline applied to envelopes):
+# request/response shells on the host control plane churn at call rate, and
+# allocator/GC pressure was measurable in the r5 attribution. A released
+# envelope re-enters service through ``_fresh_message`` (dataclass __init__
+# re-run on the recycled shell — every field overwritten, so no state leaks
+# between uses). ``recycle_message`` is called ONLY where the envelope's
+# lifecycle provably ends (RuntimeClient.receive_response, after the caller's
+# future resolves): callers guarantee no live reference remains.
+# ---------------------------------------------------------------------------
+
+_MSG_POOL: list["Message"] = []
+_MSG_POOL_CAP = 1024
+
+
+def _fresh_message(*fields) -> Message:
+    pool = _MSG_POOL
+    if pool:
+        m = pool.pop()
+        m._pool_free = False
+        m.__init__(*fields)
+        return m
+    m = Message(*fields)
+    m._pool_free = False
+    return m
+
+
+def recycle_message(m: Message) -> None:
+    """Return a dead envelope to the freelist. Idempotent (double release
+    is a no-op via ``_pool_free``); drops the shell when the pool is full.
+    Reference-carrying fields are cleared so a pooled shell cannot pin
+    user payloads or context dicts alive."""
+    if getattr(m, "_pool_free", False) or len(_MSG_POOL) >= _MSG_POOL_CAP:
+        return
+    m._pool_free = True
+    m.body = None
+    m.request_context = None
+    m.transaction_info = None
+    m.cache_invalidation = None
+    m.call_chain = ()
+    _MSG_POOL.append(m)
 
 
 def make_request(
@@ -174,7 +220,7 @@ def make_request(
     """Request factory (``MessageFactory.CreateMessage``). Default 30 s expiry
     mirrors ``MessagingOptions.ResponseTimeout``. Positional construction in
     field order (see created_response)."""
-    return Message(
+    return _fresh_message(
         category, direction, next(_correlation),
         sending_silo, sending_grain, sending_activation,
         target_silo, target_grain, None,
@@ -201,7 +247,7 @@ def make_request_fast(
     field list lives here, beside the dataclass, so reordering Message
     fields has exactly one positional construction site per shape to
     update (this, make_request, created_response)."""
-    return Message(
+    return _fresh_message(
         category, direction, next(_correlation),
         sending_silo, sending_grain, sending_activation,
         target_silo, target_grain, None,

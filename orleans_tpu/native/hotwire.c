@@ -74,6 +74,13 @@ typedef struct {
     PyObject *s_host, *s_port, *s_generation, *s_mesh_index, *s_uh;
     PyObject *s_value, *s_silo, *s_grain, *s_activation;
     int configured;
+    /* message-header struct spec (configure_headers): the field-name
+     * tuple and enum restore spec cached module-side, so the per-message
+     * socket path passes only (msg, ttl, body) — no Python-level spec
+     * marshalling per frame. */
+    PyObject *hdr_names;         /* tuple of str */
+    PyObject *hdr_enum_spec;     /* tuple of (index, members) pairs */
+    int hdr_configured;
 } hw_state;
 
 static hw_state g_state;  /* single-interpreter module; kept simple */
@@ -698,26 +705,22 @@ static PyObject *hw_configure(PyObject *self, PyObject *args) {
     Py_RETURN_NONE;
 }
 
-/* pack_attrs(obj, names, extra) -> bytes
- *
- * Encodes tuple(getattr(obj, n) for n in names) + (extra,) as one
- * T_TUPLE without materializing the intermediate tuple.  Top-level int
- * subclasses (IntEnums) are coerced to plain ints — the message-header
- * fast path; the decoder side restores them positionally. */
-static PyObject *hw_pack_attrs(PyObject *self, PyObject *args) {
-    PyObject *obj, *names, *extra;
-    if (!PyArg_ParseTuple(args, "OO!O", &obj, &PyTuple_Type, &names, &extra))
-        return NULL;
+/* Shared core of pack_attrs/pack_frame: magic+version+T_TUPLE, then
+ * tuple(getattr(obj, n) for n in names) + (extra,) without materializing
+ * the intermediate tuple.  Top-level int subclasses (IntEnums) are
+ * coerced to plain ints — the message-header fast path; the decoder side
+ * restores them positionally. */
+static int enc_attr_tuple(W *w, PyObject *obj, PyObject *names,
+                          PyObject *extra) {
     Py_ssize_t n = PyTuple_GET_SIZE(names);
-    W w;
-    if (w_init(&w, 256) < 0) return NULL;
-    w.buf[w.len++] = (char)(uint8_t)HW_MAGIC;
-    w.buf[w.len++] = (char)HW_VERSION;
-    if (w_byte(&w, T_TUPLE) < 0 || w_varint(&w, (uint64_t)(n + 1)) < 0)
-        goto fail;
+    if (w->cap - w->len < 2 && w_grow(w, 2) < 0) return -1;
+    w->buf[w->len++] = (char)(uint8_t)HW_MAGIC;
+    w->buf[w->len++] = (char)HW_VERSION;
+    if (w_byte(w, T_TUPLE) < 0 || w_varint(w, (uint64_t)(n + 1)) < 0)
+        return -1;
     for (Py_ssize_t i = 0; i < n; i++) {
         PyObject *v = PyObject_GetAttr(obj, PyTuple_GET_ITEM(names, i));
-        if (!v) goto fail;
+        if (!v) return -1;
         int rc;
         if (PyLong_Check(v) && !PyLong_CheckExact(v) && !PyBool_Check(v)) {
             /* IntEnum header field -> wire int */
@@ -725,24 +728,137 @@ static PyObject *hw_pack_attrs(PyObject *self, PyObject *args) {
             long long ll = PyLong_AsLongLongAndOverflow(v, &overflow);
             if (overflow || (ll == -1 && PyErr_Occurred())) {
                 Py_DECREF(v);
-                goto fail;
+                return -1;
             }
-            rc = (w_byte(&w, T_INT) < 0 ||
-                  w_varint(&w, zigzag(ll)) < 0) ? -1 : 0;
+            rc = (w_byte(w, T_INT) < 0 ||
+                  w_varint(w, zigzag(ll)) < 0) ? -1 : 0;
         } else {
-            rc = enc_value(&w, v, 1);
+            rc = enc_value(w, v, 1);
         }
         Py_DECREF(v);
-        if (rc < 0) goto fail;
+        if (rc < 0) return -1;
     }
-    if (enc_value(&w, extra, 1) < 0) goto fail;
+    return enc_value(w, extra, 1);
+}
+
+/* pack_attrs(obj, names, extra) -> bytes */
+static PyObject *hw_pack_attrs(PyObject *self, PyObject *args) {
+    PyObject *obj, *names, *extra;
+    if (!PyArg_ParseTuple(args, "OO!O", &obj, &PyTuple_Type, &names, &extra))
+        return NULL;
+    W w;
+    if (w_init(&w, 256) < 0) return NULL;
+    if (enc_attr_tuple(&w, obj, names, extra) < 0) { w_free(&w); return NULL; }
+    PyObject *out = PyBytes_FromStringAndSize(w.buf, w.len);
+    w_free(&w);
+    return out;
+}
+
+/* frame segment cap, mirrored from runtime.wire.MAX_FRAME_SEGMENT */
+#define HW_MAX_SEGMENT (128u * 1024u * 1024u)
+
+/* configure_headers(names, enum_spec) -> None
+ *
+ * Caches the Message header-struct spec module-side: the field-name tuple
+ * (interned for fast get/setattr) and the enum restore spec, so the
+ * per-frame socket path (pack_frame/unpack_header) passes no spec
+ * objects. */
+static PyObject *hw_configure_headers(PyObject *self, PyObject *args) {
+    PyObject *names, *enum_spec;
+    if (!PyArg_ParseTuple(args, "O!O!", &PyTuple_Type, &names,
+                          &PyTuple_Type, &enum_spec))
+        return NULL;
+    for (Py_ssize_t i = 0; i < PyTuple_GET_SIZE(names); i++) {
+        if (!PyUnicode_Check(PyTuple_GET_ITEM(names, i))) {
+            PyErr_SetString(PyExc_TypeError, "names must be strings");
+            return NULL;
+        }
+    }
+    for (Py_ssize_t e = 0; e < PyTuple_GET_SIZE(enum_spec); e++) {
+        PyObject *pair = PyTuple_GET_ITEM(enum_spec, e);
+        if (!PyTuple_Check(pair) || PyTuple_GET_SIZE(pair) != 2 ||
+            !PyLong_Check(PyTuple_GET_ITEM(pair, 0)) ||
+            !PyTuple_Check(PyTuple_GET_ITEM(pair, 1))) {
+            PyErr_SetString(PyExc_TypeError,
+                            "enum_spec: want (index, members) pairs");
+            return NULL;
+        }
+    }
+    /* intern the names in place for fast attribute access */
+    PyObject *interned = PyTuple_New(PyTuple_GET_SIZE(names));
+    if (!interned) return NULL;
+    for (Py_ssize_t i = 0; i < PyTuple_GET_SIZE(names); i++) {
+        PyObject *s = PyTuple_GET_ITEM(names, i);
+        Py_INCREF(s);
+        PyUnicode_InternInPlace(&s);
+        PyTuple_SET_ITEM(interned, i, s);
+    }
+    Py_XSETREF(g_state.hdr_names, interned);
+    Py_INCREF(enum_spec);
+    Py_XSETREF(g_state.hdr_enum_spec, enum_spec);
+    g_state.hdr_configured = 1;
+    Py_RETURN_NONE;
+}
+
+/* pack_frame(msg, ttl, body) -> bytes
+ *
+ * One C call for the whole wire frame: [u32 hlen][u32 blen][headers][body]
+ * (the IncomingMessageBuffer length-prefixed layout).  Header payload
+ * bytes are identical to pack_attrs(msg, hdr_names, ttl), so a peer that
+ * only knows unpack_attrs decodes these frames unchanged — pack_frame
+ * sheds the per-message Python-level struct.pack + two bytes-concats, not
+ * the format. */
+static PyObject *hw_pack_frame(PyObject *self, PyObject *args) {
+    PyObject *msg, *ttl;
+    Py_buffer body;
+    if (!PyArg_ParseTuple(args, "OOy*", &msg, &ttl, &body))
+        return NULL;
+    if (!g_state.hdr_configured) {
+        PyBuffer_Release(&body);
+        PyErr_SetString(PyExc_RuntimeError,
+                        "hotwire: headers not configured");
+        return NULL;
+    }
+    if (body.len > (Py_ssize_t)HW_MAX_SEGMENT) {
+        PyBuffer_Release(&body);
+        PyErr_SetString(PyExc_ValueError, "hotwire: body exceeds frame cap");
+        return NULL;
+    }
+    W w;
+    if (w_init(&w, 512) < 0) { PyBuffer_Release(&body); return NULL; }
+    memset(w.buf, 0, 8);  /* length prefix backfilled below */
+    w.len = 8;
+    if (enc_attr_tuple(&w, msg, g_state.hdr_names, ttl) < 0)
+        goto fail;
+    if (w.len - 8 > (Py_ssize_t)HW_MAX_SEGMENT) {
+        PyErr_SetString(PyExc_ValueError,
+                        "hotwire: headers exceed frame cap");
+        goto fail;
+    }
+    {
+        uint32_t hlen = (uint32_t)(w.len - 8);
+        uint32_t blen = (uint32_t)body.len;
+        /* little-endian u32 pair, matching struct.Struct("<II") */
+        w.buf[0] = (char)(hlen & 0xFF);
+        w.buf[1] = (char)((hlen >> 8) & 0xFF);
+        w.buf[2] = (char)((hlen >> 16) & 0xFF);
+        w.buf[3] = (char)((hlen >> 24) & 0xFF);
+        w.buf[4] = (char)(blen & 0xFF);
+        w.buf[5] = (char)((blen >> 8) & 0xFF);
+        w.buf[6] = (char)((blen >> 16) & 0xFF);
+        w.buf[7] = (char)((blen >> 24) & 0xFF);
+    }
+    if (w_raw(&w, (const char *)body.buf, body.len) < 0)
+        goto fail;
     {
         PyObject *out = PyBytes_FromStringAndSize(w.buf, w.len);
         w_free(&w);
+        PyBuffer_Release(&body);
         return out;
     }
 fail:
     w_free(&w);
+    PyBuffer_Release(&body);
     return NULL;
 }
 
@@ -752,11 +868,8 @@ fail:
  * len(names) values onto obj (restoring enum fields per enum_spec, a
  * tuple of (index, members_tuple) pairs), and returns the trailing extra
  * value. */
-static PyObject *hw_unpack_attrs(PyObject *self, PyObject *args) {
-    PyObject *data, *obj, *names, *enum_spec;
-    if (!PyArg_ParseTuple(args, "OOO!O!", &data, &obj, &PyTuple_Type, &names,
-                          &PyTuple_Type, &enum_spec))
-        return NULL;
+static PyObject *unpack_attrs_impl(PyObject *data, PyObject *obj,
+                                   PyObject *names, PyObject *enum_spec) {
     Py_buffer view;
     if (PyObject_GetBuffer(data, &view, PyBUF_SIMPLE) < 0) return NULL;
     R r = { (const uint8_t *)view.buf, (const uint8_t *)view.buf + view.len };
@@ -846,6 +959,31 @@ done:
     return extra;
 }
 
+static PyObject *hw_unpack_attrs(PyObject *self, PyObject *args) {
+    PyObject *data, *obj, *names, *enum_spec;
+    if (!PyArg_ParseTuple(args, "OOO!O!", &data, &obj, &PyTuple_Type, &names,
+                          &PyTuple_Type, &enum_spec))
+        return NULL;
+    return unpack_attrs_impl(data, obj, names, enum_spec);
+}
+
+/* unpack_header(data, msg) -> ttl
+ *
+ * unpack_attrs against the cached header spec (configure_headers): the
+ * per-frame decode passes only the buffer and the blank Message. */
+static PyObject *hw_unpack_header(PyObject *self, PyObject *args) {
+    PyObject *data, *obj;
+    if (!PyArg_ParseTuple(args, "OO", &data, &obj))
+        return NULL;
+    if (!g_state.hdr_configured) {
+        PyErr_SetString(PyExc_RuntimeError,
+                        "hotwire: headers not configured");
+        return NULL;
+    }
+    return unpack_attrs_impl(data, obj, g_state.hdr_names,
+                             g_state.hdr_enum_spec);
+}
+
 static PyMethodDef hw_methods[] = {
     {"dumps", hw_dumps, METH_O,
      "Encode a value to hotwire bytes (magic-prefixed)."},
@@ -855,6 +993,12 @@ static PyMethodDef hw_methods[] = {
      "pack_attrs(obj, names, extra) -> bytes: encode getattr'd fields."},
     {"unpack_attrs", hw_unpack_attrs, METH_VARARGS,
      "unpack_attrs(data, obj, names, enum_spec) -> extra: decode + setattr."},
+    {"configure_headers", hw_configure_headers, METH_VARARGS,
+     "configure_headers(names, enum_spec): cache the Message header spec."},
+    {"pack_frame", hw_pack_frame, METH_VARARGS,
+     "pack_frame(msg, ttl, body) -> bytes: full length-prefixed frame."},
+    {"unpack_header", hw_unpack_header, METH_VARARGS,
+     "unpack_header(data, msg) -> ttl: decode + setattr via cached spec."},
     {"configure", hw_configure, METH_VARARGS,
      "configure(GrainId, cat_members, SiloAddress, ActivationId, "
      "ActivationAddress, pickle_dumps, restricted_loads)"},

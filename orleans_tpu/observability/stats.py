@@ -12,7 +12,18 @@ import bisect
 import time
 from typing import Callable
 
-__all__ = ["StatsRegistry", "Histogram", "REBALANCE_STATS"]
+__all__ = ["StatsRegistry", "Histogram", "DISPATCH_STATS", "REBALANCE_STATS"]
+
+# Hot-lane dispatch counter pair (runtime.hotlane): hits = calls that ran
+# as frame-collapsed inline turns (including the always-interleave direct
+# lane), fallbacks = calls that took the full messaging path. Exposed as
+# gauges (the underlying counters are plain ints on the RuntimeClient — a
+# registry increment per call was measurable in the r5 attribution); the
+# hit ratio hits/(hits+fallbacks) is the bench/SLO signal.
+DISPATCH_STATS = {
+    "hot_hits": "dispatch.hotlane.hits",
+    "hot_fallbacks": "dispatch.hotlane.fallbacks",
+}
 
 # Canonical rebalancer counter/gauge names (orleans_tpu.rebalance wires
 # its per-round outcomes here; tests and the management surface read them

@@ -97,6 +97,13 @@ class ActivationData:
         self.grain_instance: Any = None
         self.state = ActivationState.CREATE
         self.storage_bridge = None  # set by Catalog for StatefulGrain
+        # class flags resolved once (the reentrancy gate reads these per
+        # message, and per-call getattr walks were measurable on the hot
+        # lane); plain attributes shadowing what used to be properties
+        self.is_reentrant: bool = getattr(
+            grain_class, "__orleans_reentrant__", False)
+        self.is_stateless_worker: bool = getattr(
+            grain_class, "__orleans_stateless_worker__", 0) > 0
 
         # Turn gate state (ActivationData running/waiting)
         self.running: list[Message] = []          # currently-executing requests
@@ -119,14 +126,6 @@ class ActivationData:
     def address(self) -> ActivationAddress:
         return ActivationAddress(self.runtime.silo_address, self.grain_id,
                                  self.activation_id)
-
-    @property
-    def is_reentrant(self) -> bool:
-        return getattr(self.grain_class, "__orleans_reentrant__", False)
-
-    @property
-    def is_stateless_worker(self) -> bool:
-        return getattr(self.grain_class, "__orleans_stateless_worker__", 0) > 0
 
     # -- reentrancy gate (Dispatcher.cs:313-336) ------------------------
     def may_accept_request(self, msg: Message) -> bool:

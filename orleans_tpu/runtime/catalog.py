@@ -110,6 +110,10 @@ class Catalog:
 
     def _create_activation(self, grain_id: GrainId,
                            grain_class: type) -> ActivationData:
+        # warm the per-class invoker table at activation-class registration
+        # (runtime.invoker): the first hot-lane call to this class must not
+        # pay the build, and the build itself caches remote_methods on cls
+        self.silo.invokers.entry(grain_class)
         act = ActivationData(grain_id, self.silo.runtime, grain_class,
                              max_enqueued=self.silo.config.max_enqueued_requests)
         act.state = ActivationState.ACTIVATING
@@ -178,6 +182,7 @@ class Catalog:
         if self.by_grain.get(grain_id):
             raise OrleansError(
                 f"{grain_id} already has an activation on this silo")
+        self.silo.invokers.entry(grain_class)  # warm the invoker table
         act = ActivationData(grain_id, self.silo.runtime, grain_class,
                              max_enqueued=self.silo.config.max_enqueued_requests)
         act.state = ActivationState.ACTIVATING

@@ -20,6 +20,7 @@ from typing import Any
 from ..core.errors import SiloUnavailableError
 from ..core.ids import SiloAddress
 from ..core.message import Direction, Message
+from .hotlane import try_hot_invoke as _hot_invoke
 from .references import GrainFactory
 from .runtime_client import RuntimeClient
 
@@ -148,6 +149,12 @@ class ClusterClient(RuntimeClient):
         self.grain_factory = GrainFactory(self)
         self._gateway_rr = 0
         self.connected = False
+        # hot-lane locality hint: grain_id → hosting SiloAddress
+        # (re-resolved through fabric.silos and re-verified against the
+        # silo's catalog on every use, so a stale entry just re-resolves
+        # and a dead silo is never pinned; bounded so key-churn workloads
+        # can't grow it)
+        self._hot_silo_cache: dict = {}
         from .observers import ObserverHost
         self._observer_host = ObserverHost(lambda: self._address)
 
@@ -155,6 +162,55 @@ class ClusterClient(RuntimeClient):
     @property
     def silo_address(self) -> SiloAddress:
         return self._address
+
+    def try_hot_invoke(self, grain_id, grain_class: type,
+                       interface_name: str, method_name: str,
+                       args: tuple, kwargs: dict,
+                       is_read_only: bool = False):
+        """Hot lane for the in-proc fabric: every silo shares this event
+        loop, so a call whose activation lives in ANY registered silo is
+        "local" in the hot-lane sense.  Gateway-only semantics that the
+        lane would bypass force a fallback: load shedding (queue depth is
+        the shed signal) and non-Running silos.  Socket-backed clients
+        (multiprocess clusters) never take this path — their fabric holds
+        no silo objects."""
+        if not self.hot_lane_enabled or not self.connected:
+            return None
+        cache = self._hot_silo_cache
+        addr = cache.get(grain_id)
+        # the hint stores the ADDRESS, not the silo object: a killed silo
+        # leaves fabric.silos, so a stale hint resolves to None here and
+        # can never pin a dead silo's catalog/activations in memory
+        silo = self.fabric.silos.get(addr) if addr is not None else None
+        if silo is None or silo.status != "Running" or \
+                not silo.catalog.by_grain.get(grain_id):
+            # a non-gracefully killed silo keeps its catalog populated, so
+            # the status is part of hint validity — a dead hint re-resolves
+            # (the grain reactivates elsewhere) instead of pinning the
+            # fallback path forever
+            silo = None
+            for s in self.fabric.silos.values():
+                if s.status == "Running" and s.catalog.by_grain.get(grain_id):
+                    silo = s
+                    break
+            if silo is None:
+                cache.pop(grain_id, None)  # never retain a dead hint
+                self.hot_fallbacks += 1
+                return None
+            if len(cache) >= 65536:
+                cache.clear()
+            cache[grain_id] = silo.silo_address
+        if silo.config.load_shedding_enabled:
+            self.hot_fallbacks += 1
+            return None
+        coro = _hot_invoke(self, silo, grain_id, grain_class,
+                           interface_name, method_name,
+                           args, kwargs, is_read_only)
+        if coro is None:
+            self.hot_fallbacks += 1
+        else:
+            self.hot_hits += 1
+        return coro
 
     def transmit(self, msg: Message) -> None:
         msg.sending_silo = self._address

@@ -32,6 +32,24 @@ _request_context: contextvars.ContextVar[dict | None] = contextvars.ContextVar(
 TXN_KEY = "orleans.txn"
 
 
+def build_call_chain(sender: "ActivationData | None") -> tuple:
+    """Extend ``sender``'s running call chain with its own grain id for an
+    outgoing call (deadlock/reentrancy detection,
+    InsideRuntimeClient.cs:306-311); () outside any turn.  The single
+    construction shared by the messaging send path, the direct-interleave
+    lane, and the hot lane — chain semantics changes happen HERE once."""
+    if sender is None:
+        return ()
+    running = sender.running[-1] if sender.running else None
+    parent = running.call_chain if running is not None else ()
+    return (*parent, sender.grain_id)
+
+
+def current_call_chain() -> tuple:
+    """:func:`build_call_chain` for the ambient activation."""
+    return build_call_chain(current_activation.get())
+
+
 class RequestContext:
     """Static accessors mirroring the reference API
     (``RequestContext.Get/Set/Remove``)."""

@@ -351,7 +351,10 @@ class Dispatcher:
 
     def receive_request(self, activation: ActivationData, msg: Message) -> None:
         """ReceiveRequest:262 — gate, then run or enqueue."""
-        if msg.is_expired:
+        # inline expiry check (vs the is_expired property: sheds the
+        # descriptor + method frame on every turn); unarmed messages
+        # (timer turns, timeout=0) pay one attribute load + None test
+        if msg.expires_at is not None and time.monotonic() > msg.expires_at:
             log.warning("dropping expired request %s", msg.method_name)
             return
         if self.detect_deadlocks and activation.grain_id in msg.call_chain \
@@ -474,8 +477,10 @@ class Dispatcher:
             resp.transaction_info = (info.id, dict(info.participants))
 
     async def invoke(self, activation: ActivationData, msg: Message):
-        """Resolve and call the grain method (Invoke:294-474, codegen
-        method-id switch → plain getattr here)."""
+        """Resolve and call the grain method (Invoke:294-474) through the
+        per-class invoker table (runtime.invoker — the codegen method-id
+        switch analog); methods outside the precomputed remote surface
+        fall back to per-call getattr resolution."""
         if msg.method_name == "__timer__":
             callback, done = msg.body
             try:
@@ -496,29 +501,45 @@ class Dispatcher:
                 "on_incoming_call is the grain-level call filter hook, "
                 "not a remotely invocable method")
         instance = activation.grain_instance
-        fn = getattr(instance, msg.method_name, None)
-        if fn is None:
-            raise AttributeError(
-                f"{activation.grain_class.__name__} has no method "
-                f"{msg.method_name!r}")
+        entry = self.silo.invokers.entry(activation.grain_class)
+        inv = entry.methods.get(msg.method_name)
+        if inv is not None and \
+                msg.method_name in getattr(instance, "__dict__", ()):
+            # an INSTANCE-attached callable (fault injection, test stubs)
+            # shadows the class table, exactly as the pre-table getattr
+            # resolution honored it
+            inv = None
+        if inv is not None:
+            fn = None
+        else:
+            fn = getattr(instance, msg.method_name, None)
+            if fn is None:
+                raise AttributeError(
+                    f"{activation.grain_class.__name__} has no method "
+                    f"{msg.method_name!r}")
         args, kwargs = maybe_intern_tokens(self.silo, *msg.body)
         # incoming call filter chain (InsideRuntimeClient.cs:362 →
-        # GrainMethodInvoker): silo filters first, then the grain's own
-        # on_incoming_call (grain-implements-the-filter form) last.
-        # Application traffic only — system/ping traffic (membership
-        # probes, directory RPCs, reminder ticks) must never be gated by
-        # user filters (the reference's filters wrap grain calls, not
-        # system-target messages).
-        silo_filters = self.silo.incoming_call_filters
+        # GrainMethodInvoker): silo filters first (the table's fused
+        # snapshot — entry() already revalidated it against the live
+        # list), then the grain's own on_incoming_call (grain-implements-
+        # the-filter form) last. Application traffic only — system/ping
+        # traffic (membership probes, directory RPCs, reminder ticks)
+        # must never be gated by user filters (the reference's filters
+        # wrap grain calls, not system-target messages).
+        # per-instance lookup stays unconditional: a hook attached to the
+        # INSTANCE (not the class) must gate messaging-path calls exactly
+        # as before the invoker table existed
         grain_filter = getattr(instance, "on_incoming_call", None)
-        if (silo_filters or grain_filter is not None) and \
+        if (entry.silo_chain or grain_filter is not None) and \
                 msg.category == Category.APPLICATION:
             from .filters import IncomingCallContext, run_call_chain
-            chain = list(silo_filters)
+            chain: tuple = entry.silo_chain
             if grain_filter is not None:
-                chain.append(grain_filter)
+                chain = (*chain, grain_filter)
 
             async def terminal(c):
+                if inv is not None:
+                    return await inv.fn(instance, *c.args, **c.kwargs)
                 return await fn(*c.args, **c.kwargs)
 
             return await run_call_chain(IncomingCallContext(
@@ -526,6 +547,8 @@ class Dispatcher:
                 grain_id=activation.grain_id,
                 interface_name=msg.interface_name,
                 method_name=msg.method_name, args=args, kwargs=kwargs))
+        if inv is not None:
+            return await inv.fn(instance, *args, **kwargs)
         return await fn(*args, **kwargs)
 
     def run_message_pump(self, activation: ActivationData) -> None:
@@ -538,8 +561,9 @@ class Dispatcher:
             if not activation.may_accept_request(nxt):
                 break
             activation.waiting.popleft()
-            if nxt.is_expired:
-                continue
+            if nxt.expires_at is not None and \
+                    time.monotonic() > nxt.expires_at:
+                continue  # expired while queued: caller gave up already
             self._handle_incoming(activation, nxt)
         if activation.wants_deactivation:
             self.silo.catalog.schedule_deactivation(activation)
@@ -550,16 +574,18 @@ class Dispatcher:
         non-message work (GrainTimer ticks run as turns)."""
         loop = asyncio.get_running_loop()
         done: asyncio.Future = loop.create_future()
-        from ..core.message import make_request
-        msg = make_request(
-            target_grain=activation.grain_id,
-            interface_name=activation.grain_class.__name__,
-            method_name="__timer__",
-            body=(callback, done),
-            direction=Direction.ONE_WAY,
-            category=Category.SYSTEM,
-            target_silo=self.silo.silo_address,
-            timeout=None,
+        # positional fast factory (timer ticks fire at turn rate on busy
+        # grains; the 28-kwarg construction was measurable in the r5
+        # attribution)
+        from ..core.message import make_request_fast
+        msg = make_request_fast(
+            Category.SYSTEM, Direction.ONE_WAY,
+            None, None, None,                     # sending silo/grain/act
+            self.silo.silo_address, activation.grain_id,
+            activation.grain_class.__name__, "__timer__",
+            (callback, done),
+            None, (), False, False,               # expiry, chain, flags
+            None, 0,                              # request_context, version
         )
         msg.target_activation = activation.activation_id
         self.receive_request(activation, msg)

@@ -59,14 +59,25 @@ class GrainRef:
         one_way = getattr(fn, "__orleans_one_way__", False)
 
         def invoke(*args: Any, **kwargs: Any):
-            if interleave and not one_way:
-                # always-interleave + local activation: direct coroutine
-                # (see InsideRuntimeClient.try_direct_interleave — the
-                # mailbox gate would admit the message unconditionally,
-                # so only the invoke remains)
-                direct = client.try_direct_interleave(gid, name, args, kwargs)
-                if direct is not None:
-                    return direct
+            if not one_way:
+                if interleave:
+                    # always-interleave + local activation: direct
+                    # coroutine (InsideRuntimeClient.try_direct_interleave
+                    # — the mailbox gate would admit the message
+                    # unconditionally, so only the invoke remains)
+                    direct = client.try_direct_interleave(
+                        gid, name, args, kwargs)
+                    if direct is not None:
+                        return direct
+                else:
+                    # hot lane (runtime.hotlane): the default in-silo path
+                    # — local Valid activation + admitting gate runs the
+                    # turn inline; anything complicated returns None and
+                    # falls through to the full messaging path
+                    hot = client.try_hot_invoke(gid, cls, iface, name,
+                                                args, kwargs, read_only)
+                    if hot is not None:
+                        return hot
             # skip the filter-dispatch wrapper when no filters are
             # registered (checked per call: filters may be added later)
             send = (client.send_request if client.outgoing_call_filters

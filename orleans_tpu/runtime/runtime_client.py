@@ -26,11 +26,17 @@ from ..core.message import (
     Message,
     ResponseKind,
     make_request_fast,
+    recycle_message,
 )
 from ..core.serialization import copy_call_body, deep_copy
 from ..observability.tracing import TRACE_KEY, current_trace
 from .cancellation import register_outgoing_tokens
-from .context import TXN_KEY, RequestContext, current_activation
+from .context import (
+    TXN_KEY,
+    RequestContext,
+    build_call_chain,
+    current_activation,
+)
 
 if TYPE_CHECKING:
     from .activation import ActivationData
@@ -76,6 +82,33 @@ class CallbackData:
         self.txn_info = txn_info
 
 
+# CallbackData freelist (the BufferPool.cs discipline): one acquired per
+# round-trip RPC, released wherever the entry leaves the registry for good.
+_CB_POOL: list[CallbackData] = []
+_CB_POOL_CAP = 1024
+
+
+def _fresh_callback(message: Message, future: asyncio.Future,
+                    deadline: float | None, txn_info) -> CallbackData:
+    pool = _CB_POOL
+    if pool:
+        cb = pool.pop()
+        cb.message = message
+        cb.future = future
+        cb.deadline = deadline
+        cb.txn_info = txn_info
+        return cb
+    return CallbackData(message, future, deadline, txn_info)
+
+
+def _recycle_callback(cb: CallbackData) -> None:
+    cb.message = None
+    cb.future = None
+    cb.txn_info = None
+    if len(_CB_POOL) < _CB_POOL_CAP:
+        _CB_POOL.append(cb)
+
+
 class RuntimeClient:
     """Shared base: callback registry + response correlation. Subclassed by
     the silo interior (:class:`InsideRuntimeClient`) and the external client
@@ -94,6 +127,14 @@ class RuntimeClient:
         # the hot path unless tracing is enabled — silo-side wired from
         # SiloConfig.trace_*, client-side via enable_tracing()
         self.tracer = None
+        # hot-lane dispatch (runtime.hotlane): hit/fallback counter pair
+        # (DISPATCH_STATS) as plain ints — a StatsRegistry increment per
+        # call was itself measurable in the r5 attribution — plus an
+        # on/off switch (benchmarks and the perf floor flip it to measure
+        # the messaging path alone)
+        self.hot_hits = 0
+        self.hot_fallbacks = 0
+        self.hot_lane_enabled = True
 
     def enable_tracing(self, sample_rate: float = 1.0,
                        buffer_size: int = 4096, name: str = "client"):
@@ -109,6 +150,17 @@ class RuntimeClient:
         activation; None when not applicable (take the messaging path).
         Overridden by InsideRuntimeClient — external clients always
         message."""
+        return None
+
+    def try_hot_invoke(self, grain_id, grain_class: type,
+                       interface_name: str, method_name: str,
+                       args: tuple, kwargs: dict,
+                       is_read_only: bool = False):
+        """Hot-lane dispatch (runtime.hotlane): inline turn for ordinary
+        calls to a local, Valid, gate-admitting activation; None when any
+        complication demands the full messaging path.  Overridden where a
+        local catalog is reachable (InsideRuntimeClient; ClusterClient
+        over the in-proc fabric)."""
         return None
 
     # -- to be provided by subclass -------------------------------------
@@ -216,13 +268,7 @@ class RuntimeClient:
                                  body_precopied: bool = False):
         timeout = self.response_timeout if timeout is None else timeout
         sender = current_activation.get()
-        call_chain: tuple[GrainId, ...] = ()
-        if sender is not None:
-            # extend the caller's chain for deadlock/reentrancy detection
-            # (InsideRuntimeClient.cs:306-311)
-            running = sender.running[-1] if sender.running else None
-            parent_chain = running.call_chain if running is not None else ()
-            call_chain = (*parent_chain, sender.grain_id)
+        call_chain: tuple[GrainId, ...] = build_call_chain(sender)
         # record call targets on any cancellation-token argument so
         # source.cancel() can reach remote twins (the reference's
         # _targetGrainReferences bookkeeping)
@@ -254,6 +300,14 @@ class RuntimeClient:
                     trace_id, parent_id)
                 req_ctx = dict(req_ctx) if req_ctx else {}
                 req_ctx[TRACE_KEY] = (trace_id, span.span_id, span.start)
+        # One clock read serves both the caller-side callback deadline and
+        # the server-side expiry stamp (the message previously stamped its
+        # own — a second monotonic read per call, ~2% in the r5
+        # attribution). Server-side expiry semantics are unchanged: a
+        # request that outlives its timeout while queued is still dropped
+        # by the dispatcher, preserving the at-most-once story for
+        # timed-out-and-retried callers.
+        deadline = (time.monotonic() + timeout) if timeout else None
         # Copy-isolate arguments at send time (SerializationManager.DeepCopy
         # for in-silo calls): caller mutations after the call cannot leak into
         # the callee. Immutable-wrapped args pass by reference.
@@ -268,19 +322,19 @@ class RuntimeClient:
             # copying twice would double serialization on the hot path
             (args, kwargs) if body_precopied
             else copy_call_body(args, kwargs),
-            (time.monotonic() + timeout) if timeout is not None else None,
+            deadline,
             call_chain, is_read_only, is_always_interleave,
             req_ctx,
             getattr(grain_class, "__orleans_version__", 0),
         )
         if span is None:
-            return self._send(msg, is_one_way, timeout)
+            return self._send(msg, is_one_way, deadline)
         # addressing work triggered inside transmit (directory lookups,
         # placement) runs in tasks that copy the context NOW — parent them
         # under this call's span, then restore the caller's ambient trace
         token = current_trace.set((span.trace_id, span.span_id))
         try:
-            res = self._send(msg, is_one_way, timeout)
+            res = self._send(msg, is_one_way, deadline)
         except BaseException as e:
             tracer.close(span, error=type(e).__name__)
             raise
@@ -291,15 +345,15 @@ class RuntimeClient:
             return None
         return _finish_span_after(tracer, span, res)
 
-    def _send(self, msg: Message, is_one_way: bool, timeout: float | None):
+    def _send(self, msg: Message, is_one_way: bool,
+              deadline: float | None):
         if is_one_way:
             self.transmit(msg)
             return None
         loop = asyncio.get_running_loop()
         future: asyncio.Future = loop.create_future()
-        deadline = (time.monotonic() + timeout) if timeout else None
-        self.callbacks[msg.id] = CallbackData(
-            msg, future, deadline, txn_info=RequestContext.get(TXN_KEY))
+        self.callbacks[msg.id] = _fresh_callback(
+            msg, future, deadline, RequestContext.get(TXN_KEY))
         self._ensure_sweeper()
         try:
             self.transmit(msg)
@@ -330,8 +384,13 @@ class RuntimeClient:
         cb = self.callbacks.pop(msg.id, None)
         if cb is None:
             log.debug("dropping late/unknown response %s", msg.id)
+            # a late response's envelope is dead on arrival (its request's
+            # entry already timed out/broke); the request shell itself is
+            # NOT recycled on those paths — its turn may still be running
+            recycle_message(msg)
             return
         if cb.future.done():
+            _recycle_callback(cb)
             return
         # fold callee transaction joins back into the caller's ambient
         # info (the TransactionInfo response-header merge; idempotent for
@@ -345,10 +404,23 @@ class RuntimeClient:
             # _await_response, so resolution itself need not burn an extra
             # event-loop iteration per call
             _resolve_future(cb.future, msg.body, None)
+            # settled for good: both envelopes and the callback entry are
+            # provably dereferenced now — the ONLY frames still holding the
+            # request are synchronous callers up-stack (the in-proc server's
+            # _run_turn finally block), which finish their reads before any
+            # pool re-acquire can run on this event loop
+            request = cb.message
+            _recycle_callback(cb)
+            recycle_message(request)
+            recycle_message(msg)
         elif msg.response_kind == ResponseKind.ERROR:
             exc = msg.body if isinstance(msg.body, BaseException) else \
                 RejectionError(str(msg.body))
             _resolve_future(cb.future, None, exc)
+            request = cb.message
+            _recycle_callback(cb)
+            recycle_message(request)
+            recycle_message(msg)
         else:  # rejection — transparently resend transient rejections
             # GATEWAY_TOO_BUSY is retryable: the resend re-picks a gateway
             # (the reference's client reroutes around overloaded gateways)
@@ -417,6 +489,9 @@ class RuntimeClient:
                         f"silo {silo} declared dead with request in flight"))
                     # suppress "exception never retrieved" if nobody awaits
                     cb.future.exception()
+                # the request envelope is NOT recycled: a dead-silo verdict
+                # says nothing about whether its turn still runs somewhere
+                _recycle_callback(cb)
 
     # -- timeout sweep (CallbackData timer analog) -------------------------
     def _ensure_sweeper(self) -> None:
@@ -435,6 +510,9 @@ class RuntimeClient:
                         cb.future.set_exception(GrainCallTimeoutError(
                             f"{cb.message.interface_name}.{cb.message.method_name} "
                             f"to {cb.message.target_grain} timed out"))
+                    # request envelope NOT recycled: its turn may still be
+                    # running server-side (in-proc it is the same object)
+                    _recycle_callback(cb)
         self._timeout_sweeper = None
 
     def close(self) -> None:
@@ -442,6 +520,7 @@ class RuntimeClient:
             if not cb.future.done():
                 cb.future.set_exception(SiloUnavailableError("client closed"))
                 cb.future.exception()  # mark retrieved; close is best-effort
+            _recycle_callback(cb)
         self.callbacks.clear()
         if self._timeout_sweeper is not None:
             self._timeout_sweeper.cancel()

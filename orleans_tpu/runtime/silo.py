@@ -16,7 +16,6 @@ either.
 from __future__ import annotations
 
 import asyncio
-import itertools
 import logging
 import time
 from dataclasses import dataclass
@@ -25,12 +24,16 @@ from typing import TYPE_CHECKING, Any, Callable
 from ..core.ids import GrainId, SiloAddress
 from ..core.message import Category, Direction, Message
 from ..core.serialization import copy_call_body, copy_result
-from ..observability.stats import StatsRegistry
+from ..observability.stats import DISPATCH_STATS, StatsRegistry
+from .activation import ActivationState
 from ..storage.core import StorageManager
 from .cancellation import TokenInterner
 from .catalog import Catalog
-from .context import current_activation
+from .context import current_activation, current_call_chain
 from .dispatcher import Dispatcher
+from .hotlane import marker_ids as _marker_ids
+from .hotlane import try_hot_invoke as _hot_invoke
+from .invoker import InvokerTable
 from .references import GrainFactory
 from .runtime_client import RuntimeClient
 
@@ -142,6 +145,10 @@ class SiloConfig:
     # reference's inline WorkItemGroup execution (WorkItemGroup.cs:269
     # runs queued tasks synchronously on the worker thread)
     eager_turns: bool = True
+    # hot-lane dispatch (runtime.hotlane): frame-collapsed inline turns for
+    # local gate-admitting calls. Off → every call takes the full messaging
+    # path (the perf-floor A/B lever; semantics are identical either way)
+    hot_lane_enabled: bool = True
 
 
 class GrainRegistry:
@@ -295,9 +302,9 @@ class MessageCenter:
         self.silo.fabric.deliver(msg)
 
 
-# negative ids: can never collide with wire message ids in an
-# activation's running_since map
-_direct_call_counter = itertools.count(1)
+# direct-call marker ids come from hotlane.marker_ids: ONE negative-id
+# sequence for every running-marker kind, so concurrent direct-lane and
+# hot-lane turns on one activation can never collide in running_since
 _DIRECT_YIELD_EVERY = 256
 
 
@@ -322,6 +329,7 @@ class InsideRuntimeClient(RuntimeClient):
         super().__init__(response_timeout=silo.config.response_timeout)
         self.silo = silo
         self._direct_calls_since_yield = 0
+        self.hot_lane_enabled = silo.config.hot_lane_enabled
 
     @property
     def silo_address(self) -> SiloAddress:
@@ -329,6 +337,23 @@ class InsideRuntimeClient(RuntimeClient):
 
     def transmit(self, msg: Message) -> None:
         self.silo.dispatcher.send_message(msg)
+
+    def try_hot_invoke(self, grain_id, grain_class: type,
+                       interface_name: str, method_name: str,
+                       args: tuple, kwargs: dict,
+                       is_read_only: bool = False):
+        """Hot lane for grain-to-grain calls inside this silo (see
+        runtime.hotlane for the admission conditions)."""
+        if not self.hot_lane_enabled:
+            return None
+        coro = _hot_invoke(self, self.silo, grain_id, grain_class,
+                           interface_name, method_name,
+                           args, kwargs, is_read_only)
+        if coro is None:
+            self.hot_fallbacks += 1
+        else:
+            self.hot_hits += 1
+        return coro
 
     def try_direct_interleave(self, grain_id, method_name: str,
                               args: tuple, kwargs: dict):
@@ -351,31 +376,31 @@ class InsideRuntimeClient(RuntimeClient):
         inside the callee carry the caller's extended call chain and
         attribute to the callee activation."""
         if self.outgoing_call_filters or self.silo.incoming_call_filters:
+            self.hot_fallbacks += 1
             return None
         acts = self.silo.catalog.by_grain.get(grain_id)
         if not acts or len(acts) != 1:
+            self.hot_fallbacks += 1
             return None
         act = acts[0]
-        from .activation import ActivationState
         if act.state != ActivationState.VALID:
+            self.hot_fallbacks += 1
             return None
         if getattr(act.grain_instance, "on_incoming_call", None) is not None:
+            self.hot_fallbacks += 1
             return None
         fn = getattr(act.grain_instance, method_name, None)
         if fn is None:
+            self.hot_fallbacks += 1
             return None
+        self.hot_hits += 1  # the interleave lane is part of DISPATCH_STATS
         return self._direct_interleave_call(act, fn, args, kwargs)
 
     async def _direct_interleave_call(self, act, fn, args: tuple,
                                       kwargs: dict):
         args, kwargs = copy_call_body(args, kwargs)
-        caller = current_activation.get()
-        chain: tuple = ()
-        if caller is not None:
-            running = caller.running[-1] if caller.running else None
-            parent = running.call_chain if running is not None else ()
-            chain = (*parent, caller.grain_id)
-        marker = _DirectCallMarker(-next(_direct_call_counter), chain)
+        chain = current_call_chain()
+        marker = _DirectCallMarker(-next(_marker_ids), chain)
         act.record_running(marker)
         token = current_activation.set(act)
         try:
@@ -431,6 +456,17 @@ class Silo:
         self.message_center = MessageCenter(self)
         self.dispatcher = Dispatcher(self)
         self.catalog = Catalog(self)
+        # per-(grain_class, method) invoker table (runtime.invoker): built
+        # once per class, consumed by the dispatcher's invoke engine and
+        # the hot lane; revalidates on filter registration / version bump
+        self.invokers = InvokerTable(self)
+        # hot-lane hit/fallback observability (DISPATCH_STATS): the counters
+        # live as plain ints on the runtime client; gauges surface them
+        rc = self.runtime_client
+        self.stats.register_gauge(DISPATCH_STATS["hot_hits"],
+                                  lambda: rc.hot_hits)
+        self.stats.register_gauge(DISPATCH_STATS["hot_fallbacks"],
+                                  lambda: rc.hot_fallbacks)
         self.grain_factory = GrainFactory(self.runtime_client)
         from ..directory.locator import DistributedLocator
         self.locator: Any = DistributedLocator(self)

@@ -113,11 +113,13 @@ async def frame_stream(reader: asyncio.StreamReader, chunk_size: int = 1 << 16):
 # ---------------------------------------------------------------------------
 
 # Every Message slot except the lazily-decoded body (the headers/body split
-# of Message.HeadersContainer, Message.cs:725), expires_at (rebased), and
+# of Message.HeadersContainer, Message.cs:725), expires_at (rebased),
 # received_at (a local monotonic arrival stamp, meaningless cross-process —
-# the receiver re-stamps on delivery).
+# the receiver re-stamps on delivery), and _pool_free (freelist
+# bookkeeping, core.message.recycle_message).
 _HEADER_SLOTS = tuple(s for s in Message.__slots__
-                      if s not in ("body", "expires_at", "received_at"))
+                      if s not in ("body", "expires_at", "received_at",
+                                   "_pool_free"))
 
 # Enum-typed header fields ride the wire as plain ints (the native codec's
 # scalar fast path; pickling an IntEnum writes a by-reference class lookup).
@@ -140,6 +142,17 @@ _ENUM_SPEC = (
     (_I_REJECTION_TYPE, _ser.members_by_value(RejectionType)),
 )
 
+# Native header-struct codec (hotwire.c configure_headers/pack_frame/
+# unpack_header): the field-name tuple and enum spec are cached inside the
+# C module once, so the per-message socket path is a single C call each
+# way — no struct.pack, no bytes concat, no spec tuples crossing the
+# C boundary per frame. Frame BYTES are identical to the pack_attrs form,
+# so mixed builds (one side without the new entry points) interoperate.
+_HW_FRAMES = _ser._hotwire is not None and \
+    hasattr(_ser._hotwire, "pack_frame")
+if _HW_FRAMES:
+    _ser._hotwire.configure_headers(_HEADER_SLOTS, _ENUM_SPEC)
+
 
 def encode_message(msg: Message, native: bool = True) -> bytes:
     """Encode one message frame. ``native=False`` forces the pickle wire
@@ -150,8 +163,17 @@ def encode_message(msg: Message, native: bool = True) -> bytes:
     ttl = None
     if msg.expires_at is not None:
         ttl = max(0.0, msg.expires_at - time.monotonic())
-    headers = None
+    body = serialize(msg.body) if native else serialize_portable(msg.body)
     hw = _ser._hotwire if native else None
+    if hw is not None and _HW_FRAMES:
+        try:
+            # single C call for the whole frame: getattr walk + enum
+            # coercion + header encode + length prefix + body splice
+            return hw.pack_frame(msg, ttl, body)
+        except ValueError:
+            pass  # cyclic/over-deep header payload (or absurd size):
+            #       the pickle/encode_frame fallback below handles/raises
+    headers = None
     if hw is not None:
         try:
             # single C call: getattr walk + enum coercion + encode
@@ -165,14 +187,17 @@ def encode_message(msg: Message, native: bool = True) -> bytes:
                 fields[i] = int(fields[i])
         headers = serialize((tuple(fields), ttl)) if native \
             else serialize_portable((tuple(fields), ttl))
-    body = serialize(msg.body) if native else serialize_portable(msg.body)
     return encode_frame(headers, body)
 
 
 def decode_message(headers: bytes, body: bytes) -> Message:
     msg = Message.__new__(Message)
     try:
-        if headers[:1] == b"\xa7" and _ser._hotwire is not None:
+        if headers[:1] == b"\xa7" and _HW_FRAMES and \
+                _ser._hotwire is not None:
+            # single C call against the cached header spec
+            ttl = _ser._hotwire.unpack_header(headers, msg)
+        elif headers[:1] == b"\xa7" and _ser._hotwire is not None:
             # single C call: decode + enum restore + setattr walk
             ttl = _ser._hotwire.unpack_attrs(
                 headers, msg, _HEADER_SLOTS, _ENUM_SPEC)
@@ -198,6 +223,7 @@ def decode_message(headers: bytes, body: bytes) -> Message:
         raise WireDecodeError(f"undecodable message headers: {e}") from e
     msg.expires_at = None if ttl is None else time.monotonic() + ttl
     msg.received_at = None  # local arrival stamp; tracing re-stamps
+    msg._pool_free = False  # full slot set: consumers may walk __slots__
     try:
         msg.body = deserialize(body)
     except Exception as e:  # noqa: BLE001 — body failure is per-message

@@ -335,6 +335,125 @@ async def test_direct_interleave_path_still_runs_grain_level_filter():
         await silo.stop()
 
 
+async def test_hotlane_declines_when_filters_present():
+    """Ordinary (non-interleave) calls take the hot lane when warm — but
+    any registered incoming filter must force the messaging path so
+    interception fires identically regardless of placement."""
+    seen = []
+
+    async def audit(ctx):
+        seen.append(ctx.method_name)
+        await ctx.invoke()
+
+    silo, client = await _cluster(
+        SiloBuilder().add_grains(Echo).add_incoming_call_filter(audit))
+    try:
+        g = client.get_grain(Echo, 3)
+        assert await g.say("a") == "echo:a"  # cold
+        h0 = client.hot_hits
+        assert await g.say("b") == "echo:b"  # warm — must STILL filter
+        assert seen.count("say") == 2
+        assert client.hot_hits == h0, "hot lane bypassed a call filter"
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_hotlane_invalidates_on_late_filter_registration():
+    """The invoker table snapshots the silo filter chain; registering a
+    filter AFTER hot-lane calls have warmed the table must invalidate it —
+    subsequent calls fall back and run the new filter."""
+    seen = []
+
+    async def audit(ctx):
+        seen.append(ctx.method_name)
+        await ctx.invoke()
+
+    silo, client = await _cluster(SiloBuilder().add_grains(Echo))
+    try:
+        g = client.get_grain(Echo, 4)
+        await g.say("warm")
+        h0 = client.hot_hits
+        assert await g.say("hot") == "echo:hot"
+        assert client.hot_hits == h0 + 1  # lane engaged, table warm
+        # late registration (the direct-mutation form tests use)
+        silo.incoming_call_filters.append(audit)
+        assert await g.say("filtered") == "echo:filtered"
+        assert seen == ["say"], "late-registered filter did not run"
+        assert client.hot_hits == h0 + 1  # fell back after invalidation
+        # unregistering re-opens the lane
+        silo.incoming_call_filters.remove(audit)
+        assert await g.say("fast-again") == "echo:fast-again"
+        assert client.hot_hits == h0 + 2
+        # same-length REPLACEMENT (remove A, append B) must also
+        # invalidate: revalidation is by filter identity, not count
+        other = []
+
+        async def audit2(ctx):
+            other.append(ctx.method_name)
+            await ctx.invoke()
+
+        silo.incoming_call_filters.append(audit)
+        await g.say("x")
+        silo.incoming_call_filters.remove(audit)
+        silo.incoming_call_filters.append(audit2)
+        assert await g.say("swapped") == "echo:swapped"
+        assert other == ["say"], "replaced filter did not run"
+        assert seen == ["say", "say"], "removed filter ran after removal"
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_hotlane_deferred_start_sees_late_filter():
+    """A filter registered BETWEEN building the call coroutine and its
+    execution must still run: the hot lane re-verifies admission at
+    execution time and hands the call to the messaging path."""
+    seen = []
+
+    async def audit(ctx):
+        seen.append(ctx.method_name)
+        await ctx.invoke()
+
+    silo, client = await _cluster(SiloBuilder().add_grains(Echo))
+    try:
+        g = client.get_grain(Echo, 6)
+        await g.say("warm")
+        fut = asyncio.ensure_future(g.say("raced"))  # admitted hot NOW
+        silo.incoming_call_filters.append(audit)     # ...then filtered
+        assert await fut == "echo:raced"
+        assert seen == ["say"], "late filter missed a deferred hot call"
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_hotlane_respects_grain_level_filter():
+    """A grain implementing on_incoming_call keeps its gate for ordinary
+    warm calls (the hot lane declines, mirroring the direct-interleave
+    contract)."""
+    class Gated(Grain):
+        async def on_incoming_call(self, ctx):
+            if ctx.kwargs.pop("secret", None) == "ok":
+                await ctx.invoke()
+            else:
+                ctx.result = "denied"
+
+        async def fetch(self, **kwargs) -> str:
+            return "granted"
+
+    silo, client = await _cluster(SiloBuilder().add_grains(Gated))
+    try:
+        g = client.get_grain(Gated, 9)
+        assert await g.fetch(secret="ok") == "granted"   # cold
+        assert await g.fetch(secret="ok") == "granted"   # warm
+        assert await g.fetch(secret="no") == "denied"
+        assert await g.fetch() == "denied"
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
 async def test_silo_outgoing_filter_wraps_grain_to_grain_calls():
     order = []
 

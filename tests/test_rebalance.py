@@ -208,6 +208,70 @@ async def test_migration_mid_flight_messages_redispatch():
         assert await g.incr() == 10  # state carried exactly
 
 
+async def test_hotlane_migration_fence_falls_back_cleanly():
+    """Hot-lane dispatch across a live migration: calls before the fence
+    ride the hot lane, calls during the fence fall back to the messaging
+    path (parked + re-addressed, the fence contract), and calls after the
+    migration hot-lane again on the destination via the client's
+    re-resolved locality hint — no lost or duplicated increments."""
+    cluster = (TestClusterBuilder(2).add_grains(HotGrain)
+               .with_rebalancer(period=0.0).build())
+    async with cluster:
+        silo_a, silo_b = cluster.silos
+        _pin_placement(cluster, silo_a.silo_address)
+        client = cluster.client
+        g = cluster.grain(HotGrain, "hot-mover")
+        assert await g.incr() == 1      # cold: creates on A
+        h0 = client.hot_hits
+        assert await g.incr() == 2      # warm: hot lane on A
+        assert client.hot_hits == h0 + 1
+        act = silo_a.catalog.by_grain[g.grain_id][0]
+        mig = asyncio.ensure_future(
+            silo_a.rebalancer.executor.migrate_activation(
+                act, silo_b.silo_address))
+        # deferred burst racing the fence: every call must either run
+        # before the fence or fall back and re-address — never inline on
+        # the fenced source
+        burst = [asyncio.ensure_future(g.incr()) for _ in range(6)]
+        assert await mig is True
+        vals = await asyncio.gather(*burst)
+        assert sorted(vals) == list(range(3, 9)), vals
+        assert silo_b.catalog.by_grain.get(g.grain_id)
+        # post-migration: the locality hint re-resolves to B and the hot
+        # lane re-engages there with the migrated state
+        h1 = client.hot_hits
+        assert await g.incr() == 9
+        assert await g.incr() == 10
+        assert client.hot_hits > h1, "hot lane never re-engaged on B"
+        assert await g.where() == str(silo_b.silo_address)
+
+
+async def test_hotlane_locality_hint_survives_silo_kill():
+    """A killed (non-graceful) silo keeps its catalog populated — the
+    client's hot-lane locality hint must treat a non-Running silo as
+    stale, re-resolve once the grain reactivates on a survivor, and not
+    pin the dead silo object via the cache."""
+    cluster = (TestClusterBuilder(2).add_grains(HotGrain)
+               .with_rebalancer(period=0.0).build())
+    async with cluster:
+        silo_a, silo_b = cluster.silos
+        _pin_placement(cluster, silo_a.silo_address)
+        client = cluster.client
+        g = cluster.grain(HotGrain, "phoenix")
+        assert await g.incr() == 1   # cold → activates on A
+        assert await g.incr() == 2   # warm → hot lane, hint caches A
+        assert client._hot_silo_cache.get(g.grain_id) == silo_a.silo_address
+        await cluster.kill_silo(silo_a)
+        _pin_placement(cluster, silo_b.silo_address)
+        # reactivates on B from storage (last persisted n=2); the stale
+        # hint must not disable the lane
+        assert await asyncio.wait_for(g.incr(), 10) == 3
+        h0 = client.hot_hits
+        assert await g.incr() == 4
+        assert client.hot_hits > h0, "lane never re-engaged after kill"
+        assert client._hot_silo_cache.get(g.grain_id) == silo_b.silo_address
+
+
 async def test_migration_rolls_back_when_destination_refuses():
     """Transfer failure (class unknown on the destination) leaves the
     source activation serving with its registration intact."""

@@ -387,6 +387,150 @@ async def test_argument_isolation():
         await silo.stop()
 
 
+# ---------------------------------------------------------------------------
+# Hot-lane dispatch semantics (runtime.hotlane — PR 3)
+# ---------------------------------------------------------------------------
+
+async def test_hotlane_engages_on_warm_local_calls():
+    """A warm, idle, local activation serves ordinary calls through the
+    hot lane (DISPATCH_STATS hit counter moves); results and errors are
+    identical to the messaging path."""
+    silo, client = await start_silo()
+    try:
+        g = client.get_grain(HelloGrain, 100)
+        await g.say_hello("warm")  # cold: creates the activation (fallback)
+        h0 = client.hot_hits
+        for i in range(10):
+            assert await g.say_hello(str(i)) == \
+                f"You said: '{i}', I say: Hello!"
+        assert client.hot_hits - h0 == 10
+        assert silo.stats.gauge("dispatch.hotlane.hits") >= 0  # gauge wired
+        # errors flow through unchanged
+        f = client.get_grain(FailingGrain, 100)
+        with pytest.raises(ValueError, match="kaboom"):
+            await f.boom()  # cold
+        h1 = client.hot_hits
+        with pytest.raises(ValueError, match="kaboom"):
+            await f.boom()  # warm: hot lane, same exception surface
+        assert client.hot_hits == h1 + 1
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_hotlane_busy_gate_falls_back_without_reordering():
+    """A WARM non-reentrant activation under a concurrent burst: the first
+    call runs inline, the rest hit a busy gate, fall back, and enqueue in
+    arrival order — strictly serial results, no interleave, no reorder."""
+    silo, client = await start_silo()
+    try:
+        g = client.get_grain(CounterGrain, 77)
+        await g.add(0)  # warm (the cold path covered serialization before)
+        results = await asyncio.gather(*(g.add(1) for _ in range(10)))
+        assert sorted(results) == results, "queued turns reordered"
+        assert results == list(range(1, 11))
+        assert await g.get_max_concurrent() == 1
+        assert client.hot_fallbacks > 0  # the busy gate declined inline runs
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_hotlane_deferred_start_reverifies_gate():
+    """ensure_future(ref.method()) builds the call coroutine now but runs
+    it later: the hot lane re-verifies the gate at execution time, so a
+    burst of deferred starts on a warm non-reentrant grain still runs
+    strictly serially (regression: the gate decision alone would admit
+    every one of them against the then-idle activation)."""
+    silo, client = await start_silo()
+    try:
+        g = client.get_grain(CounterGrain, 78)
+        await g.add(0)  # warm
+        futs = [asyncio.ensure_future(g.add(1)) for _ in range(8)]
+        results = await asyncio.gather(*futs)
+        assert sorted(results) == list(range(1, 9))
+        assert await g.get_max_concurrent() == 1
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_hotlane_read_only_interleaves_and_counts():
+    """Read-only hot calls interleave with running read-only turns (the
+    gate's read-only clause holds for pooled markers too)."""
+    silo, client = await start_silo()
+    try:
+        g = client.get_grain(CounterGrain, 79)
+        await g.add(5)
+        await asyncio.gather(*(g.get() for _ in range(8)))
+        assert await g.get_max_concurrent() > 1
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_hotlane_request_context_forces_fallback_and_propagates():
+    """Ambient RequestContext baggage forces the messaging path (headers
+    carry it); the callee still observes the baggage."""
+    silo, client = await start_silo()
+    try:
+        g = client.get_grain(ContextGrain, 50)
+        await g.read_baggage("k")  # warm
+        RequestContext.set("k", "v-1")
+        h0, f0 = client.hot_hits, client.hot_fallbacks
+        assert await g.read_baggage("k") == "v-1"
+        assert client.hot_hits == h0 and client.hot_fallbacks > f0
+        RequestContext.clear()
+        assert await g.read_baggage("k") is None  # hot again, no leak
+        assert client.hot_hits == h0 + 1
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_hotlane_sampled_tracing_forces_fallback_intact_span():
+    """With a sampling tracer installed every call takes the messaging
+    path (span tree must stay intact); at sample_rate=0 the hot lane
+    re-engages while an ambient trace context still forces fallback."""
+    silo = (SiloBuilder().with_name("traced").add_grains(*ALL_GRAINS)
+            .with_config(trace_enabled=True, trace_sample_rate=1.0)
+            .build())
+    await silo.start()
+    client = await ClusterClient(silo.fabric).connect()
+    client.enable_tracing(1.0)
+    try:
+        g = client.get_grain(HelloGrain, 60)
+        await g.say_hello("warm")
+        h0 = client.hot_hits
+        await g.say_hello("traced")
+        assert client.hot_hits == h0  # fell back: the call rooted a trace
+        spans = client.tracer.snapshot()
+        assert any(s["kind"] == "client" for s in spans)
+        server = [s for s in silo.tracer.snapshot() if s["kind"] == "server"]
+        assert server, "sampled call lost its server span"
+        # sample_rate=0: nothing can root a trace → hot lane engages
+        client.tracer.sample_rate = 0.0
+        await g.say_hello("x")
+        assert client.hot_hits == h0 + 1
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_hotlane_disabled_via_config():
+    silo, client = await start_silo(hot_lane_enabled=False)
+    client.hot_lane_enabled = False
+    try:
+        g = client.get_grain(HelloGrain, 70)
+        await g.say_hello("a")
+        h0 = client.hot_hits
+        await g.say_hello("b")
+        assert client.hot_hits == h0  # every call messages
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
 async def test_failing_timer_tick_keeps_timer_alive():
     class FlakyTimerGrain(Grain):
         def __init__(self):
