@@ -1,0 +1,52 @@
+"""Actor-invariant static analyzer (the RoslynCodeGenerator/analyzer story
+for a Python runtime).
+
+Orleans keeps grain code inside the virtual-actor contract with compile-time
+codegen and Roslyn analyzers; this package is the reproduction's equivalent:
+a stdlib-``ast`` lint pass over ``orleans_tpu/`` that statically checks the
+invariants the hot lane (PR 3) and migration fences made load-bearing —
+pool discipline for recycled ``Message``/``CallbackData`` shells, turn
+discipline inside ``async def`` grain/runtime methods, and purity of
+functions handed to ``jit``/``shard_map`` on the device tier.
+
+Rules
+-----
+
+========  ==========================================================
+OTPU001   pool-discipline: pooled object used/stored after release,
+          or released twice along one path
+OTPU002   blocking-in-turn: ``time.sleep`` / sync IO / ``.result()``
+          inside an ``async def`` turn
+OTPU003   interleaving-hazard: grain attribute written before and
+          read after an ``await`` in a non-reentrant grain method
+OTPU004   mutable-state-leak: grain method returns a shared mutable
+          internal (``return self._rows``)
+OTPU005   unawaited-grain-call: grain-ref coroutine dropped without
+          an explicit fire-and-forget marker
+OTPU006   traced-impurity: function traced by ``jit``/``shard_map``/
+          ``pjit`` captures or mutates host runtime state
+========  ==========================================================
+
+Usage::
+
+    python -m orleans_tpu.analysis orleans_tpu/ \
+        --baseline analysis/baseline.json
+
+Suppress one finding in place with a trailing (or preceding full-line)
+comment: ``# otpu: ignore[OTPU002]`` (rule list, or bare ``# otpu: ignore``
+for all rules). Accepted pre-existing findings live in the checked-in
+baseline; ``--write-baseline`` regenerates it (sorted, deterministic).
+``tests/test_analysis.py`` runs the analyzer over the package as part of
+tier-1, so any new finding fails CI until fixed, suppressed, or explicitly
+baselined.
+"""
+
+from .baseline import load_baseline, match_baseline, write_baseline
+from .engine import analyze_paths, analyze_source
+from .model import RULES, Finding, Rule, all_rules
+
+__all__ = [
+    "Finding", "Rule", "RULES", "all_rules",
+    "analyze_paths", "analyze_source",
+    "load_baseline", "match_baseline", "write_baseline",
+]

@@ -1,0 +1,111 @@
+"""CLI: ``python -m orleans_tpu.analysis [paths] [options]``.
+
+Exit codes: 0 — no non-baselined findings; 1 — new findings (or parse
+errors); 2 — usage/configuration error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .baseline import load_baseline, match_baseline, write_baseline
+from .engine import analyze_paths
+from .model import RULES, all_rules
+
+SEVERITY_ORDER = {"warning": 0, "error": 1}
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m orleans_tpu.analysis",
+        description="Actor-invariant static analyzer (OTPU001-OTPU006).")
+    parser.add_argument("paths", nargs="*", default=["orleans_tpu"],
+                        help="files or directories to scan "
+                             "(default: orleans_tpu)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--baseline", metavar="FILE",
+                        help="accepted-findings file; only NEW findings "
+                             "fail the run")
+    parser.add_argument("--write-baseline", metavar="FILE",
+                        help="write ALL current findings to FILE and "
+                             "exit 0 (regenerates the ratchet)")
+    parser.add_argument("--rules", metavar="IDS",
+                        help="comma-separated rule ids to run "
+                             "(default: all)")
+    parser.add_argument("--min-severity", choices=("warning", "error"),
+                        default="warning",
+                        help="drop findings below this severity")
+    args = parser.parse_args(argv)
+
+    if args.write_baseline and (args.rules
+                                or args.min_severity != "warning"):
+        # a filtered write would silently DROP accepted findings outside
+        # the filter from the ratchet, and the next full gate run would
+        # report them as new — refuse rather than corrupt the baseline
+        print("--write-baseline must run unfiltered (no --rules / "
+              "--min-severity): the baseline is the full ratchet",
+              file=sys.stderr)
+        return 2
+
+    rules = all_rules()
+    if args.rules:
+        wanted = {r.strip().upper() for r in args.rules.split(",")}
+        unknown = wanted - set(RULES)
+        if unknown:
+            print(f"unknown rule ids: {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        rules = [RULES[r] for r in sorted(wanted)]
+
+    findings = analyze_paths(args.paths, rules=rules)
+    floor = SEVERITY_ORDER[args.min_severity]
+    findings = [f for f in findings
+                if SEVERITY_ORDER.get(f.severity, 1) >= floor
+                or f.rule == "OTPU000"]
+
+    if args.write_baseline:
+        write_baseline(args.write_baseline, findings)
+        print(f"wrote {len(findings)} finding(s) to "
+              f"{args.write_baseline}")
+        return 0
+
+    baseline = load_baseline(args.baseline) if args.baseline else None
+    if baseline is not None:
+        new, stale = match_baseline(findings, baseline)
+        if args.rules or args.min_severity != "warning":
+            # a filtered run cannot produce findings outside the filter,
+            # so baseline entries for them are NOT evidence of fixed code
+            # — reporting them stale would nudge the user toward churning
+            # a correct ratchet
+            stale = {}
+    else:
+        new, stale = findings, {}
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.to_json() for f in new],
+            "baselined": len(findings) - len(new),
+            "stale_baseline": [list(k) for k in sorted(stale)],
+        }, indent=1, sort_keys=True))
+    else:
+        for f in new:
+            print(f.render())
+        if stale:
+            print(f"note: {sum(stale.values())} baseline entr"
+                  f"{'y is' if sum(stale.values()) == 1 else 'ies are'} "
+                  "stale (finding fixed) — regenerate with "
+                  "--write-baseline", file=sys.stderr)
+        summary = (f"{len(new)} new finding(s), "
+                   f"{len(findings) - len(new)} baselined, "
+                   f"{len({f.path for f in findings})} file(s) with "
+                   "findings")
+        print(summary if findings else "clean: no findings",
+              file=sys.stderr)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

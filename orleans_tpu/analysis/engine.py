@@ -1,0 +1,183 @@
+"""Analyzer driver: file discovery, parsing, suppression, rule dispatch.
+
+Deterministic by construction — files are walked in sorted order and
+findings are sorted (path, line, col, rule) — so the CLI output and the
+baseline file diff cleanly across runs.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from typing import Iterable, Sequence
+
+from .model import RULES, FileContext, Finding, all_rules
+
+__all__ = ["analyze_paths", "analyze_source", "iter_python_files",
+           "suppressed_lines"]
+
+# `# otpu: ignore` or `# otpu: ignore[OTPU001, OTPU003]`
+_SUPPRESS_RE = re.compile(
+    r"#\s*otpu:\s*ignore(?:\[([A-Za-z0-9_,\s]+)\])?")
+
+
+def _comment_lines(source: str) -> list[tuple[int, str]]:
+    """(line, comment-text) for every real comment token. Tokenizing —
+    rather than regex-scanning raw lines — keeps a marker INSIDE a string
+    literal from suppressing anything. Falls back to the raw-line scan
+    only when the source does not tokenize (it then rarely parses either,
+    so the fallback practically never decides a finding)."""
+    try:
+        return [(tok.start[0], tok.string)
+                for tok in tokenize.generate_tokens(
+                    io.StringIO(source).readline)
+                if tok.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return [(i, line) for i, line in
+                enumerate(source.splitlines(), start=1) if "#" in line]
+
+
+def suppressed_lines(source: str) -> dict[int, frozenset[str] | None]:
+    """Map line number → suppressed rule ids (None = all rules).
+
+    A marker on a code line covers that line; a marker on a comment-only
+    line covers the following line too (the idiomatic place when the code
+    line is already long).
+    """
+    lines = source.splitlines()
+    out: dict[int, frozenset | None] = {}
+    for i, comment in _comment_lines(source):
+        m = _SUPPRESS_RE.search(comment)
+        if not m:
+            continue
+        rules = None
+        if m.group(1):
+            rules = frozenset(r.strip().upper()
+                              for r in m.group(1).split(",") if r.strip())
+        targets = [i]
+        if i <= len(lines) and lines[i - 1].lstrip().startswith("#"):
+            targets.append(i + 1)
+        for t in targets:
+            prev = out.get(t, frozenset())
+            if prev is None or rules is None:
+                out[t] = None
+            else:
+                out[t] = prev | rules
+    return out
+
+
+# simple (non-compound) statements: a marker anywhere on one covers the
+# whole statement, so the natural end-of-line comment on a black-wrapped
+# multi-line call still silences the finding anchored to its first line
+_SIMPLE_STMTS = (ast.Expr, ast.Assign, ast.AugAssign, ast.AnnAssign,
+                 ast.Return, ast.Raise, ast.Assert, ast.Delete)
+
+
+def _spread_over_statements(supp: dict, tree: ast.Module) -> None:
+    if not supp:
+        return
+    for stmt in ast.walk(tree):
+        if not isinstance(stmt, _SIMPLE_STMTS):
+            continue
+        lo, hi = stmt.lineno, stmt.end_lineno or stmt.lineno
+        if hi <= lo:
+            continue
+        marked = [supp[m] for m in range(lo, hi + 1) if m in supp]
+        if not marked:
+            continue
+        rules = None if any(m is None for m in marked) else \
+            frozenset().union(*marked)
+        for line in range(lo, hi + 1):
+            prev = supp.get(line, frozenset())
+            supp[line] = None if (prev is None or rules is None) else \
+                prev | rules
+
+
+def _is_suppressed(f: Finding,
+                   supp: dict[int, frozenset | None]) -> bool:
+    rules = supp.get(f.line, frozenset())
+    return rules is None or f.rule in rules
+
+
+def analyze_source(source: str, rel_path: str, *,
+                   rules: Iterable | None = None,
+                   path: str | None = None) -> list[Finding]:
+    """Run the (selected) rules over one source blob. Syntax errors come
+    back as an ``OTPU000`` error finding rather than an exception — a
+    file the analyzer cannot parse is a finding about that file."""
+    rel_path = rel_path.replace(os.sep, "/")
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding("OTPU000", "error", rel_path, e.lineno or 0,
+                        (e.offset or 0) or 1,
+                        f"file does not parse: {e.msg}")]
+    ctx = FileContext(path=path or rel_path, rel_path=rel_path,
+                      source=source, tree=tree,
+                      lines=source.splitlines())
+    supp = suppressed_lines(source)
+    _spread_over_statements(supp, tree)
+    findings: list[Finding] = []
+    for rule in (rules if rules is not None else all_rules()):
+        findings.extend(f for f in rule.check(ctx)
+                        if not _is_suppressed(f, supp))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def iter_python_files(paths: Sequence[str]) -> list[tuple[str, str]]:
+    """Expand files/dirs into sorted (abs_path, rel_path) pairs. Relative
+    paths are rooted at each argument's parent so ``orleans_tpu/runtime/x``
+    stays stable regardless of the directory the CLI runs from."""
+    out: list[tuple[str, str]] = []
+    seen: set[str] = set()
+
+    def add(full: str, rel: str) -> None:
+        # overlapping CLI args (a dir and a file inside it) must not scan
+        # a file twice — duplicates would double findings past their
+        # baseline multiplicity and falsely fail the gate
+        key = os.path.realpath(full)
+        if key not in seen:
+            seen.add(key)
+            out.append((full, rel))
+
+    for p in paths:
+        p = p.rstrip("/")
+        if os.path.isfile(p):
+            # keep a relative CLI arg verbatim. An absolute one becomes
+            # cwd-relative when possible, else keeps its full segment
+            # chain (minus the root) — reducing to a basename would
+            # silently disable path-scoped rules (OTPU006's dispatch/ops/
+            # parallel check) and break baseline key matching
+            rel = p
+            if os.path.isabs(p):
+                rel = os.path.relpath(p)
+                if rel.startswith(".."):
+                    rel = os.path.splitdrive(p)[1].lstrip(os.sep)
+            add(p, rel)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    full = os.path.join(dirpath, fn)
+                    add(full, os.path.relpath(
+                        full, os.path.dirname(p) or "."))
+    out.sort(key=lambda t: t[1])
+    return out
+
+
+def analyze_paths(paths: Sequence[str], *,
+                  rules: Iterable | None = None) -> list[Finding]:
+    findings: list[Finding] = []
+    for full, rel in iter_python_files(paths):
+        with open(full, encoding="utf-8") as fh:
+            src = fh.read()
+        findings.extend(analyze_source(src, rel, rules=rules, path=full))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

@@ -1,0 +1,5 @@
+"""Rule modules — importing this package registers every rule."""
+
+from . import pool, state, traced, turns  # noqa: F401
+
+__all__ = ["pool", "state", "traced", "turns"]

@@ -1,0 +1,122 @@
+"""Shared AST helpers for the OTPU rules."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+__all__ = [
+    "GRAIN_BASES", "dotted_name", "decorator_names", "is_grain_class",
+    "is_reentrant_grain", "iter_functions", "iter_grain_classes",
+    "func_params", "lexical_walk",
+]
+
+# Class bases that make a class a host-tier grain (turn discipline applies).
+# VectorGrain is deliberately absent: its methods are kernel specs executed
+# by the tick engine, not turns (OTPU006 covers that tier instead).
+GRAIN_BASES = {
+    "Grain", "StatefulGrain", "JournaledGrain", "TransactionalGrain",
+    "GrainService",
+}
+
+
+def dotted_name(node: ast.AST) -> str:
+    """``a.b.c`` for Name/Attribute chains, "" for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def decorator_names(node: ast.ClassDef | ast.FunctionDef |
+                    ast.AsyncFunctionDef) -> list[str]:
+    """Dotted names of decorators; a decorator-factory call contributes
+    its callee's name (``@placement("hash")`` → ``placement``)."""
+    out = []
+    for d in node.decorator_list:
+        if isinstance(d, ast.Call):
+            d = d.func
+        name = dotted_name(d)
+        if name:
+            out.append(name)
+    return out
+
+
+def is_grain_class(node: ast.ClassDef) -> bool:
+    for base in node.bases:
+        last = dotted_name(base).rsplit(".", 1)[-1]
+        if last in GRAIN_BASES:
+            return True
+    return False
+
+
+def is_reentrant_grain(node: ast.ClassDef) -> bool:
+    """``@reentrant`` decorator or a literal ``__orleans_reentrant__ = True``
+    in the class body."""
+    for name in decorator_names(node):
+        if name.rsplit(".", 1)[-1] == "reentrant":
+            return True
+    for stmt in node.body:
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name) and \
+                        t.id == "__orleans_reentrant__":
+                    v = stmt.value
+                    if isinstance(v, ast.Constant) and v.value:
+                        return True
+    return False
+
+
+def iter_functions(tree: ast.AST, qualprefix: str = "") -> Iterator[
+        tuple[str, "ast.FunctionDef | ast.AsyncFunctionDef"]]:
+    """Yield (qualname, node) for every def/async def, nested included."""
+    for node in ast.iter_child_nodes(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            qn = f"{qualprefix}{node.name}"
+            yield qn, node
+            yield from iter_functions(node, qn + ".")
+        elif isinstance(node, ast.ClassDef):
+            yield from iter_functions(node, f"{qualprefix}{node.name}.")
+
+
+def iter_grain_classes(tree: ast.AST,
+                       qualprefix: str = "") -> Iterator[
+        tuple[str, ast.ClassDef]]:
+    for node in ast.iter_child_nodes(tree):
+        if isinstance(node, ast.ClassDef):
+            qn = f"{qualprefix}{node.name}"
+            if is_grain_class(node):
+                yield qn, node
+            yield from iter_grain_classes(node, qn + ".")
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield from iter_grain_classes(node, f"{qualprefix}{node.name}.")
+
+
+def func_params(node: "ast.FunctionDef | ast.AsyncFunctionDef |"
+                " ast.Lambda") -> set[str]:
+    a = node.args
+    names = {p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    return names
+
+
+def lexical_walk(node: ast.AST, *, into_defs: bool = False
+                 ) -> Iterator[ast.AST]:
+    """Depth-first walk in source order (``ast.walk`` is breadth-first,
+    which scrambles before/after-await ordering). By default does NOT
+    descend into nested function/class definitions — a nested def's body
+    does not execute at its lexical position."""
+    for child in ast.iter_child_nodes(node):
+        yield child
+        if not into_defs and isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                        ast.Lambda, ast.ClassDef)):
+            continue
+        yield from lexical_walk(child, into_defs=into_defs)

@@ -1,0 +1,208 @@
+"""OTPU001 — pool discipline for freelist-recycled objects.
+
+PR 3 introduced freelists for ``Message`` (``core.message.recycle_message``)
+and ``CallbackData`` (``runtime_client._recycle_callback``) plus the
+hot-lane running marker (``hotlane._release_marker``). A released shell may
+be re-acquired and re-initialized by any later allocation on the event
+loop, so touching a local variable after passing it to a releaser is a
+use-after-free with Python characteristics: no crash, just another call's
+fields. This rule runs a small branch-aware dataflow over each function
+that calls a releaser and reports
+
+* any read of a name after it was released on every path reaching the
+  read, and
+* a second release of an already-released name along one path.
+
+Rebinding (``x = ...``) or ``del x`` clears the released state. The
+analysis is intra-procedural and ignores aliases — the cross-function
+dataflow upgrade is a ROADMAP follow-on.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..model import FileContext, Finding, Rule, register
+from .common import iter_functions
+
+RELEASERS = {
+    "recycle_message", "_recycle_callback", "recycle_callback",
+    "_release_marker", "release_marker",
+}
+
+_TERMINATED = None  # sentinel state for paths that return/raise/break
+
+
+def _walk_shallow(root: ast.AST) -> Iterator[ast.AST]:
+    """Walk without entering nested def/lambda/class bodies — code there
+    does not execute at this lexical position."""
+    stack: list[ast.AST] = [root]
+    while stack:
+        node = stack.pop()
+        if node is not root and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                       ast.Lambda, ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _release_calls(stmt: ast.stmt) -> list[tuple[ast.Call, str]]:
+    """(call, released-name) for every releaser call in the statement."""
+    out = []
+    for node in _walk_shallow(stmt):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else \
+                fn.id if isinstance(fn, ast.Name) else ""
+            if name in RELEASERS and node.args and \
+                    isinstance(node.args[0], ast.Name):
+                out.append((node, node.args[0].id))
+    return out
+
+
+class _FuncAnalysis:
+    def __init__(self, rule: "PoolDiscipline", ctx: FileContext,
+                 qualname: str):
+        self.rule = rule
+        self.ctx = ctx
+        self.qualname = qualname
+        self.findings: list[Finding] = []
+        self.reported: set[tuple[str, int]] = set()
+
+    # -- state: dict name -> line of the release ------------------------
+    def run(self, body: list[ast.stmt]) -> None:
+        self.exec_block(body, {})
+
+    def exec_block(self, stmts: list[ast.stmt], state: "dict | None"):
+        for stmt in stmts:
+            if state is _TERMINATED:
+                return _TERMINATED
+            state = self.exec_stmt(stmt, state)
+        return state
+
+    def _emit(self, node: ast.AST, name: str, message: str) -> None:
+        key = (name, getattr(node, "lineno", 0))
+        if key not in self.reported:
+            self.reported.add(key)
+            self.findings.append(self.ctx.finding(
+                self.rule, node, message, self.qualname))
+
+    def _scan_uses(self, stmt: ast.stmt, state: dict,
+                   skip: set[int]) -> None:
+        """Report loads of released names anywhere in the statement,
+        skipping the releaser-arg Name nodes (handled as events) and any
+        nested def/lambda bodies (executed later, maybe never)."""
+        for node in _walk_shallow(stmt):
+            if isinstance(node, ast.Name) and id(node) not in skip and \
+                    isinstance(node.ctx, ast.Load) and node.id in state:
+                self._emit(node, node.id,
+                           f"pooled '{node.id}' used after release")
+
+    def _apply_simple(self, stmt: ast.stmt, state: dict) -> dict:
+        """Uses → releases → rebinds, in that order, for one statement."""
+        releases = _release_calls(stmt)
+        skip = {id(call.args[0]) for call, _ in releases}
+        self._scan_uses(stmt, state, skip)
+        for call, name in releases:
+            if name in state:
+                self._emit(call, name,
+                           f"pooled '{name}' released twice along one path")
+            else:
+                state[name] = call.lineno
+        for node in _walk_shallow(stmt):
+            if isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, (ast.Store, ast.Del)):
+                state.pop(node.id, None)
+        return state
+
+    @staticmethod
+    def _merge(states: list) -> "dict | None":
+        live = [s for s in states if s is not _TERMINATED]
+        if not live:
+            return _TERMINATED
+        merged = dict(live[0])
+        for s in live[1:]:
+            merged = {k: min(v, s[k]) for k, v in merged.items() if k in s}
+        return merged
+
+    def exec_stmt(self, stmt: ast.stmt, state: dict):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            # the body runs later (analyzed as its own function); only the
+            # binding of the name happens here
+            state.pop(stmt.name, None)
+            return state
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            self._apply_simple(stmt, state)
+            return _TERMINATED
+        if isinstance(stmt, (ast.Break, ast.Continue)):
+            return _TERMINATED
+        if isinstance(stmt, ast.If):
+            self._apply_simple(ast.Expr(stmt.test), state)
+            s_body = self.exec_block(stmt.body, dict(state))
+            s_else = self.exec_block(stmt.orelse, dict(state))
+            return self._merge([s_body, s_else])
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            if isinstance(stmt, ast.While):
+                self._apply_simple(ast.Expr(stmt.test), state)
+            else:
+                self._apply_simple(ast.Expr(stmt.iter), state)
+                for node in ast.walk(stmt.target):
+                    if isinstance(node, ast.Name):
+                        state.pop(node.id, None)
+            # one symbolic pass through the body catches straight-line
+            # release→use inside an iteration; loop-carried state (release
+            # in iteration N, use in N+1) is a known gap (ROADMAP)
+            self.exec_block(stmt.body, dict(state))
+            self.exec_block(stmt.orelse, dict(state))
+            return state
+        if isinstance(stmt, (ast.Try, getattr(ast, "TryStar", ast.Try))):
+            s_body = self.exec_block(stmt.body, dict(state))
+            if s_body is not _TERMINATED and stmt.orelse:
+                s_body = self.exec_block(stmt.orelse, s_body)
+            # handlers run from the PRE-try state: the exception may have
+            # fired before any release in the body executed
+            ends = [s_body]
+            for handler in stmt.handlers:
+                ends.append(self.exec_block(handler.body, dict(state)))
+            merged = self._merge(ends)
+            fin_in = merged if merged is not _TERMINATED else dict(state)
+            fin_out = self.exec_block(stmt.finalbody, dict(fin_in))
+            if merged is _TERMINATED or fin_out is _TERMINATED:
+                return _TERMINATED
+            return fin_out
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._apply_simple(ast.Expr(item.context_expr), state)
+                if item.optional_vars is not None:
+                    for node in ast.walk(item.optional_vars):
+                        if isinstance(node, ast.Name):
+                            state.pop(node.id, None)
+            return self.exec_block(stmt.body, state)
+        match_cls = getattr(ast, "Match", None)
+        if match_cls is not None and isinstance(stmt, match_cls):
+            self._apply_simple(ast.Expr(stmt.subject), state)
+            ends = [self.exec_block(case.body, dict(state))
+                    for case in stmt.cases]
+            ends.append(dict(state))  # no case may match
+            return self._merge(ends)
+        return self._apply_simple(stmt, state)
+
+
+@register
+class PoolDiscipline(Rule):
+    id = "OTPU001"
+    name = "pool-discipline"
+    severity = "error"
+    description = ("pooled Message/CallbackData/marker used after "
+                   "release, or released twice along one path")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for qualname, fn in iter_functions(ctx.tree):
+            if not any(_release_calls(s) for s in fn.body):
+                continue
+            analysis = _FuncAnalysis(self, ctx, qualname)
+            analysis.run(fn.body)
+            yield from analysis.findings

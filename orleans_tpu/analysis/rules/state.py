@@ -1,0 +1,144 @@
+"""OTPU004 mutable-state-leak and OTPU005 unawaited-grain-call.
+
+OTPU004: in-silo calls pass results by reference after ``copy_result``
+isolation — but a grain method that does ``return self._rows`` hands the
+caller the grain's OWN container on the direct-interleave and testing
+paths, and the copy-isolation layer then shares structure across turns.
+Returning internal mutable state by reference breaks the actor isolation
+contract; return a copy.
+
+OTPU005: ``ref.method(...)`` on a grain reference returns a coroutine;
+dropping it on the floor means the call never happens (Python never
+schedules it) — the classic silent-no-op. Either ``await`` it, keep the
+handle (``t = ref.m()`` / ``asyncio.ensure_future(...)``), or mark intent
+with ``# otpu: ignore[OTPU005]`` for a deliberate drop.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..model import FileContext, Finding, Rule, register
+from .common import (
+    dotted_name,
+    iter_functions,
+    iter_grain_classes,
+    lexical_walk,
+)
+
+MUTABLE_CTORS = {
+    "list", "dict", "set", "bytearray", "defaultdict", "deque",
+    "Counter", "OrderedDict",
+}
+
+GRAIN_REF_PRODUCERS = {"get_grain", "get_ref", "grain_ref"}
+
+
+def _is_mutable_value(node: ast.expr) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        return dotted_name(node.func).rsplit(".", 1)[-1] in MUTABLE_CTORS
+    return False
+
+
+@register
+class MutableStateLeak(Rule):
+    id = "OTPU004"
+    name = "mutable-state-leak"
+    severity = "warning"
+    description = ("grain method returns a shared mutable internal "
+                   "by reference")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for cls_qual, cls in iter_grain_classes(ctx.tree):
+            mutable_attrs: set[str] = set()
+            for node in ast.walk(cls):
+                if isinstance(node, ast.Assign) and \
+                        _is_mutable_value(node.value):
+                    for t in node.targets:
+                        if isinstance(t, ast.Attribute) and \
+                                isinstance(t.value, ast.Name) and \
+                                t.value.id == "self":
+                            mutable_attrs.add(t.attr)
+                elif isinstance(node, ast.AnnAssign) and \
+                        node.value is not None and \
+                        _is_mutable_value(node.value) and \
+                        isinstance(node.target, ast.Attribute) and \
+                        isinstance(node.target.value, ast.Name) and \
+                        node.target.value.id == "self":
+                    mutable_attrs.add(node.target.attr)
+            if not mutable_attrs:
+                continue
+            for stmt in cls.body:
+                if not isinstance(stmt, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                for node in ast.walk(stmt):
+                    if isinstance(node, ast.Return) and \
+                            isinstance(node.value, ast.Attribute) and \
+                            isinstance(node.value.value, ast.Name) and \
+                            node.value.value.id == "self" and \
+                            node.value.attr in mutable_attrs:
+                        a = node.value.attr
+                        yield ctx.finding(
+                            self, node,
+                            f"returns shared mutable grain state "
+                            f"'self.{a}' by reference; return a copy "
+                            f"(e.g. list(self.{a}) / dict(self.{a}))",
+                            f"{cls_qual}.{stmt.name}")
+
+
+@register
+class UnawaitedGrainCall(Rule):
+    id = "OTPU005"
+    name = "unawaited-grain-call"
+    severity = "error"
+    description = ("grain-ref coroutine dropped without await or an "
+                   "explicit fire-and-forget marker")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for qualname, fn in iter_functions(ctx.tree):
+            # which Name-store nodes bind a grain ref (targets of
+            # `x = <something>.get_grain(...)` assignments)
+            ref_binds: set[int] = set()
+            for node in lexical_walk(fn):
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call) and \
+                        dotted_name(node.value.func).rsplit(".", 1)[-1] \
+                        in GRAIN_REF_PRODUCERS:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            ref_binds.add(id(t))
+            # single lexical pass: a rebind to anything else KILLS the
+            # ref-ness of the name, so `r = get_grain(..); r = conn();
+            # r.flush()` is not flagged
+            refs: set[str] = set()
+            for node in lexical_walk(fn):
+                if isinstance(node, ast.Name) and \
+                        isinstance(node.ctx, (ast.Store, ast.Del)):
+                    if id(node) in ref_binds:
+                        refs.add(node.id)
+                    else:
+                        refs.discard(node.id)
+                    continue
+                if not (isinstance(node, ast.Expr) and
+                        isinstance(node.value, ast.Call)):
+                    continue
+                call = node.value
+                if not isinstance(call.func, ast.Attribute):
+                    continue
+                recv = call.func.value
+                dropped = (isinstance(recv, ast.Name) and recv.id in refs) \
+                    or (isinstance(recv, ast.Call) and
+                        dotted_name(recv.func).rsplit(".", 1)[-1]
+                        in GRAIN_REF_PRODUCERS)
+                if dropped:
+                    yield ctx.finding(
+                        self, call,
+                        f"grain call '.{call.func.attr}(...)' result "
+                        "dropped — the coroutine is never scheduled; "
+                        "await it, keep the handle, or mark the drop "
+                        "with # otpu: ignore[OTPU005]", qualname)

@@ -17,6 +17,7 @@ Departures:
 from __future__ import annotations
 
 import itertools
+import os
 import time
 from dataclasses import dataclass, field
 from enum import IntEnum
@@ -28,6 +29,8 @@ __all__ = [
     "Category", "Direction", "ResponseKind", "RejectionType",
     "Message", "make_request", "make_response", "make_error_response",
     "make_rejection", "recycle_message",
+    "PoolDisciplineError", "set_debug_pool", "debug_pool_enabled",
+    "pool_generation", "assert_live", "assert_generation",
 ]
 
 
@@ -89,9 +92,14 @@ class Message:
         "is_unordered", "immutable", "cache_invalidation", "request_context",
         "is_new_placement", "transaction_info", "interface_version",
         "received_at",
-        # freelist bookkeeping only — NOT a dataclass field (no annotation),
-        # never crosses the wire (excluded from runtime.wire._HEADER_SLOTS)
-        "_pool_free",
+        # freelist bookkeeping only — NOT dataclass fields (no annotation),
+        # never cross the wire (excluded from runtime.wire._HEADER_SLOTS).
+        # _pool_gen is the debug-poisoning generation counter: bumped on
+        # every recycle under ORLEANS_TPU_DEBUG_POOL=1 so wire/dispatch
+        # paths can assert a shell they hold was not recycled (and maybe
+        # re-acquired) under them — the runtime double-check of what the
+        # OTPU001 static rule proves.
+        "_pool_free", "_pool_gen",
     )
 
     category: Category
@@ -168,6 +176,55 @@ class Message:
 _MSG_POOL: list["Message"] = []
 _MSG_POOL_CAP = 1024
 
+# Debug pool-poisoning (ORLEANS_TPU_DEBUG_POOL=1): recycle_message stamps a
+# per-shell generation counter and the wire/dispatch paths assert that a
+# shell they hold is neither sitting in the freelist (_pool_free) nor a
+# different incarnation than the one they captured (_pool_gen changed) —
+# the runtime double-check of what the OTPU001 static rule proves. Off by
+# default: the stamp/asserts cost nothing on the hot path when disabled
+# (call sites gate on the module flag before calling in).
+_DEBUG_POOL = os.environ.get("ORLEANS_TPU_DEBUG_POOL", "") not in ("", "0")
+
+
+class PoolDisciplineError(AssertionError):
+    """A pooled shell was used after recycle (or across a re-acquire)."""
+
+
+def set_debug_pool(enabled: bool) -> bool:
+    """Flip poisoning at runtime (tests); returns the previous setting."""
+    global _DEBUG_POOL
+    prev, _DEBUG_POOL = _DEBUG_POOL, bool(enabled)
+    return prev
+
+
+def debug_pool_enabled() -> bool:
+    return _DEBUG_POOL
+
+
+def pool_generation(m: Message) -> int:
+    """Current incarnation of a shell (0 until its first debug recycle)."""
+    return getattr(m, "_pool_gen", 0)
+
+
+def assert_live(m: Message, where: str) -> None:
+    """Poisoning check: the shell must not be in the freelist."""
+    if _DEBUG_POOL and getattr(m, "_pool_free", False):
+        raise PoolDisciplineError(
+            f"pooled Message used after recycle at {where} "
+            f"(id={getattr(m, 'id', '?')}, gen={pool_generation(m)})")
+
+
+def assert_generation(m: Message, gen: int, where: str) -> None:
+    """Poisoning check: the shell is live AND still the incarnation the
+    caller captured — catches recycle-and-reacquire under a holder."""
+    if not _DEBUG_POOL:
+        return
+    assert_live(m, where)
+    if pool_generation(m) != gen:
+        raise PoolDisciplineError(
+            f"pooled Message recycled under its holder at {where} "
+            f"(captured gen {gen}, now {pool_generation(m)})")
+
 
 def _fresh_message(*fields) -> Message:
     pool = _MSG_POOL
@@ -178,23 +235,36 @@ def _fresh_message(*fields) -> Message:
         return m
     m = Message(*fields)
     m._pool_free = False
+    m._pool_gen = 0
     return m
 
 
 def recycle_message(m: Message) -> None:
     """Return a dead envelope to the freelist. Idempotent (double release
-    is a no-op via ``_pool_free``); drops the shell when the pool is full.
-    Reference-carrying fields are cleared so a pooled shell cannot pin
-    user payloads or context dicts alive."""
-    if getattr(m, "_pool_free", False) or len(_MSG_POOL) >= _MSG_POOL_CAP:
+    is a no-op via ``_pool_free`` — the STATIC double-release check is
+    OTPU001's job); drops the shell when the pool is full. Reference-
+    carrying fields are cleared so a pooled shell cannot pin user payloads
+    or context dicts alive."""
+    if getattr(m, "_pool_free", False):
         return
+    pool_full = len(_MSG_POOL) >= _MSG_POOL_CAP
+    if pool_full and not _DEBUG_POOL:
+        return
+    if _DEBUG_POOL:
+        # stamp even when the shell is DROPPED (pool at cap): poisoning
+        # must keep detecting use-after-recycle on the busiest paths,
+        # which are exactly the ones that fill the pool. A dropped shell
+        # never re-enters service, so leaving it marked free is correct —
+        # any later touch is the bug the mode exists to catch.
+        m._pool_gen = pool_generation(m) + 1
     m._pool_free = True
     m.body = None
     m.request_context = None
     m.transaction_info = None
     m.cache_invalidation = None
     m.call_chain = ()
-    _MSG_POOL.append(m)
+    if not pool_full:
+        _MSG_POOL.append(m)
 
 
 def make_request(

@@ -275,15 +275,22 @@ class ReplicatedWorkerHost:
         # fill; classes needing a different identity must encode it in
         # their merge field choice).
 
+        # static closure value, hoisted deliberately (like `merge` above):
+        # reading self.* inside the traced body would freeze host object
+        # state into the kernel invisibly (OTPU006) — the shard count is a
+        # trace-time constant by construction (mesh size is fixed for the
+        # host's lifetime and the kernel cache is per-shape)
+        sharded = self.n_shards > 1
+
         def local(state, keys):
             st = jax.tree_util.tree_map(lambda a: a[0], state)
             rows = {f: st[f][keys] for f in st}
-            if self.n_shards > 1:
+            if sharded:
                 rows = {f: _MERGE_COLLECTIVES[merge[f]](v, SILO_AXIS)
                         for f, v in rows.items()}
             return jax.tree_util.tree_map(lambda a: a[None], rows)
 
-        if self.n_shards > 1:
+        if sharded:
             local = shard_map_compat(
                 local, mesh=self.mesh, in_specs=(P(SILO_AXIS), P()),
                 out_specs=P(None), check_vma=False)

@@ -327,6 +327,10 @@ class JournaledGrain(Grain):
         try:
             state, version = await self._adaptor.append(self, batch)
         except BaseException:
+            # deliberate post-await re-read: events raised DURING the
+            # failed append must survive behind the restored batch — the
+            # current value is wanted, not the pre-await one
+            # otpu: ignore[OTPU003]
             self._pending = batch + self._pending  # keep tentative view
             raise
         self._confirmed, self._version = state, version

@@ -24,6 +24,7 @@ from ..core.errors import (
     NonExistentActivationError,
     TransientPlacementError,
 )
+from ..core import message as _msg_mod
 from ..core.message import (
     Category,
     Direction,
@@ -386,6 +387,10 @@ class Dispatcher:
         first step without a Task was measured ~2µs cheaper and reverted:
         it breaks exactly that contract — wait_for during the inline step
         armed its timeout against the CALLER's task.)"""
+        if _msg_mod._DEBUG_POOL:
+            # pool poisoning: starting a turn on a recycled shell would
+            # invoke with another call's method/body
+            _msg_mod.assert_live(msg, "dispatcher._handle_incoming")
         activation.record_running(msg)
         self._track(asyncio.get_running_loop().create_task(
             self._run_turn(activation, msg)))
@@ -674,6 +679,8 @@ class Dispatcher:
 
     def transmit(self, msg: Message) -> None:
         """Hand to the message center: loopback locally, network otherwise."""
+        if _msg_mod._DEBUG_POOL:
+            _msg_mod.assert_live(msg, "dispatcher.transmit")
         if msg.target_silo is not None and \
                 msg.target_silo == self.silo.silo_address:
             self.receive_message(msg)

@@ -20,6 +20,7 @@ from ..core.errors import (
     SiloUnavailableError,
 )
 from ..core.ids import GrainId, SiloAddress
+from ..core import message as _msg_mod
 from ..core.message import (
     Category,
     Direction,
@@ -70,9 +71,12 @@ def _resolve_future(fut: asyncio.Future, value, exc) -> None:
 class CallbackData:
     """One outstanding request: future + timeout bookkeeping (CallbackData.cs).
     ``txn_info`` is the caller's ambient TransactionInfo (if any) so
-    callee joins piggybacked on the response can merge back into it."""
+    callee joins piggybacked on the response can merge back into it.
+    ``gen`` is the request shell's pool generation captured at registration
+    (debug pool-poisoning only, ORLEANS_TPU_DEBUG_POOL=1): the shell must
+    still be that incarnation when the response correlates back."""
 
-    __slots__ = ("message", "future", "deadline", "txn_info")
+    __slots__ = ("message", "future", "deadline", "txn_info", "gen")
 
     def __init__(self, message: Message, future: asyncio.Future,
                  deadline: float | None, txn_info=None):
@@ -80,6 +84,7 @@ class CallbackData:
         self.future = future
         self.deadline = deadline
         self.txn_info = txn_info
+        self.gen = None
 
 
 # CallbackData freelist (the BufferPool.cs discipline): one acquired per
@@ -97,8 +102,13 @@ def _fresh_callback(message: Message, future: asyncio.Future,
         cb.future = future
         cb.deadline = deadline
         cb.txn_info = txn_info
+        cb.gen = _msg_mod.pool_generation(message) \
+            if _msg_mod._DEBUG_POOL else None
         return cb
-    return CallbackData(message, future, deadline, txn_info)
+    cb = CallbackData(message, future, deadline, txn_info)
+    if _msg_mod._DEBUG_POOL:
+        cb.gen = _msg_mod.pool_generation(message)
+    return cb
 
 
 def _recycle_callback(cb: CallbackData) -> None:
@@ -376,7 +386,9 @@ class RuntimeClient:
         waiter wakeup were two separate loop iterations per call)."""
         if future.done():
             await asyncio.sleep(0)
-            return future.result()
+            # non-blocking by construction: the done() check above ran
+            # before the only await, and a done future cannot un-done
+            return future.result()  # otpu: ignore[OTPU002]
         return await future
 
     # -- response path (ReceiveResponse:569-627) --------------------------
@@ -390,8 +402,20 @@ class RuntimeClient:
             recycle_message(msg)
             return
         if cb.future.done():
+            # timed out / broken while in flight: the caller is gone and
+            # this response envelope is dead on arrival — same recycle
+            # rationale as the late/unknown path above. The REQUEST shell
+            # stays out of the pool (its turn may still be running).
             _recycle_callback(cb)
+            recycle_message(msg)
             return
+        if _msg_mod._DEBUG_POOL and cb.gen is not None:
+            # pool poisoning: the request shell registered with this
+            # callback must not have been recycled (and possibly handed to
+            # another call) while the RPC was outstanding — the dynamic
+            # twin of OTPU001's static proof
+            _msg_mod.assert_generation(cb.message, cb.gen,
+                                       "RuntimeClient.receive_response")
         # fold callee transaction joins back into the caller's ambient
         # info (the TransactionInfo response-header merge; idempotent for
         # the in-proc shared-object case)
@@ -434,6 +458,14 @@ class RuntimeClient:
                 # BreakOutstandingMessagesToDeadSilo for pinned targets)
                 _resolve_future(cb.future, None, SiloUnavailableError(
                     msg.rejection_info or "system target unreachable"))
+                # terminal rejection: the callback entry left the registry
+                # for good (popped above), so its shell and the rejection
+                # envelope go back to the freelists. The REQUEST shell is
+                # NOT recycled: on the in-proc path the rejecting silo's
+                # _reject frames may still be up-stack holding it, and
+                # rejections are rare enough that GC is fine.
+                _recycle_callback(cb)
+                recycle_message(msg)
                 return
             if (msg.rejection_type is not None
                     and cb.message.resend_count < MAX_RESEND_COUNT
@@ -468,15 +500,24 @@ class RuntimeClient:
                         self.transmit(m)
 
                 asyncio.get_running_loop().call_later(delay, _resend)
+                # the rejection envelope is dead once its fields were read
+                # above (_resend closes over cb.message, not msg): under
+                # rejection-retry storms this is the envelope churn the
+                # freelist exists for
+                recycle_message(msg)
                 return
             if msg.rejection_type is not None and \
                     msg.rejection_type.name == "GATEWAY_TOO_BUSY":
                 from ..core.errors import GatewayTooBusyError
                 _resolve_future(cb.future, None, GatewayTooBusyError(
                     msg.rejection_info or "gateway overloaded"))
+                _recycle_callback(cb)   # terminal: see system-target note
+                recycle_message(msg)
                 return
             _resolve_future(cb.future, None,
                             RejectionError(msg.rejection_info or "rejected"))
+            _recycle_callback(cb)       # terminal: see system-target note
+            recycle_message(msg)
 
     def break_outstanding_to_dead_silo(self, silo: SiloAddress) -> None:
         """``BreakOutstandingMessagesToDeadSilo:726``."""

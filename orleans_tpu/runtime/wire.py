@@ -28,6 +28,7 @@ import struct
 import time
 from typing import Any
 
+from ..core import message as _msg_mod
 from ..core.ids import SiloAddress
 from ..core.message import Message
 from ..core.serialization import deserialize, serialize, serialize_portable
@@ -115,11 +116,11 @@ async def frame_stream(reader: asyncio.StreamReader, chunk_size: int = 1 << 16):
 # Every Message slot except the lazily-decoded body (the headers/body split
 # of Message.HeadersContainer, Message.cs:725), expires_at (rebased),
 # received_at (a local monotonic arrival stamp, meaningless cross-process —
-# the receiver re-stamps on delivery), and _pool_free (freelist
+# the receiver re-stamps on delivery), and _pool_free/_pool_gen (freelist
 # bookkeeping, core.message.recycle_message).
 _HEADER_SLOTS = tuple(s for s in Message.__slots__
                       if s not in ("body", "expires_at", "received_at",
-                                   "_pool_free"))
+                                   "_pool_free", "_pool_gen"))
 
 # Enum-typed header fields ride the wire as plain ints (the native codec's
 # scalar fast path; pickling an IntEnum writes a by-reference class lookup).
@@ -160,6 +161,10 @@ def encode_message(msg: Message, native: bool = True) -> bytes:
     hotwire support (mixed-build cluster: a silo whose native build failed
     must still receive decodable frames; SerializationManager.cs:173-201
     negotiates serializers per registered type, we negotiate per link)."""
+    if _msg_mod._DEBUG_POOL:
+        # pool poisoning: serializing a recycled shell would put another
+        # call's (or zeroed) headers on the wire — fail loudly instead
+        _msg_mod.assert_live(msg, "wire.encode_message")
     ttl = None
     if msg.expires_at is not None:
         ttl = max(0.0, msg.expires_at - time.monotonic())
@@ -224,6 +229,7 @@ def decode_message(headers: bytes, body: bytes) -> Message:
     msg.expires_at = None if ttl is None else time.monotonic() + ttl
     msg.received_at = None  # local arrival stamp; tracing re-stamps
     msg._pool_free = False  # full slot set: consumers may walk __slots__
+    msg._pool_gen = 0       # fresh incarnation on this process
     try:
         msg.body = deserialize(body)
     except Exception as e:  # noqa: BLE001 — body failure is per-message

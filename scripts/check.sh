@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Repo check: static analyzer gate + tier-1 test suite.
+#
+# The analyzer self-run is ALSO part of the pytest suite
+# (tests/test_analysis.py::test_package_tree_has_no_unbaselined_findings),
+# so the tier-1 command alone enforces the gate; running it here first
+# just fails faster and prints the findings without the pytest wrapping.
+#
+# Usage: scripts/check.sh [extra pytest args]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== static analysis (orleans_tpu/ vs analysis/baseline.json) =="
+python -m orleans_tpu.analysis orleans_tpu/ --baseline analysis/baseline.json
+
+echo "== tier-1 tests =="
+JAX_PLATFORMS=cpu exec python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider "$@"

@@ -1,0 +1,16 @@
+"""OTPU006 known-bad: traced functions touching host state. Lives under a
+``dispatch/`` path segment on purpose — the rule scopes to device-tier
+directories (dispatch/, ops/, parallel/)."""
+import time
+
+import jax
+
+
+class TickHost:
+    def build_kernel(self):
+        def local(x):
+            self.hits += 1                      # line 12: host mutation
+            stamp = time.monotonic()            # line 13: impure call
+            self.log.append(stamp)              # line 14: captured mutation
+            return x * self.scale               # line 15: self capture
+        return jax.jit(local)

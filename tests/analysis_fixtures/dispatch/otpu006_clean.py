@@ -1,0 +1,19 @@
+"""OTPU006 known-clean: hoisted statics, functional state, jax.random."""
+import jax
+
+
+class TickHost:
+    def build_kernel(self):
+        # static closure values hoisted deliberately — the traced body
+        # reads locals, not self
+        scale = self.scale
+        n_shards = self.n_shards
+
+        def local(x, key):
+            noise = jax.random.normal(key, x.shape)
+            acc = []                    # local container: free to mutate
+            acc.append(x * scale)
+            if n_shards > 1:
+                acc.append(noise)
+            return sum(acc)
+        return jax.jit(local)

@@ -1,0 +1,25 @@
+"""OTPU001 known-bad: use-after-release and double-release."""
+from orleans_tpu.core.message import recycle_message
+
+
+def use_after_release(msg, transport):
+    recycle_message(msg)
+    transport.send(msg)                 # line 7: use after release
+
+
+def double_release(msg):
+    recycle_message(msg)
+    recycle_message(msg)                # line 12: released twice
+
+
+def released_on_all_paths(msg, cond, transport):
+    if cond:
+        recycle_message(msg)
+    else:
+        recycle_message(msg)
+    transport.send(msg)                 # line 20: released on every path
+
+
+def store_after_release(msg, registry):
+    recycle_message(msg)
+    registry[msg.id] = msg              # line 25: stored after release

@@ -1,0 +1,30 @@
+"""OTPU001 known-clean: release at end of life, branch-dependent release,
+rebinding after release."""
+from orleans_tpu.core.message import recycle_message
+
+
+def release_last(msg, transport):
+    transport.send(msg)
+    recycle_message(msg)
+
+
+def one_branch_only(msg, cond, transport):
+    if cond:
+        recycle_message(msg)
+        return
+    transport.send(msg)                 # unreleased on this path
+
+
+def rebound(msg, fresh):
+    recycle_message(msg)
+    msg = fresh()
+    return msg.id                       # rebound: a different object
+
+
+def released_in_handler(msg, transport):
+    try:
+        transport.send(msg)
+    except ConnectionError:
+        recycle_message(msg)
+        raise
+    return msg.id                       # only released on the raise path

@@ -1,0 +1,15 @@
+"""OTPU002 known-bad: blocking calls inside async turns."""
+import time
+
+
+async def sleepy_turn(self):
+    time.sleep(0.5)                     # line 6: blocks the event loop
+
+
+async def sync_result(fut):
+    return fut.result()                 # line 10: may block
+
+
+async def sync_file_io(path):
+    with open(path) as fh:              # line 14: sync file IO
+        return fh.read()

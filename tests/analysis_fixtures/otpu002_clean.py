@@ -1,0 +1,18 @@
+"""OTPU002 known-clean: async sleep, awaited futures, sync helpers."""
+import asyncio
+import time
+
+
+async def good_turn():
+    await asyncio.sleep(0.5)
+
+
+async def awaited(fut):
+    return await fut
+
+
+def sync_helper(path):
+    # sync code may block freely — it is not a turn
+    time.sleep(0.01)
+    with open(path) as fh:
+        return fh.read()

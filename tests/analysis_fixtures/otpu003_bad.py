@@ -1,0 +1,15 @@
+"""OTPU003 known-bad: write → await → stale read in a non-reentrant grain."""
+from orleans_tpu.runtime.grain import Grain
+
+
+class TransferGrain(Grain):
+    async def transfer(self, amount):
+        self.balance = self.balance - amount
+        await self.write_state()
+        return self.balance             # line 9: read after await
+
+    async def lost_update(self, n):
+        self.total = n
+        await self.notify()
+        self.total += 1                 # line 14: read-modify-write
+        return self.total

@@ -1,0 +1,24 @@
+"""OTPU003 known-clean: re-validation after the await, locals across
+awaits, and reentrant grains (hazard accepted by declaration)."""
+from orleans_tpu.runtime.grain import Grain, reentrant
+
+
+class CarefulGrain(Grain):
+    async def transfer(self, amount):
+        balance = self.balance - amount     # local carries across the await
+        await self.write_state()
+        return balance
+
+    async def revalidated(self, n):
+        self.total = n
+        await self.notify()
+        self.total = n + 1                  # rewritten after the await
+        return self.total
+
+
+@reentrant
+class DeclaredReentrant(Grain):
+    async def transfer(self, amount):
+        self.balance = self.balance - amount
+        await self.write_state()
+        return self.balance                 # reentrant: out of rule scope

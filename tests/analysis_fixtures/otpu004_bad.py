@@ -1,0 +1,14 @@
+"""OTPU004 known-bad: grain methods handing out internal containers."""
+from orleans_tpu.runtime.grain import Grain
+
+
+class RowsGrain(Grain):
+    def __init__(self):
+        self._rows = []
+        self._index = {}
+
+    async def rows(self):
+        return self._rows               # line 11: shared list escapes
+
+    async def index(self):
+        return self._index              # line 14: shared dict escapes

@@ -1,0 +1,17 @@
+"""OTPU004 known-clean: copies and immutable internals may be returned."""
+from orleans_tpu.runtime.grain import Grain
+
+
+class SafeRowsGrain(Grain):
+    def __init__(self):
+        self._rows = []
+        self._count = 0
+
+    async def rows(self):
+        return list(self._rows)         # defensive copy
+
+    async def count(self):
+        return self._count              # immutable scalar
+
+    async def tail(self):
+        return self._rows[-1]           # element, not the container

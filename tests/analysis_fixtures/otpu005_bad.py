@@ -1,0 +1,10 @@
+"""OTPU005 known-bad: dropped grain-call coroutines (never scheduled)."""
+
+
+async def forgot_await(factory, key):
+    ref = factory.get_grain("CounterGrain", key)
+    ref.add(1)                          # line 6: coroutine dropped
+
+
+async def chained_drop(factory, key):
+    factory.get_grain("CounterGrain", key).add(1)   # line 10: dropped

@@ -1,0 +1,18 @@
+"""OTPU005 known-clean: awaited, handled, or explicitly marked drops."""
+import asyncio
+
+
+async def awaited(factory, key):
+    ref = factory.get_grain("CounterGrain", key)
+    await ref.add(1)
+
+
+async def handle_kept(factory, key):
+    ref = factory.get_grain("CounterGrain", key)
+    task = asyncio.ensure_future(ref.add(1))
+    return await task
+
+
+async def marked_drop(factory, key):
+    ref = factory.get_grain("CounterGrain", key)
+    ref.add(1)  # otpu: ignore[OTPU005]

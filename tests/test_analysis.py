@@ -1,0 +1,356 @@
+"""Static analyzer tests: per-rule fixtures (exact ids + lines),
+suppressions, baseline round-trip, CLI exit codes — and the GATE: the
+analyzer self-run over ``orleans_tpu/`` against the checked-in baseline,
+which makes every tier-1 run a ratchet against new invariant violations."""
+
+import json
+import os
+
+from orleans_tpu.analysis import (
+    analyze_paths,
+    analyze_source,
+    load_baseline,
+    match_baseline,
+    write_baseline,
+)
+from orleans_tpu.analysis.__main__ import main as cli_main
+from orleans_tpu.analysis.model import RULES, all_rules
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+FIXTURES = os.path.join(HERE, "analysis_fixtures")
+
+
+def _scan(name: str):
+    """Findings for one fixture file (scanned via the directory so the
+    rule's path-scoping — dispatch/ for OTPU006 — stays in effect)."""
+    out = analyze_paths([FIXTURES])
+    return [f for f in out if os.path.basename(f.path) == name]
+
+
+# ---------------------------------------------------------------------------
+# Per-rule fixtures: exact rule ids and line numbers
+# ---------------------------------------------------------------------------
+
+# file → expected set of (rule, line); every *_clean fixture must be empty
+EXPECTED_BAD = {
+    "otpu001_bad.py": {("OTPU001", 7), ("OTPU001", 12), ("OTPU001", 20),
+                       ("OTPU001", 25)},
+    "otpu002_bad.py": {("OTPU002", 6), ("OTPU002", 10), ("OTPU002", 14)},
+    "otpu003_bad.py": {("OTPU003", 9), ("OTPU003", 14)},
+    "otpu004_bad.py": {("OTPU004", 11), ("OTPU004", 14)},
+    "otpu005_bad.py": {("OTPU005", 6), ("OTPU005", 10)},
+    "otpu006_bad.py": {("OTPU006", 12), ("OTPU006", 13), ("OTPU006", 14),
+                       ("OTPU006", 15)},
+}
+
+CLEAN = ["otpu001_clean.py", "otpu002_clean.py", "otpu003_clean.py",
+         "otpu004_clean.py", "otpu005_clean.py", "otpu006_clean.py",
+         "suppressed.py"]
+
+
+def test_every_rule_has_bad_and_clean_fixture():
+    rules = {r.id for r in all_rules()}
+    assert rules == {"OTPU001", "OTPU002", "OTPU003", "OTPU004",
+                     "OTPU005", "OTPU006"}
+    for rid in rules:
+        assert f"{rid.lower()}_bad.py" in EXPECTED_BAD
+        assert f"{rid.lower()}_clean.py" in CLEAN
+
+
+def test_bad_fixtures_exact_rule_ids_and_lines():
+    for fname, expected in EXPECTED_BAD.items():
+        got = {(f.rule, f.line) for f in _scan(fname)}
+        assert got == expected, f"{fname}: {got} != {expected}"
+
+
+def test_bad_fixtures_fire_only_their_own_rule():
+    for fname, expected in EXPECTED_BAD.items():
+        rule = next(iter(expected))[0]
+        assert {f.rule for f in _scan(fname)} == {rule}, fname
+
+
+def test_clean_fixtures_are_silent():
+    for fname in CLEAN:
+        assert _scan(fname) == [], fname
+
+
+def test_severities_come_from_rule():
+    by_rule = {r.id: r.severity for r in all_rules()}
+    for fname in EXPECTED_BAD:
+        for f in _scan(fname):
+            assert f.severity == by_rule[f.rule]
+
+
+# ---------------------------------------------------------------------------
+# Suppressions
+# ---------------------------------------------------------------------------
+
+def test_inline_suppression_same_line_and_preceding_comment():
+    src = (
+        "import time\n"
+        "async def t():\n"
+        "    time.sleep(1)  # otpu: ignore[OTPU002]\n"
+        "    # otpu: ignore[OTPU002]\n"
+        "    time.sleep(2)\n"
+        "    time.sleep(3)\n"
+    )
+    findings = analyze_source(src, "s.py")
+    assert [(f.rule, f.line) for f in findings] == [("OTPU002", 6)]
+
+
+def test_suppression_wrong_rule_id_does_not_silence():
+    src = ("import time\n"
+           "async def t():\n"
+           "    time.sleep(1)  # otpu: ignore[OTPU001]\n")
+    assert [f.rule for f in analyze_source(src, "s.py")] == ["OTPU002"]
+
+
+def test_bare_ignore_silences_all_rules():
+    src = ("import time\n"
+           "async def t():\n"
+           "    time.sleep(1)  # otpu: ignore\n")
+    assert analyze_source(src, "s.py") == []
+
+
+def test_suppression_on_multiline_statement_closing_line():
+    src = ("import time\n"
+           "async def t():\n"
+           "    time.sleep(\n"
+           "        1)  # otpu: ignore[OTPU002]\n")
+    assert analyze_source(src, "s.py") == []
+
+
+def test_otpu006_same_name_in_unrelated_scope_not_flagged():
+    src = ("import jax\n"
+           "class A:\n"
+           "    def build(self):\n"
+           "        def local(x):\n"
+           "            return x + self.offset\n"
+           "        return local\n"
+           "class B:\n"
+           "    def build(self):\n"
+           "        def local(x):\n"
+           "            return x * self.scale\n"
+           "        return jax.jit(local)\n")
+    findings = analyze_source(src, "orleans_tpu/dispatch/p.py")
+    assert [(f.rule, f.symbol) for f in findings] == \
+        [("OTPU006", "B.build.local")]
+
+
+def test_otpu003_tuple_assignment_counts_as_write():
+    src = ("from orleans_tpu.runtime.grain import Grain\n"
+           "class G(Grain):\n"
+           "    async def ok(self):\n"
+           "        self.x = 1\n"
+           "        await self.f()\n"
+           "        self.x, self.y = await self.g()\n"
+           "        return self.x\n"
+           "    async def bad(self):\n"
+           "        self.a, self.b = 1, 2\n"
+           "        await self.f()\n"
+           "        return self.a\n")
+    findings = analyze_source(src, "g.py")
+    assert [(f.rule, f.symbol) for f in findings] == \
+        [("OTPU003", "G.bad")]
+
+
+def test_otpu005_rebinding_kills_ref():
+    src = ("async def ok(factory):\n"
+           "    r = factory.get_grain('X', 1)\n"
+           "    r = connect()\n"
+           "    r.flush()\n"
+           "async def bad(factory):\n"
+           "    r = factory.get_grain('X', 1)\n"
+           "    r.add(1)\n")
+    findings = analyze_source(src, "g.py")
+    assert [(f.rule, f.line) for f in findings] == [("OTPU005", 7)]
+
+
+def test_overlapping_path_args_scan_once():
+    pkg = os.path.join(REPO, "orleans_tpu")
+    once = analyze_paths([pkg])
+    twice = analyze_paths([pkg, os.path.join(pkg, "storage", "core.py")])
+    assert len(twice) == len(once)
+
+
+def test_marker_inside_string_literal_does_not_suppress():
+    src = ('import time\n'
+           'async def t():\n'
+           '    time.sleep(bad("x # otpu: ignore"))\n')
+    assert [f.rule for f in analyze_source(src, "s.py")] == ["OTPU002"]
+
+
+def test_otpu006_local_scratch_object_writes_exempt():
+    src = ("import jax\n"
+           "def make(self):\n"
+           "    def local(x):\n"
+           "        box = Scratch()\n"
+           "        box.total = 1\n"
+           "        self.hits = 2\n"
+           "        return x\n"
+           "    return jax.jit(local)\n")
+    findings = analyze_source(src, "orleans_tpu/dispatch/p.py")
+    assert [(f.rule, f.line) for f in findings] == [("OTPU006", 6)]
+
+
+def test_absolute_file_arg_keeps_path_scoping():
+    """An absolute path must not collapse to a basename — that would
+    silently disable OTPU006's dispatch/ops/parallel scoping."""
+    target = os.path.join(FIXTURES, "dispatch", "otpu006_bad.py")
+    findings = analyze_paths([target])
+    assert findings and all(f.rule == "OTPU006" for f in findings)
+    assert "dispatch" in findings[0].path.split("/")
+
+
+def test_otpu006_subscripted_local_and_temporary_exempt():
+    src = ("import jax\n"
+           "def make(self, cfg):\n"
+           "    def local(x):\n"
+           "        out = [Scratch()]\n"
+           "        out[0].tag = 1\n"
+           "        f().attr = 2\n"
+           "        cfg.limit = 3\n"
+           "        return x\n"
+           "    return jax.jit(local)\n")
+    findings = analyze_source(src, "orleans_tpu/dispatch/p.py")
+    assert [(f.rule, f.line) for f in findings] == [("OTPU006", 7)]
+
+
+def test_otpu003_if_else_branches_are_exclusive():
+    src = ("from orleans_tpu.runtime.grain import Grain\n"
+           "class G(Grain):\n"
+           "    async def ok(self, cond):\n"
+           "        if cond:\n"
+           "            self.x = 1\n"
+           "            await self.f()\n"
+           "        else:\n"
+           "            print(self.x)\n"
+           "    async def bad(self, cond):\n"
+           "        if cond:\n"
+           "            self.x = 1\n"
+           "            await self.f()\n"
+           "        return self.x\n")
+    findings = analyze_source(src, "g.py")
+    assert [(f.rule, f.line, f.symbol) for f in findings] == \
+        [("OTPU003", 13, "G.bad")]
+
+
+def test_syntax_error_is_a_finding_not_a_crash():
+    findings = analyze_source("def broken(:\n", "b.py")
+    assert len(findings) == 1 and findings[0].rule == "OTPU000"
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+def test_baseline_round_trip_and_staleness(tmp_path):
+    findings = _scan("otpu001_bad.py")
+    assert findings
+    path = str(tmp_path / "b.json")
+    write_baseline(path, findings)
+    base = load_baseline(path)
+    new, stale = match_baseline(findings, base)
+    assert new == [] and not stale
+    # one finding fixed → its baseline entry is stale, none new
+    new, stale = match_baseline(findings[1:], base)
+    assert new == [] and sum(stale.values()) == 1
+    # a novel finding is NOT absorbed
+    other = _scan("otpu002_bad.py")
+    new, _ = match_baseline(findings + other, base)
+    assert {f.rule for f in new} == {"OTPU002"}
+
+
+def test_baseline_matching_survives_line_churn(tmp_path):
+    findings = _scan("otpu001_bad.py")
+    path = str(tmp_path / "b.json")
+    write_baseline(path, findings)
+    # same finding, different line (code above it moved): still matched
+    moved = [type(f)(f.rule, f.severity, f.path, f.line + 40, f.col,
+                     f.message, f.symbol) for f in findings]
+    new, stale = match_baseline(moved, load_baseline(path))
+    assert new == [] and not stale
+
+
+def test_baseline_file_is_sorted_and_deterministic(tmp_path):
+    findings = analyze_paths([FIXTURES])
+    p1, p2 = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    write_baseline(p1, findings)
+    write_baseline(p2, list(reversed(findings)))
+    with open(p1) as f1, open(p2) as f2:
+        assert f1.read() == f2.read()
+    entries = json.load(open(p1))["findings"]
+    keys = [(e["path"], e["line"], e["col"], e["rule"]) for e in entries]
+    assert keys == sorted(keys)
+
+
+# ---------------------------------------------------------------------------
+# CLI exit codes
+# ---------------------------------------------------------------------------
+
+def test_cli_exits_nonzero_on_bad_fixture(capsys):
+    rc = cli_main([os.path.join(FIXTURES, "otpu001_bad.py")])
+    assert rc == 1
+    assert "OTPU001" in capsys.readouterr().out
+
+
+def test_cli_exits_zero_on_clean_file(capsys):
+    rc = cli_main([os.path.join(FIXTURES, "otpu001_clean.py")])
+    assert rc == 0
+
+
+def test_cli_json_format(capsys):
+    rc = cli_main([os.path.join(FIXTURES, "otpu004_bad.py"),
+                   "--format", "json"])
+    assert rc == 1
+    data = json.loads(capsys.readouterr().out)
+    assert {f["rule"] for f in data["findings"]} == {"OTPU004"}
+
+
+def test_cli_rule_selection(capsys):
+    rc = cli_main([FIXTURES, "--rules", "OTPU003"])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "OTPU003" in out and "OTPU001" not in out
+
+
+def test_cli_unknown_rule_is_usage_error():
+    assert cli_main([FIXTURES, "--rules", "OTPU999"]) == 2
+
+
+def test_cli_filtered_run_does_not_report_stale(capsys):
+    """A --rules-filtered run cannot see findings outside the filter, so
+    it must not call their baseline entries stale."""
+    baseline = os.path.join(REPO, "analysis", "baseline.json")
+    rc = cli_main([os.path.join(REPO, "orleans_tpu"), "--rules", "OTPU001",
+                   "--baseline", baseline])
+    assert rc == 0
+    assert "stale" not in capsys.readouterr().err
+
+
+def test_cli_write_baseline_refuses_filters(tmp_path):
+    """A filtered --write-baseline would drop accepted findings outside
+    the filter from the ratchet — must refuse, not corrupt."""
+    out = str(tmp_path / "b.json")
+    assert cli_main([FIXTURES, "--write-baseline", out,
+                     "--rules", "OTPU001"]) == 2
+    assert cli_main([FIXTURES, "--write-baseline", out,
+                     "--min-severity", "error"]) == 2
+    assert not os.path.exists(out)
+    assert cli_main([FIXTURES, "--write-baseline", out]) == 0
+    assert os.path.exists(out)
+
+
+# ---------------------------------------------------------------------------
+# THE GATE: analyzer self-run over orleans_tpu/ vs the checked-in baseline
+# ---------------------------------------------------------------------------
+
+def test_package_tree_has_no_unbaselined_findings():
+    findings = analyze_paths([os.path.join(REPO, "orleans_tpu")])
+    baseline = load_baseline(os.path.join(REPO, "analysis",
+                                          "baseline.json"))
+    new, stale = match_baseline(findings, baseline)
+    assert not new, "new analyzer findings (fix, suppress, or baseline):\n" \
+        + "\n".join(f.render() for f in new)
+    assert not stale, f"stale baseline entries (regenerate): {stale}"

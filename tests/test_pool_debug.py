@@ -1,0 +1,223 @@
+"""Pool-discipline fixes + debug pool-poisoning (ORLEANS_TPU_DEBUG_POOL=1).
+
+Covers the release-site audit fixes in ``RuntimeClient.receive_response``
+(terminal rejections and dead-on-arrival responses now return their shells
+to the freelists) and the poisoning mode: ``recycle_message`` stamps a
+generation counter, and wire/dispatch paths assert when a recycled (or
+recycled-and-reacquired) shell is used — the runtime double-check of what
+the OTPU001 static rule proves.
+"""
+
+import asyncio
+
+import pytest
+
+from orleans_tpu.core.errors import RejectionError, SiloUnavailableError
+from orleans_tpu.core.ids import GrainId, GrainType
+from orleans_tpu.core.message import (
+    PoolDisciplineError,
+    RejectionType,
+    ResponseKind,
+    assert_generation,
+    assert_live,
+    make_rejection,
+    make_request,
+    pool_generation,
+    recycle_message,
+    set_debug_pool,
+)
+from orleans_tpu.runtime import ClusterClient, Grain, SiloBuilder
+from orleans_tpu.runtime import runtime_client as rc_mod
+from orleans_tpu.runtime.runtime_client import RuntimeClient
+from orleans_tpu.runtime.wire import encode_message
+
+
+@pytest.fixture
+def debug_pool():
+    prev = set_debug_pool(True)
+    try:
+        yield
+    finally:
+        set_debug_pool(prev)
+
+
+def _request(system_target=False):
+    if system_target:
+        from orleans_tpu.core.ids import SiloAddress
+        gid = GrainId.system_target(
+            7, SiloAddress("127.0.0.1", 1, generation=1))
+    else:
+        gid = GrainId.for_grain(GrainType.of("TestGrain"), 1)
+    return make_request(target_grain=gid, interface_name="TestGrain",
+                        method_name="m", body=((), {}))
+
+
+class _StubClient(RuntimeClient):
+    def __init__(self):
+        super().__init__()
+        self.sent = []
+
+    @property
+    def silo_address(self):
+        return None
+
+    def transmit(self, msg):
+        self.sent.append(msg)
+
+
+# ---------------------------------------------------------------------------
+# Release-site audit fixes (satellite of the OTPU001 rule)
+# ---------------------------------------------------------------------------
+
+async def test_terminal_rejection_releases_callback_and_envelope():
+    client = _StubClient()
+    msg = _request()
+    res = client._send(msg, False, None)
+    rej = make_rejection(msg, RejectionType.UNRECOVERABLE, "nope")
+    before = len(rc_mod._CB_POOL)
+    client.receive_response(rej)
+    with pytest.raises(RejectionError):
+        await res
+    assert len(rc_mod._CB_POOL) == before + 1   # cb shell back in pool
+    assert rej._pool_free                        # rejection envelope too
+    assert not msg._pool_free                    # request stays out (turn
+    client.close()                               # may still hold it)
+
+
+async def test_system_target_rejection_releases_callback():
+    client = _StubClient()
+    msg = _request(system_target=True)
+    res = client._send(msg, False, None)
+    rej = make_rejection(msg, RejectionType.TRANSIENT, "silo gone")
+    before = len(rc_mod._CB_POOL)
+    client.receive_response(rej)
+    with pytest.raises(SiloUnavailableError):
+        await res
+    assert len(rc_mod._CB_POOL) == before + 1
+    assert rej._pool_free
+    client.close()
+
+
+async def test_transient_resend_recycles_rejection_envelope():
+    """The resend branch schedules a retry of the REQUEST shell; the
+    rejection envelope itself is dead once its fields were read."""
+    client = _StubClient()
+    msg = _request()
+    res = client._send(msg, False, None)
+    rej = make_rejection(msg, RejectionType.TRANSIENT, "try elsewhere")
+    client.receive_response(rej)
+    assert rej._pool_free                        # envelope recycled
+    assert msg.id in client.callbacks            # request still in flight
+    assert not msg._pool_free
+    client.close()
+    with pytest.raises(SiloUnavailableError):
+        await res
+
+
+async def test_dead_on_arrival_response_is_recycled():
+    client = _StubClient()
+    msg = _request()
+    res = client._send(msg, False, None)
+    # simulate the sweeper: entry stays, future already failed
+    cb = client.callbacks[msg.id]
+    cb.future.set_exception(TimeoutError("gave up"))
+    resp = msg.created_response(ResponseKind.SUCCESS, "late")
+    client.receive_response(resp)
+    assert resp._pool_free                       # envelope recycled
+    with pytest.raises(TimeoutError):
+        await res
+    client.close()
+
+
+# ---------------------------------------------------------------------------
+# Debug pool-poisoning mode
+# ---------------------------------------------------------------------------
+
+def test_recycle_stamps_generation(debug_pool):
+    m = _request()
+    g = pool_generation(m)
+    recycle_message(m)
+    assert pool_generation(m) == g + 1
+    assert m._pool_free
+
+
+def test_assert_live_raises_on_recycled_shell(debug_pool):
+    m = _request()
+    recycle_message(m)
+    with pytest.raises(PoolDisciplineError):
+        assert_live(m, "test")
+
+
+def test_assert_generation_catches_recycle_under_holder(debug_pool):
+    m = _request()
+    g = pool_generation(m)
+    assert_generation(m, g, "test")              # live + same gen: fine
+    recycle_message(m)
+    m._pool_free = False                         # simulate re-acquire
+    with pytest.raises(PoolDisciplineError):
+        assert_generation(m, g, "test")          # gen moved under holder
+
+
+def test_recycle_at_pool_cap_still_poisons(debug_pool):
+    """A shell dropped because the freelist is full must still be marked
+    recycled — the busiest paths (which fill the pool) are exactly where
+    poisoning has to keep working."""
+    from orleans_tpu.core import message as msg_mod
+    cap = msg_mod._MSG_POOL_CAP
+    msg_mod._MSG_POOL_CAP = 0                    # force "pool full"
+    try:
+        m = _request()
+        g = pool_generation(m)
+        recycle_message(m)
+        assert m._pool_free and pool_generation(m) == g + 1
+        with pytest.raises(PoolDisciplineError):
+            assert_live(m, "test")
+    finally:
+        msg_mod._MSG_POOL_CAP = cap
+
+
+def test_asserts_are_noops_when_disabled():
+    prev = set_debug_pool(False)
+    try:
+        m = _request()
+        recycle_message(m)
+        assert_live(m, "test")                   # silent
+        assert_generation(m, 999, "test")        # silent
+    finally:
+        set_debug_pool(prev)
+
+
+def test_wire_refuses_to_encode_recycled_shell(debug_pool):
+    m = _request()
+    encode_message(m)                            # live: fine
+    recycle_message(m)
+    with pytest.raises(PoolDisciplineError):
+        encode_message(m)
+
+
+async def test_end_to_end_calls_clean_under_poisoning(debug_pool):
+    """A full request/response workout (messaging path forced) trips no
+    poisoning assert: the PR-3 release sites really are end-of-life."""
+
+    class EchoGrain(Grain):
+        async def echo(self, v):
+            return v
+
+        async def boom(self):
+            raise ValueError("kaboom")
+
+    silo = (SiloBuilder().with_name("dbgpool").add_grains(EchoGrain)
+            .build())
+    await silo.start()
+    client = await ClusterClient(silo.fabric).connect()
+    client.hot_lane_enabled = False              # force Message envelopes
+    silo.runtime_client.hot_lane_enabled = False
+    try:
+        g = client.get_grain(EchoGrain, 1)
+        results = await asyncio.gather(*(g.echo(i) for i in range(25)))
+        assert results == list(range(25))
+        with pytest.raises(ValueError):
+            await g.boom()
+    finally:
+        await client.close_async()
+        await silo.stop()
