@@ -38,22 +38,27 @@ class EchoGrain(Grain):
 async def bench_host_tier(n_grains: int, concurrency: int,
                           seconds: float,
                           trace_sample: float | None = None,
-                          hot_lane: bool = True) -> dict:
+                          hot_lane: bool = True,
+                          tail: bool = False) -> dict:
     """``trace_sample``: None runs untraced (no collector installed);
     a float enables distributed tracing at that head-sampling rate — the
     overhead-tracking variant wired into run_all and the perf floor.
     ``hot_lane=False`` forces every call onto the full messaging path
-    (the A/B lever for the hot-lane margin floor)."""
+    (the A/B lever for the hot-lane margin floor). ``tail=True`` turns on
+    tail-based retention (record at the head rate, keep/drop at trace
+    completion — the worst-case tail-record tax, since fast-clean pings
+    buffer, quiesce, and then drop every single trace)."""
     b = (SiloBuilder().with_name("ping-silo").add_grains(EchoGrain)
          .with_config(hot_lane_enabled=hot_lane))
     if trace_sample is not None:
-        b = b.with_config(trace_enabled=True, trace_sample_rate=trace_sample)
+        b = b.with_config(trace_enabled=True, trace_sample_rate=trace_sample,
+                          trace_tail_enabled=tail)
     silo = b.build()
     await silo.start()
     client = await ClusterClient(silo.fabric).connect()
     client.hot_lane_enabled = hot_lane
     if trace_sample is not None:
-        client.enable_tracing(trace_sample)
+        client.enable_tracing(trace_sample, tail=tail)
     grains = [client.get_grain(EchoGrain, k) for k in range(n_grains)]
 
     # warmup: activate every grain
@@ -86,6 +91,7 @@ async def bench_host_tier(n_grains: int, concurrency: int,
     await silo.stop()
     return {
         "metric": ("ping_host_calls_per_sec" if trace_sample is None
+                   else "ping_host_tail_traced_calls_per_sec" if tail
                    else "ping_host_traced_calls_per_sec"),
         "value": round(calls / elapsed, 1),
         "unit": "calls/sec",
@@ -105,11 +111,18 @@ async def bench_host_tier(n_grains: int, concurrency: int,
 
 
 async def bench_hotlane(n_grains: int = 256, concurrency: int = 100,
-                        seconds: float = 2.0) -> dict:
+                        seconds: float = 2.0,
+                        sampled_rate: float | None = 0.01) -> dict:
     """Hot-lane A/B: the same ping workload with the hot lane on vs forced
     onto the full messaging path, reporting the speedup and the hit ratio.
     Asserts the lane actually engaged (a silent 0% hit ratio would report
-    a meaningless speedup of ~1.0 and hide a regression)."""
+    a meaningless speedup of ~1.0 and hide a regression).
+
+    Third A/B point (``sampled_rate``): hot lane with a tracing collector
+    installed at a realistic sample rate ≪1. The lane rolls the
+    head-sample die itself, so the hit ratio must stay ≈ 1 - rate —
+    before the sampled-trace lane it collapsed to 0 whenever a collector
+    existed, paying full messaging cost for the 99% unsampled majority."""
     hot = await bench_host_tier(n_grains, concurrency, seconds,
                                 hot_lane=True)
     cold = await bench_host_tier(n_grains, concurrency, seconds,
@@ -117,19 +130,64 @@ async def bench_hotlane(n_grains: int = 256, concurrency: int = 100,
     ratio = hot["extra"]["hotlane_hit_ratio"]
     assert ratio is not None and ratio > 0.95, \
         f"hot lane engaged on only {ratio} of warm local calls"
+    extra = {
+        "messaging_calls_per_sec": cold["value"],
+        "speedup": round(hot["value"] / cold["value"], 2),
+        "hotlane_hit_ratio": ratio,
+        "n_grains": n_grains,
+        "concurrency": concurrency,
+        "p50_ms": hot["extra"]["p50_ms"],
+        "p99_ms": hot["extra"]["p99_ms"],
+    }
+    if sampled_rate is not None:
+        sampled = await bench_host_tier(n_grains, concurrency, seconds,
+                                        trace_sample=sampled_rate,
+                                        hot_lane=True)
+        sratio = sampled["extra"]["hotlane_hit_ratio"]
+        assert sratio is not None and sratio > 1 - sampled_rate - 0.05, \
+            f"hot lane engaged on only {sratio} of calls at " \
+            f"sample_rate={sampled_rate} — the lane is falling back on " \
+            f"the unsampled majority"
+        extra.update(
+            sampled_trace_rate=sampled_rate,
+            sampled_calls_per_sec=sampled["value"],
+            sampled_hit_ratio=sratio)
     return {
         "metric": "ping_hotlane_calls_per_sec",
         "value": hot["value"],
         "unit": "calls/sec",
         "vs_baseline": None,
+        "extra": extra,
+    }
+
+
+async def bench_trace_tail(n_grains: int = 128, concurrency: int = 50,
+                           seconds: float = 1.5) -> dict:
+    """trace_tail_overhead: tail-record mode (head rate 1.0, every trace
+    buffered then dropped as fast-clean) vs untraced ping, as a ratio —
+    interpreter-independent like the hot-lane margin. The floor companion
+    (tests/test_perf_floors.py) keeps this within 1.5x of the
+    trace_overhead budget.
+
+    Both sides run with the hot lane off: full-rate record forces the
+    messaging path anyway (a sampled call must carry trace headers), so a
+    hot-lane baseline would measure the lane's margin — already floored
+    separately — instead of the span-recording + tail-stage tax this
+    ratio exists to guard."""
+    base = await bench_host_tier(n_grains, concurrency, seconds,
+                                 hot_lane=False)
+    tail = await bench_host_tier(n_grains, concurrency, seconds,
+                                 trace_sample=1.0, tail=True,
+                                 hot_lane=False)
+    return {
+        "metric": "trace_tail_overhead",
+        "value": round(tail["value"] / base["value"], 3),
+        "unit": "ratio (tail-record / untraced)",
+        "vs_baseline": None,
         "extra": {
-            "messaging_calls_per_sec": cold["value"],
-            "speedup": round(hot["value"] / cold["value"], 2),
-            "hotlane_hit_ratio": ratio,
-            "n_grains": n_grains,
-            "concurrency": concurrency,
-            "p50_ms": hot["extra"]["p50_ms"],
-            "p99_ms": hot["extra"]["p99_ms"],
+            "untraced_calls_per_sec": base["value"],
+            "tail_traced_calls_per_sec": tail["value"],
+            "n_grains": n_grains, "concurrency": concurrency,
         },
     }
 

@@ -36,8 +36,13 @@ def main() -> None:
     # overhead, tracked in BENCH output against the untraced figure above
     print(json.dumps(asyncio.run(ping.bench_host_tier(
         n_grains=1000, concurrency=100, seconds=3.0, trace_sample=1.0))))
+    # tail-record mode overhead as a ratio vs untraced (every fast-clean
+    # ping buffers, quiesces, and drops — the tail stage's worst case)
+    print(json.dumps(asyncio.run(ping.bench_trace_tail(
+        n_grains=128, concurrency=50, seconds=1.5))))
     # hot-lane A/B: collapsed inline dispatch vs the full messaging path,
-    # with the hit ratio asserted in the harness (PR 3)
+    # with the hit ratio asserted in the harness (PR 3) + the
+    # sampled-trace point at rate 0.01 (the lane rolls the die itself)
     print(json.dumps(asyncio.run(ping.bench_hotlane(
         n_grains=256, concurrency=100, seconds=2.0))))
     print(json.dumps(asyncio.run(mapreduce.run())))

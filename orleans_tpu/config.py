@@ -190,18 +190,51 @@ class TracingOptions:
     """Distributed request tracing (observability.tracing): enable flag,
     head-based sampling rate (the ROOT of each trace rolls once; 0 keeps
     the collector installed but records nothing), and the per-silo span
-    ring-buffer capacity."""
+    ring-buffer capacity.
+
+    ``tail_*`` knobs enable tail-based retention: head sampling becomes a
+    record-locally pre-filter and the keep/drop decision defers until the
+    trace completes (root-span close + ``tail_window`` quiescence for
+    straggler legs) — keep only slow (``tail_slow_threshold`` seconds
+    absolute, and/or above ``tail_slow_percentile`` of recent roots),
+    errored, or force-retained traces. ``tail_leg_ttl`` bounds how long a
+    silo buffers legs of traces rooted elsewhere before expiring them
+    un-pulled; ``tail_max_pending`` bounds the undecided-trace buffer.
+
+    ``otlp_endpoint`` streams retained spans as OTLP/HTTP JSON to an
+    OpenTelemetry collector (export.OtlpSink) in ``otlp_batch_size``
+    batches flushed every ``otlp_flush_interval`` seconds; unset = no
+    sink, and an unreachable collector degrades to counted drops."""
 
     enabled: bool = False
     sample_rate: float = 1.0
     buffer_size: int = 4096
+    tail_enabled: bool = False
+    tail_window: float = 0.25
+    tail_slow_threshold: float = 0.1
+    tail_slow_percentile: float = 0.0
+    tail_leg_ttl: float = 2.0
+    tail_max_pending: int = 256
+    otlp_endpoint: str | None = None
+    otlp_batch_size: int = 64
+    otlp_flush_interval: float = 0.5
 
     def validate(self) -> None:
-        _positive(self, "buffer_size")
+        _positive(self, "buffer_size", "tail_window", "tail_leg_ttl",
+                  "tail_max_pending", "otlp_batch_size",
+                  "otlp_flush_interval")
         if not (0.0 <= self.sample_rate <= 1.0):
             raise ConfigurationError(
                 f"trace sample_rate must be within [0, 1], got "
                 f"{self.sample_rate!r}")
+        if not (0.0 <= self.tail_slow_percentile < 1.0):
+            raise ConfigurationError(
+                f"trace tail_slow_percentile must be within [0, 1), got "
+                f"{self.tail_slow_percentile!r}")
+        if self.tail_slow_threshold < 0:
+            raise ConfigurationError(
+                "trace tail_slow_threshold must be >= 0 "
+                "(0 disables the absolute threshold)")
 
 
 @dataclass
@@ -251,6 +284,15 @@ _FLAT_MAP = {
     "trace_enabled": (TracingOptions, "enabled"),
     "trace_sample_rate": (TracingOptions, "sample_rate"),
     "trace_buffer_size": (TracingOptions, "buffer_size"),
+    "trace_tail_enabled": (TracingOptions, "tail_enabled"),
+    "trace_tail_window": (TracingOptions, "tail_window"),
+    "trace_tail_slow_threshold": (TracingOptions, "tail_slow_threshold"),
+    "trace_tail_slow_percentile": (TracingOptions, "tail_slow_percentile"),
+    "trace_tail_leg_ttl": (TracingOptions, "tail_leg_ttl"),
+    "trace_tail_max_pending": (TracingOptions, "tail_max_pending"),
+    "trace_otlp_endpoint": (TracingOptions, "otlp_endpoint"),
+    "trace_otlp_batch_size": (TracingOptions, "otlp_batch_size"),
+    "trace_otlp_flush_interval": (TracingOptions, "otlp_flush_interval"),
 }
 
 

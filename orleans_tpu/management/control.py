@@ -105,12 +105,32 @@ class SiloControl:
 
     # -- distributed tracing (observability.tracing) ----------------------
     async def ctl_trace_spans(self, trace_id: int | None = None,
-                              limit: int | None = None) -> list[dict]:
+                              limit: int | None = None,
+                              pull: bool = False) -> list[dict]:
         """This silo's collected spans (optionally one trace); [] when
         tracing is disabled. The ManagementGrain merges these
-        cluster-wide for breakdowns and Perfetto export."""
+        cluster-wide for breakdowns and Perfetto export. Reads are pure —
+        in tail mode a trace_id query also shows that trace's pending
+        (undecided) legs without touching their fate.
+
+        ``pull=True`` is the retention-propagation form (the rooting
+        silo's `Silo._pull_trace_legs` sets it when it RETAINS a trace):
+        this silo's pending legs of that trace are handed off —
+        counted kept/pulled here, stored and exported by the puller —
+        instead of quietly expiring. Diagnostic callers must leave it
+        False so polling a live trace never mutates retention state."""
         tracer = self.silo.tracer
-        return [] if tracer is None else tracer.snapshot(trace_id, limit)
+        if tracer is None:
+            return []
+        if pull and trace_id is not None and tracer.tail:
+            return tracer.pull(trace_id, limit)
+        return tracer.snapshot(trace_id, limit)
+
+    async def ctl_retention_stats(self) -> dict:
+        """Tail-retention + export counters (kept/dropped/pulled/buffered,
+        OTLP exported/export_dropped); {} when tracing is disabled."""
+        tracer = self.silo.tracer
+        return {} if tracer is None else tracer.retention_stats()
 
     async def ctl_trace_breakdown(self, trace_id: int | None = None) -> dict:
         """Critical-path breakdown over THIS silo's spans (per-silo view;

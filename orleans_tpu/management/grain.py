@@ -97,6 +97,20 @@ class ManagementGrain(Grain):
         from ..observability.tracing import critical_path_breakdown
         return critical_path_breakdown(await self.get_trace_spans(trace_id))
 
+    async def get_retention_stats(self) -> dict:
+        """Cluster-wide tail-retention/export counters: per-silo snapshots
+        plus summed totals (kept/dropped/pulled/buffered/exported/
+        export_dropped) — the operator's answer to "is tail sampling
+        keeping the right amount"."""
+        per_silo = await self._fan_out("ctl_retention_stats")
+        totals: dict[str, int] = {}
+        for snap in per_silo.values():
+            for k, v in snap.items():
+                if isinstance(v, bool) or not isinstance(v, int):
+                    continue
+                totals[k] = totals.get(k, 0) + v
+        return {"totals": totals, "per_silo": per_silo}
+
     async def get_cluster_histogram(self, name: str) -> dict | None:
         """One named latency histogram aggregated across every silo
         (Histogram.merge over the per-bucket counts each SiloControl

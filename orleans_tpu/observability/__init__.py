@@ -1,13 +1,21 @@
 """Observability: statistics, device profiling, distributed tracing,
 management surface (reference L13)."""
 
-from .export import chrome_trace_events, write_chrome_trace  # noqa: F401
+from .export import (  # noqa: F401
+    OtlpSink,
+    chrome_trace_events,
+    spans_to_otlp,
+    write_chrome_trace,
+)
 from .profiling import Profiler, StepTimer, annotate, traced  # noqa: F401
 from .stats import REBALANCE_STATS, Histogram, StatsRegistry  # noqa: F401
 from .tracing import (  # noqa: F401
     TRACE_KEY,
+    LatencyErrorPolicy,
+    RetentionPolicy,
     Span,
     SpanCollector,
     critical_path_breakdown,
     current_trace,
+    span_from_dict,
 )

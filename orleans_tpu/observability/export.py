@@ -1,4 +1,4 @@
-"""Chrome-trace / Perfetto export for distributed traces.
+"""Chrome-trace / Perfetto export + streaming OTLP sink.
 
 Turns the spans collected by :mod:`orleans_tpu.observability.tracing`
 — typically merged from every silo of a cluster plus the client — into
@@ -10,6 +10,14 @@ network → queue wait → turn execution reads left-to-right across the
 process rows it touched. Span attrs (queue_s/exec_s, forward counts,
 migration outcomes) land in ``args`` for the selection panel.
 
+:class:`OtlpSink` is the live counterpart: it streams finished/retained
+spans as OTLP/HTTP JSON (the `opentelemetry-proto` JSON mapping over
+plain ``urllib`` — no exporter dependency) to a collector endpoint in
+bounded batches with retry/backoff, so traces land in Jaeger/Tempo/any
+OTel collector instead of per-test Chrome files. An unreachable
+collector degrades to counted drops; it can never stall or break the
+runtime that feeds it.
+
 Device-side XLA kernel timelines come from ``jax.profiler`` capture
 (:mod:`orleans_tpu.observability.profiling`); the dispatch engine opens a
 ``TraceAnnotation`` per tick named like the logical tick span, so the two
@@ -18,9 +26,17 @@ captures correlate by name when viewed together.
 
 from __future__ import annotations
 
+import asyncio
 import json
+import logging
+import urllib.error
+import urllib.request
+from collections import deque
 
-__all__ = ["chrome_trace_events", "write_chrome_trace"]
+__all__ = ["chrome_trace_events", "write_chrome_trace",
+           "OtlpSink", "spans_to_otlp"]
+
+log = logging.getLogger("orleans.export")
 
 
 def chrome_trace_events(spans) -> list[dict]:
@@ -77,3 +93,211 @@ def write_chrome_trace(path: str, spans) -> str:
     with open(path, "w") as f:
         json.dump(payload, f)
     return path
+
+
+# ---------------------------------------------------------------------------
+# OTLP/HTTP streaming sink
+# ---------------------------------------------------------------------------
+
+# our span kinds → OTLP SpanKind enum (opentelemetry-proto trace.proto):
+# 1=INTERNAL, 2=SERVER, 3=CLIENT
+_OTLP_KIND = {"client": 3, "directory": 3, "server": 2}
+
+
+def _otlp_value(v) -> dict:
+    """One attribute value in the OTLP JSON AnyValue encoding."""
+    if isinstance(v, bool):
+        return {"boolValue": v}
+    if isinstance(v, int):
+        return {"intValue": str(v)}  # proto-JSON carries int64 as string
+    if isinstance(v, float):
+        return {"doubleValue": v}
+    return {"stringValue": str(v)}
+
+
+def _otlp_attrs(attrs: dict) -> list[dict]:
+    return [{"key": k, "value": _otlp_value(v)} for k, v in attrs.items()]
+
+
+def spans_to_otlp(span_dicts, service_name: str = "orleans_tpu") -> dict:
+    """Convert ``Span.to_dict`` forms into one OTLP/HTTP JSON
+    ``ExportTraceServiceRequest``. Our 63-bit ids zero-pad into OTLP's
+    128-bit trace / 64-bit span hex ids; ``error`` attrs map to status
+    ERROR; span events carry through as OTLP span events. The silo name
+    rides per span (``orleans.silo``) because one batch can merge legs
+    pulled from several silos, while the resource names the exporting
+    process."""
+    out_spans = []
+    for s in span_dicts:
+        attrs = dict(s.get("attrs") or {})
+        err = attrs.pop("error", None)
+        span = {
+            "traceId": f"{s['trace_id']:032x}",
+            "spanId": f"{s['span_id']:016x}",
+            "name": s["name"],
+            "kind": _OTLP_KIND.get(s["kind"], 1),
+            "startTimeUnixNano": str(int(s["start"] * 1e9)),
+            "endTimeUnixNano": str(
+                int((s["start"] + s.get("duration", 0.0)) * 1e9)),
+            "attributes": _otlp_attrs(attrs) + [
+                {"key": "orleans.silo",
+                 "value": {"stringValue": s.get("silo") or "?"}},
+                {"key": "orleans.kind",
+                 "value": {"stringValue": s["kind"]}},
+            ],
+            "status": ({"code": 2, "message": str(err)}
+                       if err is not None else {}),
+        }
+        if s.get("parent_id"):
+            span["parentSpanId"] = f"{s['parent_id']:016x}"
+        events = s.get("events")
+        if events:
+            span["events"] = [
+                {"timeUnixNano": str(int(ts * 1e9)), "name": name,
+                 "attributes": _otlp_attrs(ev_attrs or {})}
+                for name, ts, ev_attrs in events]
+        out_spans.append(span)
+    return {"resourceSpans": [{
+        "resource": {"attributes": [
+            {"key": "service.name",
+             "value": {"stringValue": service_name}}]},
+        "scopeSpans": [{
+            "scope": {"name": "orleans_tpu.observability.tracing"},
+            "spans": out_spans,
+        }],
+    }]}
+
+
+class OtlpSink:
+    """Streaming OTLP/HTTP exporter with the OTel-collector queue
+    discipline: bounded buffer (overflow drops oldest + counts), batches
+    of ``batch_size`` flushed every ``flush_interval`` seconds or as soon
+    as a full batch accumulates, per-batch retry with exponential backoff,
+    and give-up-drop when the collector stays unreachable. The POST runs
+    in a thread executor so the event loop never blocks on the socket.
+
+    Attach to a collector: ``collector.sinks.append(OtlpSink(endpoint))``
+    — or let the silo wire it from ``trace_otlp_endpoint``."""
+
+    def __init__(self, endpoint: str, *, service_name: str = "orleans_tpu",
+                 batch_size: int = 64, flush_interval: float = 0.5,
+                 max_queue: int = 2048, max_retries: int = 2,
+                 retry_backoff: float = 0.05, timeout: float = 2.0):
+        self.endpoint = endpoint
+        self.service_name = service_name
+        self.batch_size = batch_size
+        self.flush_interval = flush_interval
+        self.max_queue = max_queue
+        self.max_retries = max_retries
+        self.retry_backoff = retry_backoff
+        self.timeout = timeout
+        self._q: deque[dict] = deque()
+        self._task: asyncio.Task | None = None
+        self._wake: asyncio.Event | None = None
+        self._closing = False
+        self.exported = 0          # spans shipped
+        self.exported_batches = 0  # successful POSTs
+        self.dropped = 0           # spans given up on (overflow/unreachable)
+        self.retries = 0           # retry attempts (observability of flap)
+
+    # -- producer side (called by SpanCollector, sync, hot-ish path) ------
+    def offer(self, span_dicts) -> None:
+        q = self._q
+        for d in span_dicts:
+            if len(q) >= self.max_queue:
+                q.popleft()
+                self.dropped += 1
+            q.append(d)
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return  # no loop (sync tests): spans wait for an explicit flush
+        if self._task is None or self._task.done():
+            self._task = loop.create_task(self._run())
+        if self._wake is not None and len(q) >= self.batch_size:
+            self._wake.set()
+
+    # -- flusher -----------------------------------------------------------
+    async def _run(self) -> None:
+        self._wake = wake = asyncio.Event()
+        try:
+            while self._q:
+                try:
+                    await asyncio.wait_for(wake.wait(), self.flush_interval)
+                except asyncio.TimeoutError:
+                    pass
+                wake.clear()
+                await self.flush()
+        finally:
+            self._wake = None
+
+    async def flush(self) -> None:
+        """Ship everything queued, one bounded batch at a time."""
+        q = self._q
+        while q:
+            n = min(len(q), self.batch_size)
+            batch = [q.popleft() for _ in range(n)]
+            if await self._send(batch):
+                self.exported += n
+                self.exported_batches += 1
+            else:
+                self.dropped += n
+                if self._closing:
+                    # teardown with an unreachable collector: one failed
+                    # probe is enough evidence — drop the rest instead of
+                    # paying the timeout per batch (silo.stop must not
+                    # hang minutes on a dead exporter)
+                    self.dropped += len(q)
+                    q.clear()
+
+    async def _send(self, batch: list[dict]) -> bool:
+        body = json.dumps(
+            spans_to_otlp(batch, self.service_name)).encode()
+        loop = asyncio.get_running_loop()
+        attempts = 1 if self._closing else self.max_retries + 1
+        for attempt in range(attempts):
+            try:
+                await loop.run_in_executor(None, self._post, body)
+                return True
+            except Exception as e:  # noqa: BLE001 — collector flap/absence
+                if attempt + 1 >= attempts:
+                    log.debug("OTLP export to %s failed after %d attempts: "
+                              "%s", self.endpoint, attempt + 1, e)
+                    return False
+                self.retries += 1
+                await asyncio.sleep(self.retry_backoff * (2 ** attempt))
+        return False
+
+    def _post(self, body: bytes) -> None:
+        # sync on purpose: runs in the executor thread, never on the loop
+        req = urllib.request.Request(
+            self.endpoint, data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            if resp.status >= 400:  # urlopen raises on most, belt+braces
+                raise urllib.error.HTTPError(
+                    self.endpoint, resp.status, "collector rejected batch",
+                    resp.headers, None)
+
+    async def aclose(self, flush: bool = True) -> None:
+        self._closing = True  # single-attempt sends + drop-on-first-failure
+        if flush and self._q:
+            try:
+                await self.flush()
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                pass
+        t = self._task
+        if t is not None and not t.done():
+            t.cancel()
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        self._task = None
+
+    def stats(self) -> dict:
+        return {"exported": self.exported,
+                "export_batches": self.exported_batches,
+                "export_dropped": self.dropped,
+                "export_retries": self.retries,
+                "queued": len(self._q)}

@@ -35,6 +35,7 @@ from ..core.message import (
     make_response,
 )
 from ..observability.tracing import (
+    TRACE_KEY,
     context_from_headers,
     current_trace,
     restamp_header,
@@ -424,11 +425,14 @@ class Dispatcher:
                     trace_id, parent_id)
                 tspan.start = recv_wall
                 ttoken = current_trace.set((trace_id, tspan.span_id))
+        turn_error = None
         try:
             result = await self.invoke(activation, msg)
             if msg.direction == Direction.REQUEST:
                 resp = make_response(msg, copy_result(result))
                 self._attach_txn_joins(resp)
+                if tspan is not None:
+                    self._stamp_response(resp, tspan)
                 self.send_response(msg, resp)
         except asyncio.CancelledError:
             # silo stop/kill abandoned this turn: no response through a
@@ -436,9 +440,12 @@ class Dispatcher:
             # request is broken by runtime_client.close() instead
             raise
         except BaseException as e:  # noqa: BLE001 — grain errors flow to caller
+            turn_error = type(e).__name__
             if msg.direction == Direction.REQUEST:
                 resp = make_error_response(msg, e)
                 self._attach_txn_joins(resp)
+                if tspan is not None:
+                    self._stamp_response(resp, tspan)
                 self.send_response(msg, resp)
             else:
                 log.exception("one-way turn failed on %s.%s",
@@ -462,12 +469,29 @@ class Dispatcher:
                 self.silo.stats.observe("scheduler.turn_length", elapsed)
             if tspan is not None:
                 current_trace.reset(ttoken)
-                tracer.close(tspan, duration=t_queue + elapsed,
-                             queue_s=t_queue, exec_s=elapsed)
+                if turn_error is not None:
+                    # the error attr is what tail retention keys on for
+                    # silo-rooted traces (errored traces always survive)
+                    tracer.close(tspan, duration=t_queue + elapsed,
+                                 queue_s=t_queue, exec_s=elapsed,
+                                 error=turn_error)
+                else:
+                    tracer.close(tspan, duration=t_queue + elapsed,
+                                 queue_s=t_queue, exec_s=elapsed)
             RequestContext.clear()
             current_activation.reset(token_a)
             activation.reset_running(msg)
             self.run_message_pump(activation)
+
+    @staticmethod
+    def _stamp_response(resp: Message, tspan) -> None:
+        """Send-side wall stamp on the response envelope (the request-leg
+        twin lives in the TRACE_KEY header stamped at client send): the
+        caller's receive_response measures stamp → arrival as the
+        response-leg network span. Responses of unsampled turns carry no
+        header and pay nothing."""
+        resp.request_context = {
+            TRACE_KEY: (tspan.trace_id, tspan.span_id, time.time())}
 
     @staticmethod
     def _attach_txn_joins(resp: Message) -> None:
@@ -700,6 +724,15 @@ class Dispatcher:
     def _reject(self, msg: Message, rtype: RejectionType, info: str) -> None:
         if msg.direction == Direction.ONE_WAY:
             return
+        tracer = self.silo.tracer
+        if tracer is not None:
+            hdr = context_from_headers(msg.request_context)
+            if hdr is not None:
+                # zero-duration annotation parented under the caller's
+                # invoke span: a traced call that bounced here shows the
+                # rejection in its tree instead of unexplained retry time
+                tracer.event(hdr[0], hdr[1], "reject", type=rtype.name,
+                             info=info)
         rej = make_rejection(msg, rtype, info)
         rej.target_silo = msg.sending_silo
         self.transmit(rej)
@@ -712,6 +745,13 @@ class Dispatcher:
             msg.target_silo = None
             msg.target_activation = None
             if self.silo.tracer is not None:
+                hdr = context_from_headers(msg.request_context)
+                if hdr is not None:
+                    # annotate the forward hop under the caller's invoke
+                    # span (event spans are breakdown-neutral)
+                    self.silo.tracer.event(hdr[0], hdr[1], "forward",
+                                           hop=msg.forward_count,
+                                           reason=reason)
                 # the message leaves again: reset the arrival stamp and
                 # refresh the header's sent_at so the NEXT silo's queue/
                 # network spans measure only their own leg, not ours

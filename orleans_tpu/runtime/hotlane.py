@@ -23,9 +23,12 @@ the hot lane never has to replicate rare-path semantics:
 * any call filter is registered (outgoing, silo incoming, or a grain-level
   ``on_incoming_call`` hook) — interception fires identically regardless
   of placement;
-* tracing could sample this call (collector installed with a non-zero
-  rate, or an ambient trace context to propagate) — sampled traces keep
-  their intact span tree;
+* tracing actually sampled this call — the lane rolls the head-sample die
+  itself (collector installed with a non-zero rate) and hands a winning
+  roll to the messaging path via ``SpanCollector.presampled``, so at
+  sample rates ≪1 only the sampled minority leaves the lane and sampled
+  traces keep their intact span tree; an ambient trace context to
+  propagate always falls back;
 * ambient RequestContext baggage, including a transaction context — the
   header round-trip (TransactionInfo piggyback) only exists on the
   messaging path;
@@ -143,10 +146,8 @@ def try_hot_invoke(client, silo: "Silo", grain_id, grain_class: type,
     d = getattr(instance, "__dict__", None)
     if d is not None and (method_name in d or "on_incoming_call" in d):
         return None
-    tracer = client.tracer
-    if (tracer is not None and tracer.sample_rate > 0) or \
-            current_trace.get() is not None:
-        return None  # this call could root or continue a sampled trace
+    if current_trace.get() is not None:
+        return None  # continuing a sampled trace: headers must propagate
     if _request_context.get():
         return None  # baggage/txn context rides message headers
     for a in args:
@@ -161,14 +162,30 @@ def try_hot_invoke(client, silo: "Silo", grain_id, grain_class: type,
     chain = current_call_chain()
     if not _gate_admits(act, inv, is_read_only, grain_id, chain):
         return None
+    tracer = client.tracer
+    if tracer is not None and tracer.sample_rate > 0:
+        # sampled-trace hot lane: roll the head-sample die HERE instead of
+        # declining whenever a collector is installed — at sample rates
+        # ≪1 the lane keeps serving the unsampled majority and only the
+        # sampled minority pays the messaging path. The roll is handed to
+        # send_request via the collector's one-shot ``presampled`` slot
+        # (consumed synchronously in this same step), so the effective
+        # rate stays exactly ``sample_rate``, never its square. Rolled
+        # LAST, after every other decline: a call the lane turns away for
+        # a different reason must reach the messaging path un-rolled, or
+        # its record probability would double.
+        if tracer.sample():
+            tracer.presampled = True
+            return None
     return _hot_turn(client, silo, act, inv, grain_id, grain_class,
-                     interface_name, args, kwargs, is_read_only, chain)
+                     interface_name, args, kwargs, is_read_only, chain,
+                     tracer)
 
 
 async def _hot_turn(client, silo: "Silo", act: "ActivationData", inv,
                     grain_id, grain_class: type, interface_name: str,
                     args: tuple, kwargs: dict, is_read_only: bool,
-                    chain: tuple):
+                    chain: tuple, admitted_tracer):
     """The collapsed turn: copy-isolate, run gated on a pooled running
     marker, copy-isolate the result, pump, once-per-RPC fairness yield.
     Error semantics match the messaging path (the grain's exception object
@@ -188,15 +205,32 @@ async def _hot_turn(client, silo: "Silo", act: "ActivationData", inv,
     instance = act.grain_instance
     d = getattr(instance, "__dict__", None)
     tracer = client.tracer
+    # tracer re-verify: admission already rolled (and lost) the sampling
+    # die against ``admitted_tracer``, so running inline IS the unsampled
+    # outcome — re-rolling here would skew the rate. Only a collector
+    # INSTALLED/SWAPPED since admission (which never got a roll) forces
+    # the messaging path, preserving the old install-after-creation guard.
     if (act.state is not ActivationState.VALID
             or not silo.invokers.entry(act.grain_class).hot_ok
             or client.outgoing_call_filters
-            or (tracer is not None and tracer.sample_rate > 0)
+            or (tracer is not admitted_tracer and tracer is not None
+                and tracer.sample_rate > 0)
             or current_trace.get() is not None
             or (d is not None and (inv.name in d or "on_incoming_call" in d))
             or not _gate_admits(act, inv, is_read_only, grain_id, chain)):
         client.hot_hits -= 1
         client.hot_fallbacks += 1
+        if (tracer is admitted_tracer and tracer is not None
+                and tracer.sample_rate > 0
+                and not client.outgoing_call_filters
+                and current_trace.get() is None):
+            # admission already rolled (and lost) the head-sample die for
+            # this call — hand the UNSAMPLED outcome over too, or the
+            # messaging path would re-roll and double this call class's
+            # record probability. Skipped when filters appeared since
+            # (their deferred send consumes the slot in a later task,
+            # where it could suppress a different call's roll).
+            tracer.presampled = False
         # send_request, not _send_request_unfiltered: an outgoing filter
         # registered since coroutine creation must wrap this call too
         return await client.send_request(

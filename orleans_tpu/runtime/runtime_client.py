@@ -30,7 +30,11 @@ from ..core.message import (
     recycle_message,
 )
 from ..core.serialization import copy_call_body, deep_copy
-from ..observability.tracing import TRACE_KEY, current_trace
+from ..observability.tracing import (
+    TRACE_KEY,
+    context_from_headers,
+    current_trace,
+)
 from .cancellation import register_outgoing_tokens
 from .context import (
     TXN_KEY,
@@ -74,17 +78,20 @@ class CallbackData:
     callee joins piggybacked on the response can merge back into it.
     ``gen`` is the request shell's pool generation captured at registration
     (debug pool-poisoning only, ORLEANS_TPU_DEBUG_POOL=1): the shell must
-    still be that incarnation when the response correlates back."""
+    still be that incarnation when the response correlates back.
+    ``span`` is the still-open client invoke span for sampled calls (None
+    otherwise) so rejection/resend events can attach to it mid-flight."""
 
-    __slots__ = ("message", "future", "deadline", "txn_info", "gen")
+    __slots__ = ("message", "future", "deadline", "txn_info", "gen", "span")
 
     def __init__(self, message: Message, future: asyncio.Future,
-                 deadline: float | None, txn_info=None):
+                 deadline: float | None, txn_info=None, span=None):
         self.message = message
         self.future = future
         self.deadline = deadline
         self.txn_info = txn_info
         self.gen = None
+        self.span = span
 
 
 # CallbackData freelist (the BufferPool.cs discipline): one acquired per
@@ -94,7 +101,8 @@ _CB_POOL_CAP = 1024
 
 
 def _fresh_callback(message: Message, future: asyncio.Future,
-                    deadline: float | None, txn_info) -> CallbackData:
+                    deadline: float | None, txn_info,
+                    span=None) -> CallbackData:
     pool = _CB_POOL
     if pool:
         cb = pool.pop()
@@ -104,8 +112,9 @@ def _fresh_callback(message: Message, future: asyncio.Future,
         cb.txn_info = txn_info
         cb.gen = _msg_mod.pool_generation(message) \
             if _msg_mod._DEBUG_POOL else None
+        cb.span = span
         return cb
-    cb = CallbackData(message, future, deadline, txn_info)
+    cb = CallbackData(message, future, deadline, txn_info, span)
     if _msg_mod._DEBUG_POOL:
         cb.gen = _msg_mod.pool_generation(message)
     return cb
@@ -115,6 +124,7 @@ def _recycle_callback(cb: CallbackData) -> None:
     cb.message = None
     cb.future = None
     cb.txn_info = None
+    cb.span = None
     if len(_CB_POOL) < _CB_POOL_CAP:
         _CB_POOL.append(cb)
 
@@ -147,11 +157,40 @@ class RuntimeClient:
         self.hot_lane_enabled = True
 
     def enable_tracing(self, sample_rate: float = 1.0,
-                       buffer_size: int = 4096, name: str = "client"):
+                       buffer_size: int = 4096, name: str = "client", *,
+                       tail: bool = False, tail_window: float = 0.25,
+                       slow_threshold: float | None = None,
+                       slow_percentile: float | None = None,
+                       leg_ttl: float | None = None,
+                       max_pending: int = 256,
+                       policy=None, otlp_endpoint: str | None = None):
         """Install a SpanCollector so calls through this client open
-        root client spans (head-based sampling at ``sample_rate``)."""
-        from ..observability.tracing import SpanCollector
-        self.tracer = SpanCollector(name, sample_rate, buffer_size)
+        root client spans (head-based sampling at ``sample_rate``).
+        ``tail=True`` defers keep/drop to trace completion (slow/errored/
+        forced survive — see TracingOptions.tail_*); ``otlp_endpoint``
+        attaches a streaming OTLP/HTTP sink for retained spans."""
+        from ..observability.tracing import (LatencyErrorPolicy,
+                                             SpanCollector)
+        if policy is None and (slow_threshold is not None
+                               or slow_percentile is not None):
+            # an omitted threshold keeps the class default (matching the
+            # silo-side SiloConfig default) so one with_tracing() call
+            # yields the SAME policy for client- and silo-rooted traces
+            policy = LatencyErrorPolicy(
+                LatencyErrorPolicy().slow_threshold
+                if slow_threshold is None else slow_threshold,
+                slow_percentile or 0.0)
+        kw = {}
+        if leg_ttl is not None:
+            kw["leg_ttl"] = leg_ttl
+        self.tracer = SpanCollector(name, sample_rate, buffer_size,
+                                    tail=tail, tail_window=tail_window,
+                                    policy=policy, max_pending=max_pending,
+                                    **kw)
+        if otlp_endpoint:
+            from ..observability.export import OtlpSink
+            self.tracer.sinks.append(OtlpSink(otlp_endpoint,
+                                              service_name=name))
         return self.tracer
 
     def try_direct_interleave(self, grain_id, method_name: str,
@@ -298,7 +337,10 @@ class RuntimeClient:
             if tctx is not None:
                 trace_id, parent_id = tctx
             elif (category is None or category == Category.APPLICATION) \
-                    and tracer.sample():
+                    and tracer.consume_head_roll():
+                # consume_head_roll honors a die already rolled by the hot
+                # lane this synchronous step (the lane falls back to this
+                # path on the sampled minority), else rolls here
                 trace_id, parent_id = tracer.new_trace_id(), None
             else:
                 trace_id = None
@@ -344,7 +386,7 @@ class RuntimeClient:
         # under this call's span, then restore the caller's ambient trace
         token = current_trace.set((span.trace_id, span.span_id))
         try:
-            res = self._send(msg, is_one_way, deadline)
+            res = self._send(msg, is_one_way, deadline, span)
         except BaseException as e:
             tracer.close(span, error=type(e).__name__)
             raise
@@ -356,14 +398,14 @@ class RuntimeClient:
         return _finish_span_after(tracer, span, res)
 
     def _send(self, msg: Message, is_one_way: bool,
-              deadline: float | None):
+              deadline: float | None, span=None):
         if is_one_way:
             self.transmit(msg)
             return None
         loop = asyncio.get_running_loop()
         future: asyncio.Future = loop.create_future()
         self.callbacks[msg.id] = _fresh_callback(
-            msg, future, deadline, RequestContext.get(TXN_KEY))
+            msg, future, deadline, RequestContext.get(TXN_KEY), span)
         self._ensure_sweeper()
         try:
             self.transmit(msg)
@@ -416,6 +458,18 @@ class RuntimeClient:
             # twin of OTPU001's static proof
             _msg_mod.assert_generation(cb.message, cb.gen,
                                        "RuntimeClient.receive_response")
+        if self.tracer is not None and msg.request_context is not None:
+            # response-leg network span: the server stamped the response
+            # header at send (dispatcher._run_turn) — without this the
+            # breakdown only sees the request leg and return-path latency
+            # hides in the client-span remainder. Parented under the
+            # server turn span (the sending side), like the request leg
+            # parents under the client span.
+            hdr = context_from_headers(msg.request_context)
+            if hdr is not None:
+                self.tracer.record(hdr[0], hdr[1], "network", "network",
+                                   hdr[2], time.time() - hdr[2],
+                                   leg="response")
         # fold callee transaction joins back into the caller's ambient
         # info (the TransactionInfo response-header merge; idempotent for
         # the in-proc shared-object case)
@@ -448,6 +502,16 @@ class RuntimeClient:
         else:  # rejection — transparently resend transient rejections
             # GATEWAY_TOO_BUSY is retryable: the resend re-picks a gateway
             # (the reference's client reroutes around overloaded gateways)
+            if cb.span is not None and msg.rejection_type is not None:
+                # span event on the still-open client invoke span: the
+                # rejection (and any resend below) is part of THIS call's
+                # story — without it the retry backoff reads as opaque
+                # client-span time and tail-retained slow traces can't
+                # show why they were slow
+                cb.span.add_event(
+                    "rejected", rejection=msg.rejection_type.name,
+                    info=msg.rejection_info or "",
+                    resend_count=cb.message.resend_count)
             if (msg.rejection_type is not None
                     and cb.message.target_grain is not None
                     and cb.message.target_grain.is_system_target()):
@@ -476,6 +540,10 @@ class RuntimeClient:
                 cb.message.target_silo = None  # re-address from scratch
                 cb.message.target_activation = None
                 self.callbacks[msg.id] = cb
+                if cb.span is not None:
+                    cb.span.add_event(
+                        "resend", rejection=msg.rejection_type.name,
+                        resend_count=cb.message.resend_count)
                 # back off before re-addressing: transient rejections during
                 # silo death need the directory/membership view a moment to
                 # converge before the retry can land elsewhere. Jittered —

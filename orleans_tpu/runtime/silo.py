@@ -132,6 +132,22 @@ class SiloConfig:
     trace_enabled: bool = False
     trace_sample_rate: float = 1.0
     trace_buffer_size: int = 4096
+    # tail-based retention (config.TracingOptions.tail_*): keep/drop moves
+    # from the head roll to trace completion — slow/errored/forced traces
+    # survive, the rest drop after the quiescence window. Legs of traces
+    # rooted on other silos buffer up to trace_tail_leg_ttl awaiting the
+    # rooting silo's retention pull (ctl_trace_spans), then expire.
+    trace_tail_enabled: bool = False
+    trace_tail_window: float = 0.25
+    trace_tail_slow_threshold: float = 0.1
+    trace_tail_slow_percentile: float = 0.0
+    trace_tail_leg_ttl: float = 2.0
+    trace_tail_max_pending: int = 256
+    # streaming OTLP/HTTP export of retained spans (export.OtlpSink);
+    # None = no sink. Unreachable collectors degrade to counted drops.
+    trace_otlp_endpoint: str | None = None
+    trace_otlp_batch_size: int = 64
+    trace_otlp_flush_interval: float = 0.5
     # live rebalancer (orleans_tpu.rebalance): plan/execute period in
     # seconds (0 disables the loop even when the service is installed),
     # per-round migration budget, and the hot/mean load ratio below which
@@ -443,10 +459,27 @@ class Silo:
         # — every hot-path site guards on that None
         self.tracer = None
         if config.trace_enabled:
-            from ..observability.tracing import SpanCollector
-            self.tracer = SpanCollector(config.name,
-                                        config.trace_sample_rate,
-                                        config.trace_buffer_size)
+            from ..observability.tracing import (LatencyErrorPolicy,
+                                                 SpanCollector)
+            self.tracer = SpanCollector(
+                config.name, config.trace_sample_rate,
+                config.trace_buffer_size,
+                tail=config.trace_tail_enabled,
+                tail_window=config.trace_tail_window,
+                policy=LatencyErrorPolicy(config.trace_tail_slow_threshold,
+                                          config.trace_tail_slow_percentile),
+                leg_ttl=config.trace_tail_leg_ttl,
+                max_pending=config.trace_tail_max_pending)
+            if config.trace_otlp_endpoint:
+                from ..observability.export import OtlpSink
+                self.tracer.sinks.append(OtlpSink(
+                    config.trace_otlp_endpoint, service_name=config.name,
+                    batch_size=config.trace_otlp_batch_size,
+                    flush_interval=config.trace_otlp_flush_interval))
+            if config.trace_tail_enabled:
+                # retention propagation: when THIS silo retains a trace it
+                # pulls the remote legs over the control path before export
+                self.tracer.remote_fetcher = self._pull_trace_legs
         # grain cancellation twins (CancellationSourcesExtension)
         self.cancellation_tokens = TokenInterner(self)
 
@@ -592,6 +625,9 @@ class Silo:
         stop_maint = getattr(self.locator, "stop_cache_maintainer", None)
         if stop_maint is not None:
             stop_maint()
+        if self.tracer is not None:
+            # graceful: decide + export what's buffered; kill: drop it
+            await self.tracer.aclose(flush=graceful)
         self.message_center.stop()
         self.runtime_client.close()
         self.fabric.unregister_silo(self, dead=not graceful)
@@ -599,6 +635,32 @@ class Silo:
             self._eager_installed = False
             _uninstall_eager_factory(asyncio.get_running_loop())
         self.status = "Stopped"
+
+    async def _pull_trace_legs(self, trace_id: int) -> list[dict]:
+        """Retention propagation (tail tracing): fan ``ctl_trace_spans``
+        out to every other alive silo so a trace retained HERE exports
+        with its remote legs. SYSTEM-category RPCs never root traces, so
+        the pull cannot recursively trace itself; unreachable peers just
+        contribute nothing (export stays best-effort)."""
+        from ..core.ids import type_code_of
+        from ..management.control import SILO_CONTROL, SiloControl
+        peers = [a for a in self.locator.alive_list
+                 if a != self.silo_address]
+        if not peers:
+            return []
+        calls = [self.runtime_client.send_request(
+            target_grain=GrainId.system_target(type_code_of(SILO_CONTROL), a),
+            grain_class=SiloControl, interface_name=SILO_CONTROL,
+            method_name="ctl_trace_spans", args=(trace_id,),
+            kwargs={"pull": True},
+            target_silo=a, category=Category.SYSTEM, timeout=1.0)
+            for a in peers]
+        results = await asyncio.gather(*calls, return_exceptions=True)
+        out: list[dict] = []
+        for r in results:
+            if not isinstance(r, BaseException) and r:
+                out.extend(r)
+        return out
 
     def register_system_target(self, instance, name: str) -> GrainId:
         """Register a per-silo pseudo-grain at a well-known id

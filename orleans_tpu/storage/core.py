@@ -99,13 +99,43 @@ class MemoryStorage(GrainStorage):
         self._data.pop(k, None)
 
 
+def _file_read_blob(path: str) -> "tuple[bytes | None, str | None]":
+    """Sync half of FileStorage.read — runs in the loop's thread executor
+    so file IO never stalls grain turns (the OTPU002 discipline)."""
+    try:
+        with open(path, "rb") as f:
+            meta_len = int.from_bytes(f.read(4), "little")
+            meta = json.loads(f.read(meta_len))
+            blob = f.read()
+        return blob, meta["etag"]
+    except FileNotFoundError:
+        return None, None
+
+
+def _file_write_blob(path: str, meta: bytes, blob: bytes) -> None:
+    """Sync half of FileStorage.write (executor-run): tmp + atomic
+    replace, so a crash mid-write never leaves a torn record."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(len(meta).to_bytes(4, "little"))
+        f.write(meta)
+        f.write(blob)
+    os.replace(tmp, path)
+
+
 class FileStorage(GrainStorage):
     """Durable single-host provider: one JSON-indexed blob dir. Plays the
-    role of the reference's cloud table providers for local deployments."""
+    role of the reference's cloud table providers for local deployments.
+    File IO runs through ``loop.run_in_executor`` — a slow disk stalls
+    only the writing activation, never the whole silo's event loop. A
+    per-store mutation lock keeps the etag check-then-write atomic across
+    the executor suspensions (the pure-sync body used to get that for
+    free from loop atomicity; concurrent CAS writers must still lose)."""
 
     def __init__(self, root: str) -> None:
         self.root = root
         os.makedirs(root, exist_ok=True)
+        self._mutate_lock = asyncio.Lock()
 
     def _path(self, grain_type: str, grain_id: GrainId) -> str:
         name = f"{grain_type}-{grain_id.uniform_hash:016x}"
@@ -113,42 +143,39 @@ class FileStorage(GrainStorage):
 
     async def read(self, grain_type, grain_id):
         p = self._path(grain_type, grain_id)
-        try:
-            with open(p, "rb") as f:
-                meta_len = int.from_bytes(f.read(4), "little")
-                meta = json.loads(f.read(meta_len))
-                blob = f.read()
-            return deserialize(blob), meta["etag"]
-        except FileNotFoundError:
+        blob, etag = await asyncio.get_running_loop().run_in_executor(
+            None, _file_read_blob, p)
+        if blob is None:
             return None, None
+        return deserialize(blob), etag
 
     async def write(self, grain_type, grain_id, state, etag):
-        _, cur_etag = await self.read(grain_type, grain_id)
-        if etag != cur_etag:
-            raise InconsistentStateError(
-                f"etag mismatch for {grain_id}", stored_etag=cur_etag,
-                current_etag=etag)
-        new_etag = uuid.uuid4().hex
-        meta = json.dumps({"etag": new_etag}).encode()
-        p = self._path(grain_type, grain_id)
-        tmp = p + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(len(meta).to_bytes(4, "little"))
-            f.write(meta)
-            # durable blobs outlive the process: always-portable encoding
-            f.write(serialize_portable(state))
-        os.replace(tmp, p)
-        return new_etag
+        async with self._mutate_lock:
+            _, cur_etag = await self.read(grain_type, grain_id)
+            if etag != cur_etag:
+                raise InconsistentStateError(
+                    f"etag mismatch for {grain_id}", stored_etag=cur_etag,
+                    current_etag=etag)
+            new_etag = uuid.uuid4().hex
+            meta = json.dumps({"etag": new_etag}).encode()
+            # serialize on the loop (touches live state; executor threads
+            # must only see immutable bytes), write in the executor
+            blob = serialize_portable(state)
+            await asyncio.get_running_loop().run_in_executor(
+                None, _file_write_blob, self._path(grain_type, grain_id),
+                meta, blob)
+            return new_etag
 
     async def clear(self, grain_type, grain_id, etag):
-        _, cur_etag = await self.read(grain_type, grain_id)
-        if cur_etag is None:
-            return
-        if etag != cur_etag:
-            raise InconsistentStateError(
-                f"etag mismatch for {grain_id}", stored_etag=cur_etag,
-                current_etag=etag)
-        os.remove(self._path(grain_type, grain_id))
+        async with self._mutate_lock:
+            _, cur_etag = await self.read(grain_type, grain_id)
+            if cur_etag is None:
+                return
+            if etag != cur_etag:
+                raise InconsistentStateError(
+                    f"etag mismatch for {grain_id}", stored_etag=cur_etag,
+                    current_etag=etag)
+            os.remove(self._path(grain_type, grain_id))
 
 
 # ---------------------------------------------------------------------------

@@ -118,14 +118,44 @@ class TestClusterBuilder:
         return self
 
     def with_tracing(self, sample_rate: float = 1.0,
-                     buffer_size: int = 4096) -> "TestClusterBuilder":
+                     buffer_size: int = 4096, *, tail: bool = False,
+                     tail_window: float = 0.25,
+                     slow_threshold: float | None = None,
+                     slow_percentile: float | None = None,
+                     leg_ttl: float | None = None,
+                     otlp_endpoint: str | None = None,
+                     client: bool = True) -> "TestClusterBuilder":
         """Distributed request tracing on every silo AND the test client
         (the client is the root of most test traces); spans merge via
-        ``TestCluster.trace_spans`` / ``export_trace``."""
-        self.config.update(trace_enabled=True,
-                           trace_sample_rate=sample_rate,
-                           trace_buffer_size=buffer_size)
-        self._client_tracing = (sample_rate, buffer_size)
+        ``TestCluster.trace_spans`` / ``export_trace``.
+
+        ``tail=True`` enables tail-based retention everywhere: head
+        sampling records, the keep/drop decision waits for trace
+        completion (slow/errored/forced survive). ``client=False`` leaves
+        the test client untraced so traces root silo-side (exercises the
+        silo's own retention + cross-silo control-path pull)."""
+        cfg = dict(trace_enabled=True, trace_sample_rate=sample_rate,
+                   trace_buffer_size=buffer_size)
+        if tail:
+            cfg.update(trace_tail_enabled=True,
+                       trace_tail_window=tail_window)
+            if slow_threshold is not None:
+                cfg["trace_tail_slow_threshold"] = slow_threshold
+            if slow_percentile is not None:
+                cfg["trace_tail_slow_percentile"] = slow_percentile
+            if leg_ttl is not None:
+                cfg["trace_tail_leg_ttl"] = leg_ttl
+        if otlp_endpoint is not None:
+            cfg["trace_otlp_endpoint"] = otlp_endpoint
+        self.config.update(cfg)
+        self._client_tracing = None
+        if client:
+            self._client_tracing = dict(
+                sample_rate=sample_rate, buffer_size=buffer_size,
+                tail=tail, tail_window=tail_window,
+                slow_threshold=slow_threshold,
+                slow_percentile=slow_percentile, leg_ttl=leg_ttl,
+                otlp_endpoint=otlp_endpoint)
         return self
 
     def with_rebalancer(self, period: float = 0.2, budget: int | None = None,
@@ -180,7 +210,23 @@ class TestCluster:
         self.client = await ClusterClient(self.fabric).connect()
         tracing = getattr(self.builder, "_client_tracing", None)
         if tracing is not None:
-            self.client.enable_tracing(*tracing)
+            if isinstance(tracing, tuple):  # legacy (rate, buffer) form
+                self.client.enable_tracing(*tracing)
+            else:
+                self.client.enable_tracing(**tracing)
+                if tracing.get("tail"):
+                    # the testing-host analog of the silo's control-path
+                    # retention pull (Silo._pull_trace_legs): the in-proc
+                    # client pulls silo legs straight off their collectors
+                    async def _fetch(tid: int) -> list[dict]:
+                        out: list[dict] = []
+                        for s in self.silos:
+                            tr = getattr(s, "tracer", None)
+                            if tr is not None and s.status == "Running":
+                                out.extend(tr.pull(tid) if tr.tail
+                                           else tr.snapshot(tid))
+                        return out
+                    self.client.tracer.remote_fetcher = _fetch
         if self.builder.with_membership:
             await self.wait_for_liveness()
         return self
@@ -264,6 +310,41 @@ class TestCluster:
         if getattr(self.client, "tracer", None) is not None:
             self.client.tracer.clear()
 
+    async def drain_traces(self) -> None:
+        """Deterministically settle tail retention everywhere, in two
+        phases: first every collector decides its ROOTED traces (awaiting
+        the cross-silo pulls those retentions trigger), then every
+        collector expires whatever legs nobody pulled — expiring first
+        would drop legs a peer's in-flight pull still needs. No-op for
+        head-mode collectors."""
+        collectors = []
+        client_tracer = getattr(self.client, "tracer", None)
+        if client_tracer is not None and client_tracer.tail:
+            collectors.append(client_tracer)
+        for s in self.silos:
+            tr = getattr(s, "tracer", None)
+            if tr is not None and tr.tail and s.status == "Running":
+                collectors.append(tr)
+        for tr in collectors:
+            await tr.drain_tail(force=True, expire_legs=False)
+        for tr in collectors:
+            await tr.drain_tail(force=True)
+
+    def retention_stats(self) -> dict:
+        """Merged kept/dropped/... counters across client + silos (tests'
+        quick view; the management surface is get_retention_stats)."""
+        totals: dict[str, int] = {}
+        collectors = [getattr(self.client, "tracer", None)] + \
+            [getattr(s, "tracer", None) for s in self.silos]
+        for tr in collectors:
+            if tr is None:
+                continue
+            for k, v in tr.retention_stats().items():
+                if isinstance(v, bool) or not isinstance(v, int):
+                    continue
+                totals[k] = totals.get(k, 0) + v
+        return totals
+
     def export_trace(self, path: str, trace_id: int | None = None) -> str:
         """Merge spans from every silo + the client into one Chrome-trace/
         Perfetto JSON timeline file; returns ``path``."""
@@ -303,6 +384,11 @@ class TestCluster:
     # -- teardown ------------------------------------------------------------
     async def stop_all(self) -> None:
         if self.client is not None:
+            tracer = getattr(self.client, "tracer", None)
+            if tracer is not None:
+                # settle sink flusher/pull tasks; tests that care about
+                # exported spans drain_traces() explicitly before stopping
+                await tracer.aclose(flush=False)
             await self.client.close_async()
             self.client = None
         for s in list(self.silos):
