@@ -1,0 +1,166 @@
+"""Ingest attribution benchmark — where an ingested message's time goes.
+
+The ROADMAP's #1 wall: the device tier absorbs ~3.9B rounds/sec while
+host-side ingest caps at ~12-18M msgs/sec bound, and until this PR
+nothing could say *where* a message spends its time between socket and
+device tick. This harness drives the full ingest path — GatewayClient →
+TCP → wire decode (hotwire) → fabric enqueue → dispatcher → host turn
+AND device-tier tick — with `metrics_enabled`, then reads the stage
+histograms (observability.stats.INGEST_STATS) back out of the silo's
+registry:
+
+    decode / enqueue / queue_wait        host-side, per socket frame
+    staging / transfer / tick            device-side, per vector batch
+
+Stage *shares* are each stage's summed seconds over the total of all
+stage sums — contiguous segments against the envelope's single
+``received_at`` stamp, so they sum to 1.0 of the measured ingest wall
+time by construction; ``stage_seconds_per_wall_second`` reports the
+summed per-message stage time per wall second (>1 under concurrency —
+N queued messages accrue wait simultaneously, which is the saturation
+signal). This is the hard attribution PR 7's zero-copy batched-ingress
+work lands against.
+"""
+
+import argparse
+import asyncio
+import json
+import time
+
+if __package__ in (None, ""):
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from orleans_tpu.observability.stats import INGEST_STAGES, INGEST_STATS
+from orleans_tpu.runtime import Grain, SiloBuilder
+from orleans_tpu.runtime.socket_fabric import GatewayClient, SocketFabric
+
+
+class EchoGrain(Grain):
+    async def ping(self, x: int) -> int:
+        return x
+
+
+def _make_vector_grain():
+    import jax.numpy as jnp
+
+    from orleans_tpu.dispatch import VectorGrain, actor_method
+
+    class EchoVec(VectorGrain):
+        STATE = {"pings": (jnp.int32, ())}
+
+        @staticmethod
+        def initial_state(key_hash):
+            return {"pings": jnp.int32(0)}
+
+        @actor_method(args={"x": (jnp.int32, ())})
+        def ping(state, args):
+            return {"pings": state["pings"] + 1}, args["x"]
+
+    return EchoVec
+
+
+async def run(seconds: float = 2.0, concurrency: int = 32,
+              n_grains: int = 64, n_keys: int = 64) -> dict:
+    """One silo over real TCP, metrics on, mixed host + device traffic;
+    returns the stage breakdown in the BENCH extra."""
+    import numpy as np
+
+    from orleans_tpu.dispatch import add_vector_grains
+    from orleans_tpu.parallel import make_mesh
+
+    EchoVec = _make_vector_grain()
+    fabric = SocketFabric()
+    b = (SiloBuilder().with_name("ingest-silo").with_fabric(fabric)
+         .add_grains(EchoGrain)
+         .with_config(metrics_enabled=True, metrics_sample_period=0.25))
+    add_vector_grains(b, EchoVec, mesh=make_mesh(1),
+                      dense={EchoVec: n_keys})
+    silo = b.build()
+    await silo.start()
+    client = await GatewayClient([silo.silo_address.endpoint]).connect()
+    try:
+        host_refs = [client.get_grain(EchoGrain, k) for k in range(n_grains)]
+        vec_refs = [client.get_grain(EchoVec, k) for k in range(n_keys)]
+        # warmup: activate host grains, compile the vector kernel
+        await asyncio.gather(*(g.ping(0) for g in host_refs))
+        await asyncio.gather(*(v.ping(x=np.int32(0)) for v in vec_refs[:8]))
+
+        stop_at = time.perf_counter() + seconds
+        calls = 0
+
+        async def host_worker(wid: int) -> None:
+            nonlocal calls
+            i = wid
+            while time.perf_counter() < stop_at:
+                await host_refs[i % n_grains].ping(i)
+                i += 1
+                calls += 1
+
+        async def vec_worker(wid: int) -> None:
+            nonlocal calls
+            i = wid
+            while time.perf_counter() < stop_at:
+                await vec_refs[i % n_keys].ping(x=np.int32(i & 0x7FFF))
+                i += 1
+                calls += 1
+
+        t0 = time.perf_counter()
+        half = max(1, concurrency // 2)
+        await asyncio.gather(
+            *(host_worker(w) for w in range(half)),
+            *(vec_worker(w) for w in range(half)))
+        elapsed = time.perf_counter() - t0
+
+        snap = silo.stats.snapshot()
+        hists = snap["histograms"]
+        stage_seconds = {}
+        stage_counts = {}
+        for stage in INGEST_STAGES:
+            h = hists.get(INGEST_STATS[stage], {})
+            stage_seconds[stage] = float(h.get("sum", 0.0))
+            stage_counts[stage] = int(h.get("count", 0))
+        total = sum(stage_seconds.values())
+        shares = {k: (round(v / total, 4) if total else 0.0)
+                  for k, v in stage_seconds.items()}
+        frames = snap["counters"].get(INGEST_STATS["frames"], 0)
+        batch_h = hists.get(INGEST_STATS["frame_batch"], {})
+    finally:
+        await client.close_async()
+        await silo.stop()
+    return {
+        "metric": "ingest_attribution_msgs_per_sec",
+        "value": round(calls / elapsed, 1),
+        "unit": "msgs/sec",
+        "vs_baseline": None,
+        "extra": {
+            "seconds": seconds, "concurrency": concurrency,
+            "calls": calls,
+            "stage_seconds": {k: round(v, 4)
+                              for k, v in stage_seconds.items()},
+            "stage_counts": stage_counts,
+            "stage_shares": shares,
+            "shares_sum": round(sum(shares.values()), 4),
+            # summed per-message stage seconds over the bench wall: >1
+            # under concurrency (N in-flight messages each accrue queue
+            # wait simultaneously) — the saturation signal itself
+            "stage_seconds_per_wall_second":
+                round(total / elapsed, 4) if elapsed else 0.0,
+            "frames_decoded": frames,
+            "mean_frames_per_read": round(
+                batch_h.get("mean", 0.0), 2) if batch_h else None,
+        },
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=3.0)
+    ap.add_argument("--concurrency", type=int, default=32)
+    a = ap.parse_args()
+    print(json.dumps(asyncio.run(run(a.seconds, a.concurrency))))
+
+
+if __name__ == "__main__":
+    main()

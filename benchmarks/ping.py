@@ -39,7 +39,8 @@ async def bench_host_tier(n_grains: int, concurrency: int,
                           seconds: float,
                           trace_sample: float | None = None,
                           hot_lane: bool = True,
-                          tail: bool = False) -> dict:
+                          tail: bool = False,
+                          metrics: bool = False) -> dict:
     """``trace_sample``: None runs untraced (no collector installed);
     a float enables distributed tracing at that head-sampling rate — the
     overhead-tracking variant wired into run_all and the perf floor.
@@ -47,12 +48,18 @@ async def bench_host_tier(n_grains: int, concurrency: int,
     (the A/B lever for the hot-lane margin floor). ``tail=True`` turns on
     tail-based retention (record at the head rate, keep/drop at trace
     completion — the worst-case tail-record tax, since fast-clean pings
-    buffer, quiesce, and then drop every single trace)."""
+    buffer, quiesce, and then drop every single trace). ``metrics=True``
+    enables the live metrics pipeline — ingest stage instrumentation on
+    every message plus the queue/backpressure sampler loop (fast period
+    so it actually ticks during the run) — the A/B lever for the
+    metrics-overhead floor."""
     b = (SiloBuilder().with_name("ping-silo").add_grains(EchoGrain)
          .with_config(hot_lane_enabled=hot_lane))
     if trace_sample is not None:
         b = b.with_config(trace_enabled=True, trace_sample_rate=trace_sample,
                           trace_tail_enabled=tail)
+    if metrics:
+        b = b.with_config(metrics_enabled=True, metrics_sample_period=0.2)
     silo = b.build()
     await silo.start()
     client = await ClusterClient(silo.fabric).connect()
@@ -90,7 +97,8 @@ async def bench_host_tier(n_grains: int, concurrency: int,
     await client.close_async()
     await silo.stop()
     return {
-        "metric": ("ping_host_calls_per_sec" if trace_sample is None
+        "metric": ("ping_host_metered_calls_per_sec" if metrics
+                   else "ping_host_calls_per_sec" if trace_sample is None
                    else "ping_host_tail_traced_calls_per_sec" if tail
                    else "ping_host_traced_calls_per_sec"),
         "value": round(calls / elapsed, 1),
@@ -187,6 +195,35 @@ async def bench_trace_tail(n_grains: int = 128, concurrency: int = 50,
         "extra": {
             "untraced_calls_per_sec": base["value"],
             "tail_traced_calls_per_sec": tail["value"],
+            "n_grains": n_grains, "concurrency": concurrency,
+        },
+    }
+
+
+async def bench_metrics_overhead(n_grains: int = 128, concurrency: int = 50,
+                                 seconds: float = 1.5) -> dict:
+    """metrics_overhead: the live metrics pipeline (ingest stage
+    histograms on every message + the sampler loop) vs a bare silo, as a
+    ratio — interpreter-independent like the tail/hot-lane ratios. The
+    floor companion (tests/test_perf_floors.py::test_floor_metrics_overhead)
+    keeps this >= 0.85.
+
+    Both sides run with the hot lane off: hot-lane calls collapse the
+    whole messaging frame — including every instrumented site — so a
+    hot-lane baseline would measure the lane's margin instead of the
+    per-message stamp/observe tax this ratio exists to guard."""
+    base = await bench_host_tier(n_grains, concurrency, seconds,
+                                 hot_lane=False)
+    metered = await bench_host_tier(n_grains, concurrency, seconds,
+                                    hot_lane=False, metrics=True)
+    return {
+        "metric": "metrics_overhead",
+        "value": round(metered["value"] / base["value"], 3),
+        "unit": "ratio (metered / bare)",
+        "vs_baseline": None,
+        "extra": {
+            "bare_calls_per_sec": base["value"],
+            "metered_calls_per_sec": metered["value"],
             "n_grains": n_grains, "concurrency": concurrency,
         },
     }

@@ -16,6 +16,7 @@ if __package__ in (None, ""):
 from benchmarks import (
     chirper_fanout,
     gpstracker_stream,
+    ingest_attribution,
     mxu_handler,
     mapreduce,
     ping,
@@ -45,6 +46,15 @@ def main() -> None:
     # sampled-trace point at rate 0.01 (the lane rolls the die itself)
     print(json.dumps(asyncio.run(ping.bench_hotlane(
         n_grains=256, concurrency=100, seconds=2.0))))
+    # metrics pipeline overhead as a ratio vs a bare silo (stage
+    # instrumentation on every message + the sampler loop; CI floor 0.85)
+    print(json.dumps(asyncio.run(ping.bench_metrics_overhead(
+        n_grains=128, concurrency=50, seconds=1.5))))
+    # ingest attribution: socket -> decode/enqueue/queue-wait ->
+    # staging/transfer/tick stage breakdown (shares sum to 1.0 of the
+    # measured ingest wall — the substrate the ingest-wall work lands on)
+    print(json.dumps(asyncio.run(ingest_attribution.run(
+        seconds=2.0, concurrency=32))))
     print(json.dumps(asyncio.run(mapreduce.run())))
     for r in serialization.run():
         print(json.dumps(r))

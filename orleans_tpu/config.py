@@ -25,7 +25,7 @@ __all__ = [
     "ClusterOptions", "MessagingOptions", "SchedulingOptions",
     "GrainCollectionOptions", "MembershipOptions", "DirectoryOptions",
     "LoadSheddingOptions", "DispatchOptions", "RebalanceOptions",
-    "TracingOptions",
+    "TracingOptions", "MetricsOptions",
     "flatten", "apply_options", "validate_options", "log_options",
 ]
 
@@ -213,6 +213,11 @@ class TracingOptions:
     tail_window: float = 0.25
     tail_slow_threshold: float = 0.1
     tail_slow_percentile: float = 0.0
+    # auto-tune tail_slow_threshold from the root-duration percentile
+    # history (LatencyErrorPolicy auto mode): the threshold converges on
+    # the tail_slow_percentile cut (default 0.95 when unset), so drifting
+    # baselines keep retaining the slowest ~(1-p) fraction
+    tail_auto: bool = False
     tail_leg_ttl: float = 2.0
     tail_max_pending: int = 256
     otlp_endpoint: str | None = None
@@ -235,6 +240,36 @@ class TracingOptions:
             raise ConfigurationError(
                 "trace tail_slow_threshold must be >= 0 "
                 "(0 disables the absolute threshold)")
+
+
+@dataclass
+class MetricsOptions:
+    """Live metrics pipeline (observability.metrics — the reference's
+    continuous statistics surface, Core/Statistics/ + LogStatistics):
+    stage-level ingest instrumentation + the queue/backpressure sampler
+    loop, the per-silo Prometheus pull endpoint, and periodic OTLP
+    metrics push.
+
+    ``enabled`` turns on the ingest stage histograms (decode / enqueue /
+    queue-wait / staging / transfer / tick) and the sampler; everything
+    costs one attribute check per site when off. ``port`` gates the
+    stdlib-HTTP ``GET /metrics`` exposition endpoint (``None`` = no
+    server; ``0`` = ephemeral port). ``otlp_endpoint`` streams registry
+    snapshots every ``otlp_period`` seconds via export.OtlpMetricsSink
+    (same bounded-queue/retry/drop discipline as trace export)."""
+
+    enabled: bool = False
+    sample_period: float = 1.0
+    window: float = 60.0
+    port: int | None = None
+    otlp_endpoint: str | None = None
+    otlp_period: float = 5.0
+
+    def validate(self) -> None:
+        _positive(self, "sample_period", "window", "otlp_period")
+        if self.port is not None and not (0 <= int(self.port) <= 65535):
+            raise ConfigurationError(
+                f"metrics port must be None or 0-65535, got {self.port!r}")
 
 
 @dataclass
@@ -288,11 +323,18 @@ _FLAT_MAP = {
     "trace_tail_window": (TracingOptions, "tail_window"),
     "trace_tail_slow_threshold": (TracingOptions, "tail_slow_threshold"),
     "trace_tail_slow_percentile": (TracingOptions, "tail_slow_percentile"),
+    "trace_tail_auto": (TracingOptions, "tail_auto"),
     "trace_tail_leg_ttl": (TracingOptions, "tail_leg_ttl"),
     "trace_tail_max_pending": (TracingOptions, "tail_max_pending"),
     "trace_otlp_endpoint": (TracingOptions, "otlp_endpoint"),
     "trace_otlp_batch_size": (TracingOptions, "otlp_batch_size"),
     "trace_otlp_flush_interval": (TracingOptions, "otlp_flush_interval"),
+    "metrics_enabled": (MetricsOptions, "enabled"),
+    "metrics_sample_period": (MetricsOptions, "sample_period"),
+    "metrics_window": (MetricsOptions, "window"),
+    "metrics_port": (MetricsOptions, "port"),
+    "metrics_otlp_endpoint": (MetricsOptions, "otlp_endpoint"),
+    "metrics_otlp_period": (MetricsOptions, "otlp_period"),
 }
 
 

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from functools import partial
 from typing import Any
 
@@ -35,9 +36,16 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..core.ids import GrainId
+from ..observability.stats import INGEST_STATS as _INGEST
 from ..parallel.mesh import SILO_AXIS, make_mesh, shard_map_compat
 from .table import ShardedActorTable
 from .vector_grain import ActorMethod, VectorGrain
+
+_QUEUE_WAIT = _INGEST["queue_wait"]
+_STAGING = _INGEST["staging"]
+_TRANSFER = _INGEST["transfer"]
+_TICK = _INGEST["tick"]
+_MESSAGES = _INGEST["messages"]
 
 log = logging.getLogger("orleans.vector")
 
@@ -125,17 +133,24 @@ class _DensePlan:
 
 
 class _Pending:
-    """One queued invocation in the hashed (per-key) path."""
+    """One queued invocation in the hashed (per-key) path. ``t_enq`` is
+    the monotonic enqueue stamp (0.0 with metrics off): the engine's
+    queue-wait stage measures it against batch start, so tick-scheduling
+    delay AND conflict-deferred extra ticks are attributed, on the owning
+    silo only."""
 
-    __slots__ = ("key_hash", "shard", "slot", "fresh", "args", "future")
+    __slots__ = ("key_hash", "shard", "slot", "fresh", "args", "future",
+                 "t_enq")
 
-    def __init__(self, key_hash, shard, slot, fresh, args, future):
+    def __init__(self, key_hash, shard, slot, fresh, args, future,
+                 t_enq=0.0):
         self.key_hash = key_hash
         self.shard = shard
         self.slot = slot
         self.fresh = fresh
         self.args = args
         self.future = future
+        self.t_enq = t_enq
 
 
 class VectorActorRef:
@@ -195,6 +210,13 @@ class VectorRuntime:
         # a "device_tick" span AND opens a jax.profiler.TraceAnnotation so
         # XLA kernels nest under the logical tick on a profiler capture
         self.tracer = None
+        # ingest stage metrics (observability.stats.INGEST_STATS), set by
+        # dispatch.hosting when the owning silo has metrics enabled: each
+        # message batch splits into staging (pending -> host arrays),
+        # transfer (host -> device operands), and tick (kernel dispatch +
+        # device execution + host materialize) histograms — the device
+        # half of the socket->tick ingest attribution
+        self.stats = None
         # stateless-worker (mesh-replicated) hosts per class — see
         # dispatch.replicated (StatelessWorkerPlacement.cs:6 on device)
         self._replicated_hosts: dict[type, Any] = {}
@@ -316,7 +338,8 @@ class VectorRuntime:
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
         self.pending.setdefault((grain_class, method), []).append(
-            _Pending(key_hash, shard, slot, fresh, args, fut))
+            _Pending(key_hash, shard, slot, fresh, args, fut,
+                     time.monotonic() if self.stats is not None else 0.0))
         self._schedule_tick(loop)
         return fut
 
@@ -390,6 +413,11 @@ class VectorRuntime:
             self._schedule_tick(asyncio.get_running_loop())
 
     def _run_batch(self, cls: type, method: str, items: list[_Pending]) -> None:
+        st = self.stats
+        t_stage = now_mono = 0.0
+        if st is not None:
+            t_stage = time.perf_counter()
+            now_mono = time.monotonic()  # queue-wait ends at batch start
         tbl = self.tables[cls]
         m = tbl.methods[method]
         # schema inference is committed only after a successful batch so a
@@ -436,6 +464,16 @@ class VectorRuntime:
                     args_stacked[fname][s, i] = p.args[fname]
         if inferred:
             m.args_schema = schema  # needed by the kernel builder
+        t_xfer = t_tick = 0.0
+        if st is not None:
+            t_xfer = time.perf_counter()
+            st.observe(_STAGING, t_xfer - t_stage)
+            # per-item queue wait: enqueue (rt.call) -> this batch start —
+            # tick scheduling plus any conflict-deferred full ticks; items
+            # enqueued by non-call paths carry no stamp and are skipped
+            for p in ready:
+                if p.t_enq:
+                    st.observe(_QUEUE_WAIT, max(0.0, now_mono - p.t_enq))
         tracer = self.tracer
         tick_span = None
         try:
@@ -444,6 +482,9 @@ class VectorRuntime:
                 tbl.state, jnp.asarray(slots), jnp.asarray(khash),
                 jnp.asarray(fresh), jnp.asarray(valid),
                 {k: jnp.asarray(v) for k, v in args_stacked.items()})
+            if st is not None:
+                t_tick = time.perf_counter()
+                st.observe(_TRANSFER, t_tick - t_xfer)
             if tracer is not None and tracer.sample():
                 tick_span = tracer.open(
                     f"tick {cls.__name__}.{method}", "device_tick",
@@ -475,6 +516,12 @@ class VectorRuntime:
             tbl.record_hits(slots, valid)
         # resolve futures from the result batch
         host = jax.tree_util.tree_map(np.asarray, results)
+        if st is not None:
+            # tick closes AFTER the host transfer for the same reason the
+            # span below does: jax dispatch is async, and the np.asarray
+            # sync is where device execution is actually paid
+            st.observe(_TICK, time.perf_counter() - t_tick)
+            st.increment(_MESSAGES, len(ready))
         if tick_span is not None:
             # close AFTER the host transfer: jax dispatch is async, so
             # the np.asarray sync above is where device execution is

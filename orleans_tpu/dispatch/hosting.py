@@ -62,6 +62,10 @@ def add_vector_grains(builder, *grain_classes: type[VectorGrain],
                 options=options)
         if silo.tracer is not None:
             silo.vector.tracer = silo.tracer  # device ticks join the traces
+        if silo.ingest_stats is not None:
+            # device-half ingest attribution (staging/transfer/tick land
+            # in the silo's registry beside the host-side stages)
+            silo.vector.stats = silo.ingest_stats
         silo.vector.register(*grain_classes)
         for cls in grain_classes:
             silo.vector_interfaces[cls.__name__] = cls

@@ -138,6 +138,23 @@ class SiloControl:
         from ..observability.tracing import critical_path_breakdown
         return critical_path_breakdown(await self.ctl_trace_spans(trace_id))
 
+    async def ctl_metrics(self) -> dict:
+        """Full metrics payload for the cluster merge
+        (ManagementGrain.get_cluster_metrics): the stats-registry snapshot
+        (counters/gauges/histograms-with-buckets) plus, when the sampler
+        is installed, the time-windowed queue/backpressure series
+        summaries."""
+        snap = self.silo.stats.snapshot()
+        # the config NAME, not the address: one silo identity across the
+        # metrics surface (OTLP push data points, Prometheus labels, span
+        # silo attrs all use it), so dashboards join without a mapping
+        snap["silo"] = self.silo.config.name
+        snap["address"] = str(self.silo.silo_address)
+        sampler = self.silo.metrics
+        if sampler is not None:
+            snap["windows"] = sampler.window_snapshot()
+        return snap
+
     async def ctl_histogram(self, name: str) -> dict | None:
         """One named histogram's summary (with per-bucket counts so the
         ManagementGrain can merge silos losslessly); None if unknown."""

@@ -111,6 +111,36 @@ class ManagementGrain(Grain):
                 totals[k] = totals.get(k, 0) + v
         return {"totals": totals, "per_silo": per_silo}
 
+    async def get_cluster_metrics(self) -> dict:
+        """Cluster-wide metrics merge over every silo's ``ctl_metrics``:
+        counters and gauges sum across silos, histograms fold losslessly
+        via their per-bucket counts (Histogram.merge), and the per-silo
+        snapshots (including sampler window summaries) ride along for
+        drill-down — one call answers both "what is the cluster doing"
+        and "which silo is the outlier"."""
+        from ..observability.stats import Histogram
+        per_silo = await self._fan_out("ctl_metrics")
+        counters: dict[str, int] = {}
+        gauges: dict[str, float] = {}
+        hists: dict[str, Histogram] = {}
+        for snap in per_silo.values():
+            for k, v in snap.get("counters", {}).items():
+                counters[k] = counters.get(k, 0) + v
+            for k, v in snap.get("gauges", {}).items():
+                gauges[k] = gauges.get(k, 0.0) + float(v)
+            for k, h in snap.get("histograms", {}).items():
+                merged = hists.get(k)
+                if merged is None:
+                    hists[k] = Histogram.from_snapshot(h)
+                else:
+                    merged.merge(Histogram.from_snapshot(h))
+        return {
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": {k: h.summary() for k, h in hists.items()},
+            "per_silo": per_silo,
+        }
+
     async def get_cluster_histogram(self, name: str) -> dict | None:
         """One named latency histogram aggregated across every silo
         (Histogram.merge over the per-bucket counts each SiloControl

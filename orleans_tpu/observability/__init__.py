@@ -2,13 +2,27 @@
 management surface (reference L13)."""
 
 from .export import (  # noqa: F401
+    OtlpMetricsSink,
     OtlpSink,
     chrome_trace_events,
+    snapshots_to_otlp_metrics,
     spans_to_otlp,
     write_chrome_trace,
 )
+from .metrics import (  # noqa: F401
+    MetricsHttpServer,
+    MetricsSampler,
+    WindowedGauge,
+    prometheus_exposition,
+)
 from .profiling import Profiler, StepTimer, annotate, traced  # noqa: F401
-from .stats import REBALANCE_STATS, Histogram, StatsRegistry  # noqa: F401
+from .stats import (  # noqa: F401
+    INGEST_STAGES,
+    INGEST_STATS,
+    REBALANCE_STATS,
+    Histogram,
+    StatsRegistry,
+)
 from .tracing import (  # noqa: F401
     TRACE_KEY,
     LatencyErrorPolicy,

@@ -34,7 +34,8 @@ import urllib.request
 from collections import deque
 
 __all__ = ["chrome_trace_events", "write_chrome_trace",
-           "OtlpSink", "spans_to_otlp"]
+           "OtlpSink", "OtlpMetricsSink", "spans_to_otlp",
+           "snapshots_to_otlp_metrics"]
 
 log = logging.getLogger("orleans.export")
 
@@ -168,16 +169,20 @@ def spans_to_otlp(span_dicts, service_name: str = "orleans_tpu") -> dict:
     }]}
 
 
-class OtlpSink:
-    """Streaming OTLP/HTTP exporter with the OTel-collector queue
+class _OtlpHttpSink:
+    """Shared OTLP/HTTP export machinery with the OTel-collector queue
     discipline: bounded buffer (overflow drops oldest + counts), batches
     of ``batch_size`` flushed every ``flush_interval`` seconds or as soon
     as a full batch accumulates, per-batch retry with exponential backoff,
     and give-up-drop when the collector stays unreachable. The POST runs
     in a thread executor so the event loop never blocks on the socket.
 
-    Attach to a collector: ``collector.sinks.append(OtlpSink(endpoint))``
-    — or let the silo wire it from ``trace_otlp_endpoint``."""
+    Subclasses provide :meth:`_encode` mapping one batch of queued items
+    to the request body — :class:`OtlpSink` ships span dicts as an
+    ``ExportTraceServiceRequest``, :class:`OtlpMetricsSink` ships stats
+    snapshots as an ``ExportMetricsServiceRequest``. Everything else
+    (queue bounds, flusher task, retry/backoff, teardown fast-drop,
+    counters) is identical by construction."""
 
     def __init__(self, endpoint: str, *, service_name: str = "orleans_tpu",
                  batch_size: int = 64, flush_interval: float = 0.5,
@@ -199,6 +204,9 @@ class OtlpSink:
         self.exported_batches = 0  # successful POSTs
         self.dropped = 0           # spans given up on (overflow/unreachable)
         self.retries = 0           # retry attempts (observability of flap)
+
+    def _encode(self, batch: list[dict]) -> bytes:  # pragma: no cover
+        raise NotImplementedError
 
     # -- producer side (called by SpanCollector, sync, hot-ish path) ------
     def offer(self, span_dicts) -> None:
@@ -251,8 +259,7 @@ class OtlpSink:
                     q.clear()
 
     async def _send(self, batch: list[dict]) -> bool:
-        body = json.dumps(
-            spans_to_otlp(batch, self.service_name)).encode()
+        body = self._encode(batch)
         loop = asyncio.get_running_loop()
         attempts = 1 if self._closing else self.max_retries + 1
         for attempt in range(attempts):
@@ -301,3 +308,97 @@ class OtlpSink:
                 "export_dropped": self.dropped,
                 "export_retries": self.retries,
                 "queued": len(self._q)}
+
+
+class OtlpSink(_OtlpHttpSink):
+    """Streaming OTLP/HTTP *trace* exporter. Attach to a collector:
+    ``collector.sinks.append(OtlpSink(endpoint))`` — or let the silo wire
+    it from ``trace_otlp_endpoint``."""
+
+    def _encode(self, batch: list[dict]) -> bytes:
+        return json.dumps(spans_to_otlp(batch, self.service_name)).encode()
+
+
+# ---------------------------------------------------------------------------
+# OTLP metrics export
+# ---------------------------------------------------------------------------
+
+def _metric_points(snapshot: dict) -> list[dict]:
+    """One silo's ``StatsRegistry.snapshot()`` → OTLP metric objects.
+    Counters become cumulative monotonic sums, gauges become gauges,
+    histograms become OTLP histograms carrying the registry's native
+    bucket bounds (so the collector sees the same quantile substrate the
+    Prometheus endpoint serves)."""
+    from .stats import Histogram
+
+    ts = str(int(snapshot.get("ts", 0.0) * 1e9))
+    attrs = []
+    silo = snapshot.get("silo")
+    if silo:
+        attrs = [{"key": "orleans.silo", "value": {"stringValue": silo}}]
+    metrics: list[dict] = []
+    for name, v in snapshot.get("counters", {}).items():
+        metrics.append({"name": name, "sum": {
+            "dataPoints": [{"asInt": str(int(v)), "timeUnixNano": ts,
+                            "attributes": attrs}],
+            "aggregationTemporality": 2,  # CUMULATIVE
+            "isMonotonic": True}})
+    for name, v in snapshot.get("gauges", {}).items():
+        metrics.append({"name": name, "gauge": {
+            "dataPoints": [{"asDouble": float(v), "timeUnixNano": ts,
+                            "attributes": attrs}]}})
+    for name, snap in snapshot.get("histograms", {}).items():
+        h = Histogram.from_snapshot(snap)
+        # explicitBounds excludes the terminal +Inf bucket (OTLP carries
+        # len(bounds)+1 bucketCounts)
+        bounds = [b for b in h.bounds if b != float("inf")]
+        metrics.append({"name": name, "histogram": {
+            "dataPoints": [{"timeUnixNano": ts, "attributes": attrs,
+                            "count": str(h.total), "sum": h.sum,
+                            "bucketCounts": [str(c) for c in h.counts],
+                            "explicitBounds": bounds}],
+            "aggregationTemporality": 2}})
+    return metrics
+
+
+def snapshots_to_otlp_metrics(snapshots,
+                              service_name: str = "orleans_tpu") -> dict:
+    """Convert stats snapshots (``StatsRegistry.snapshot()`` dicts, each
+    optionally carrying a ``silo`` name) into one OTLP/HTTP JSON
+    ``ExportMetricsServiceRequest``. The silo rides per data point
+    (``orleans.silo``) because one batch can merge several silos'
+    snapshots, while the resource names the exporting process — the same
+    split :func:`spans_to_otlp` uses."""
+    metrics: list[dict] = []
+    for snap in snapshots:
+        metrics.extend(_metric_points(snap))
+    return {"resourceMetrics": [{
+        "resource": {"attributes": [
+            {"key": "service.name",
+             "value": {"stringValue": service_name}}]},
+        "scopeMetrics": [{
+            "scope": {"name": "orleans_tpu.observability.metrics"},
+            "metrics": metrics,
+        }],
+    }]}
+
+
+class OtlpMetricsSink(_OtlpHttpSink):
+    """Streaming OTLP/HTTP *metrics* exporter: queued items are full
+    registry snapshots (the MetricsSampler offers one per push period),
+    so batches stay small — same bounded-queue/retry/drop discipline as
+    the span sink, tuned for snapshot-sized payloads."""
+
+    def __init__(self, endpoint: str, *, service_name: str = "orleans_tpu",
+                 batch_size: int = 4, flush_interval: float = 1.0,
+                 max_queue: int = 64, max_retries: int = 2,
+                 retry_backoff: float = 0.05, timeout: float = 2.0):
+        super().__init__(endpoint, service_name=service_name,
+                         batch_size=batch_size,
+                         flush_interval=flush_interval,
+                         max_queue=max_queue, max_retries=max_retries,
+                         retry_backoff=retry_backoff, timeout=timeout)
+
+    def _encode(self, batch: list[dict]) -> bytes:
+        return json.dumps(
+            snapshots_to_otlp_metrics(batch, self.service_name)).encode()

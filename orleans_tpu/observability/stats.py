@@ -12,7 +12,8 @@ import bisect
 import time
 from typing import Callable
 
-__all__ = ["StatsRegistry", "Histogram", "DISPATCH_STATS", "REBALANCE_STATS"]
+__all__ = ["StatsRegistry", "Histogram", "DISPATCH_STATS", "REBALANCE_STATS",
+           "INGEST_STATS", "INGEST_STAGES", "SIZE_BOUNDS", "COUNT_BOUNDS"]
 
 # Hot-lane dispatch counter pair (runtime.hotlane): hits = calls that ran
 # as frame-collapsed inline turns (including the always-interleave direct
@@ -45,20 +46,68 @@ REBALANCE_STATS = {
 }
 
 
-class Histogram:
-    """Fixed-bucket latency histogram (HistogramValueStatistic)."""
+# Canonical ingest-pipeline stage metrics (the socket→device attribution
+# substrate — ROADMAP "break the ingest wall"). Stage latency histograms
+# decompose one ingested message's wall time into contiguous segments
+# against a single monotonic stamp carried on the envelope (the
+# Message.received_at slot, wire-excluded, re-stamped at each boundary;
+# every observe/re-stamp happens BEFORE the step that could consume the
+# envelope — routing can synchronously run a turn and recycle the shell):
+#
+#   decode      wire.decode_message (native hotwire or pickle fallback);
+#               stamps received_at at decode end
+#   enqueue     arrival -> leaving the MessageCenter inbound queue
+#               (inline routing makes this ~0; a backlogged QoS category
+#               shows its queue dwell here); re-stamps before routing
+#   queue_wait  hand-off -> work start. Host tier: routing + mailbox +
+#               task scheduling, observed at turn start. Device tier:
+#               engine enqueue -> batch start (tick scheduling +
+#               conflict-deferred ticks), observed per item by the
+#               OWNING silo's engine only — forwarded/rejected hops
+#               never add samples
+#   staging     vector batch pack (pending invocations -> host arrays)
+#   transfer    host arrays -> device operands
+#   tick        kernel dispatch + device execution + host materialize
+#
+# Host-tier turns end at queue_wait (execution is scheduler.turn_length);
+# device-tier requests continue through staging/transfer/tick. Everything
+# is gated on SiloConfig.metrics_enabled — one attr check when off.
+INGEST_STAGES = ("decode", "enqueue", "queue_wait", "staging", "transfer",
+                 "tick")
 
-    # bucket upper bounds in seconds
+INGEST_STATS = {
+    "decode": "ingest.decode.seconds",
+    "decode_bytes": "ingest.decode.bytes",       # SIZE_BOUNDS histogram
+    "frames": "ingest.frames",                   # counter: frames decoded
+    "frame_batch": "ingest.frame_batch.size",    # COUNT_BOUNDS histogram
+    "enqueue": "ingest.enqueue.seconds",
+    "queue_wait": "ingest.queue_wait.seconds",
+    "turns": "ingest.turns",                     # counter: host turns timed
+    "staging": "ingest.staging.seconds",
+    "transfer": "ingest.transfer.seconds",
+    "tick": "ingest.tick.seconds",
+    "messages": "ingest.messages",               # counter: device msgs ticked
+}
+
+
+class Histogram:
+    """Fixed-bucket histogram (HistogramValueStatistic). Default bounds
+    are latency seconds; size/count series pass their own (SIZE_BOUNDS /
+    COUNT_BOUNDS below) — non-default bounds ride along in
+    :meth:`summary` so snapshots merge and expose losslessly."""
+
+    # default bucket upper bounds in seconds
     BOUNDS = [0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
               0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, float("inf")]
 
-    def __init__(self) -> None:
-        self.counts = [0] * len(self.BOUNDS)
+    def __init__(self, bounds: list[float] | None = None) -> None:
+        self.bounds = self.BOUNDS if bounds is None else list(bounds)
+        self.counts = [0] * len(self.bounds)
         self.total = 0
         self.sum = 0.0
 
     def observe(self, value: float) -> None:
-        self.counts[bisect.bisect_left(self.BOUNDS, value)] += 1
+        self.counts[bisect.bisect_left(self.bounds, value)] += 1
         self.total += 1
         self.sum += value
 
@@ -72,17 +121,37 @@ class Histogram:
         for i, c in enumerate(self.counts):
             seen += c
             if seen >= rank:
-                return self.BOUNDS[i]
-        return self.BOUNDS[-1]
+                return self.bounds[i]
+        return self.bounds[-1]
+
+    def quantile(self, q: float) -> float:
+        """Arbitrary-quantile read (the exposition-friendly name for
+        :meth:`percentile`; q in [0, 1])."""
+        return self.percentile(q)
+
+    def bucket_labels(self) -> list[str]:
+        """Prometheus/OpenMetrics ``le`` label values, one per bucket, in
+        bound order with the terminal ``+Inf`` — so the exposition endpoint
+        serves this histogram without re-bucketing."""
+        return [("+Inf" if b == float("inf") else f"{b:g}")
+                for b in self.bounds]
+
+    def cumulative_counts(self) -> list[int]:
+        """Per-bucket counts as the cumulative form the Prometheus
+        ``_bucket`` series requires (monotone, last == count)."""
+        out, running = [], 0
+        for c in self.counts:
+            running += c
+            out.append(running)
+        return out
 
     @property
     def mean(self) -> float:
         return self.sum / self.total if self.total else 0.0
 
     def merge(self, other: "Histogram") -> "Histogram":
-        """Fold another histogram in (same fixed buckets) — the
-        management grain aggregates per-silo histograms cluster-wide
-        with this."""
+        """Fold another histogram in (same buckets) — the management
+        grain aggregates per-silo histograms cluster-wide with this."""
         for i, c in enumerate(other.counts):
             self.counts[i] += c
         self.total += other.total
@@ -90,23 +159,35 @@ class Histogram:
         return self
 
     def summary(self) -> dict:
-        """The snapshot form (per-bucket counts ride along so summaries
-        merge losslessly via :meth:`from_snapshot`)."""
-        return {"count": self.total, "sum": self.sum, "mean": self.mean,
-                "p50": self.percentile(0.5), "p95": self.percentile(0.95),
-                "p99": self.percentile(0.99), "buckets": list(self.counts)}
+        """The snapshot form (per-bucket counts — and non-default bounds
+        — ride along so summaries merge losslessly via
+        :meth:`from_snapshot`)."""
+        out = {"count": self.total, "sum": self.sum, "mean": self.mean,
+               "p50": self.percentile(0.5), "p95": self.percentile(0.95),
+               "p99": self.percentile(0.99), "buckets": list(self.counts)}
+        if self.bounds is not self.BOUNDS:
+            out["bounds"] = list(self.bounds)
+        return out
 
     @classmethod
     def from_snapshot(cls, d: dict) -> "Histogram":
         """Rebuild from a :meth:`summary` dict (cross-silo aggregation:
         snapshots travel the wire, histogram objects do not)."""
-        h = cls()
+        h = cls(d.get("bounds"))
         counts = d.get("buckets")
         if counts and len(counts) == len(h.counts):
             h.counts = [int(c) for c in counts]
         h.total = int(d.get("count", sum(h.counts)))
         h.sum = float(d.get("sum", 0.0))
         return h
+
+
+# payload-size buckets (bytes) and small-count buckets (batch sizes) for
+# the ingest size/shape histograms — pass to StatsRegistry.histogram_with
+SIZE_BOUNDS = [64.0, 256.0, 1024.0, 4096.0, 16384.0, 65536.0, 262144.0,
+               1048576.0, 4194304.0, float("inf")]
+COUNT_BOUNDS = [1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
+                float("inf")]
 
 
 class StatsRegistry:
@@ -140,6 +221,15 @@ class StatsRegistry:
         h = self.histograms.get(name)
         if h is None:
             h = self.histograms[name] = Histogram()
+        return h
+
+    def histogram_with(self, name: str, bounds: list[float]) -> Histogram:
+        """Histogram with non-default bucket bounds (size/count series —
+        e.g. ``SIZE_BOUNDS`` for frame bytes); bounds apply on first
+        creation only, so call sites can pass them unconditionally."""
+        h = self.histograms.get(name)
+        if h is None:
+            h = self.histograms[name] = Histogram(bounds)
         return h
 
     def observe(self, name: str, value: float) -> None:

@@ -65,6 +65,7 @@ __all__ = [
     "TRACE_KEY", "Span", "SpanCollector", "current_trace",
     "new_trace_id", "new_span_id", "critical_path_breakdown",
     "RetentionPolicy", "LatencyErrorPolicy", "span_from_dict",
+    "mark_remote_if_traced",
 ]
 
 log = logging.getLogger("orleans.tracing")
@@ -175,33 +176,65 @@ class LatencyErrorPolicy(RetentionPolicy):
     of recently completed root latencies (``slow_percentile`` in (0,1);
     0 disables; needs a small warm-up history before it fires). A trace
     with no root span locally is never slow by this policy — only the
-    rooting collector sees the full round trip."""
+    rooting collector sees the full round trip.
+
+    ``auto=True`` (the ``trace_tail_auto`` knob): ``slow_threshold``
+    self-tunes from the same root-duration history — each decision damps
+    the threshold toward the ``slow_percentile`` cut (default 0.95 when
+    unset), so a workload whose baseline latency drifts keeps retaining
+    roughly the slowest ``1-p`` fraction instead of whatever a hand-set
+    absolute threshold happens to straddle. Until the history warms
+    (``_MIN_HISTORY`` roots) the configured static threshold applies
+    unchanged; retention in auto mode is strictly-above so a uniform
+    workload converges to retaining nothing, not everything."""
+
+    _AUTO_PERCENTILE = 0.95  # default cut when slow_percentile unset
+    _AUTO_DAMPING = 0.2      # per-decision step toward the current cut
 
     def __init__(self, slow_threshold: float = 0.1,
-                 slow_percentile: float = 0.0, history: int = 512):
+                 slow_percentile: float = 0.0, history: int = 512,
+                 auto: bool = False):
         self.slow_threshold = slow_threshold
         self.slow_percentile = slow_percentile
+        self.auto = auto
         self._durations: deque[float] = deque(maxlen=history)
         self._ranked: list[float] = []  # sorted twin, maintained via bisect
 
     _MIN_HISTORY = 16  # percentile over fewer samples is noise
+
+    def _observe(self, dur: float) -> None:
+        # maintained sorted twin: one insort + one bisect-delete per
+        # trace instead of re-sorting the whole history each decision
+        if len(self._durations) == self._durations.maxlen:
+            old = self._durations.popleft()
+            del self._ranked[bisect.bisect_left(self._ranked, old)]
+        self._durations.append(dur)
+        bisect.insort(self._ranked, dur)
 
     def decide(self, trace: "_PendingTrace") -> tuple[bool, str | None]:
         root = trace.root
         if root is None:
             return False, None
         dur = root.duration
+        if self.auto:
+            self._observe(dur)
+            n = len(self._ranked)
+            if n >= self._MIN_HISTORY:
+                p = self.slow_percentile or self._AUTO_PERCENTILE
+                cut = self._ranked[min(n - 1, int(p * n))]
+                t = self.slow_threshold
+                self.slow_threshold = cut if t <= 0 else \
+                    t + self._AUTO_DAMPING * (cut - t)
+            # strictly above: the threshold converges onto the cut, and a
+            # uniform workload (dur == cut) must not tail-retain everything
+            if self.slow_threshold > 0 and dur > self.slow_threshold:
+                return True, "slow_auto"
+            return False, None
         if self.slow_threshold > 0 and dur >= self.slow_threshold:
             return True, "slow"
         p = self.slow_percentile
         if p > 0:
-            # maintained sorted twin: one insort + one bisect-delete per
-            # trace instead of re-sorting the whole history each decision
-            if len(self._durations) == self._durations.maxlen:
-                old = self._durations.popleft()
-                del self._ranked[bisect.bisect_left(self._ranked, old)]
-            self._durations.append(dur)
-            bisect.insort(self._ranked, dur)
+            self._observe(dur)
             n = len(self._ranked)
             if n >= self._MIN_HISTORY:
                 cut = self._ranked[min(n - 1, int(p * n))]
@@ -216,7 +249,7 @@ class _PendingTrace:
     """Spans of one not-yet-decided trace buffered in tail mode."""
 
     __slots__ = ("spans", "root", "root_closed_mono", "last_mono",
-                 "error", "force")
+                 "error", "force", "remote")
 
     def __init__(self, now: float):
         self.spans: list[Span] = []
@@ -225,6 +258,10 @@ class _PendingTrace:
         self.last_mono = now
         self.error = False
         self.force = False
+        # "went remote" hint (mark_remote): any leg of this trace left the
+        # local process, so retention must pull peers before export. False
+        # = provably silo-local — the ctl_trace_spans fan-out is skipped.
+        self.remote = False
 
 
 class SpanCollector:
@@ -261,9 +298,14 @@ class SpanCollector:
         # async ``fetch(trace_id) -> list[span dict]`` pulling remote legs
         # of a trace this collector retained (silo: ctl_trace_spans fan-out)
         self.remote_fetcher = None
-        self._ret = {"kept": 0, "dropped": 0, "pulled": 0}
+        self._ret = {"kept": 0, "dropped": 0, "pulled": 0,
+                     "pull_skipped": 0}
         # insertion-ordered so the bound evicts the OLDEST pin, not all
         self._forced: dict[int, None] = {}
+        # "went remote" hints for traces with no pending entry yet (the
+        # root span usually closes LAST, after the outbound send that
+        # proves remoteness) — bounded, oldest-evicted like _forced
+        self._remote_hints: dict[int, None] = {}
         self._tasks: set = set()
         self._sweeper = None
         self._pump_at = 0.0
@@ -364,6 +406,10 @@ class SpanCollector:
                 else:
                     self._ret["dropped"] += 1
             e = self.pending[span.trace_id] = _PendingTrace(now)
+            if self._remote_hints.pop(span.trace_id, 0) is None:
+                # a send-side hook marked this trace remote before any of
+                # its spans closed locally (stored value is None; miss is 0)
+                e.remote = True
         elif len(e.spans) >= self._MAX_TRACE_SPANS and \
                 span.parent_id is not None:
             # cap the entry but KEEP it so the trace still gets exactly one
@@ -418,6 +464,7 @@ class SpanCollector:
                 # rooting collector dropped it (or died) — expire
                 self._ret["dropped"] += 1
                 self._forced.pop(tid, None)
+                self._remote_hints.pop(tid, None)
                 continue
             self._finalize(tid, e)
 
@@ -430,19 +477,29 @@ class SpanCollector:
         else:
             keep, reason = self.policy.decide(e)
         self._forced.pop(tid, None)
+        went_remote = e.remote or \
+            self._remote_hints.pop(tid, 0) is None
         if not keep:
             self._ret["dropped"] += 1
             return
         if self.remote_fetcher is not None:
-            try:
-                loop = asyncio.get_running_loop()
-            except RuntimeError:
-                loop = None
-            if loop is not None:
-                t = loop.create_task(self._retain_with_pull(tid, e, reason))
-                self._tasks.add(t)
-                t.add_done_callback(self._tasks.discard)
-                return
+            if not went_remote:
+                # silo-local trace (no leg ever left this process): every
+                # span is already here — skip the ctl_trace_spans fan-out
+                # to every peer, which would return nothing and cost one
+                # SYSTEM RPC per silo per retained trace
+                self._ret["pull_skipped"] += 1
+            else:
+                try:
+                    loop = asyncio.get_running_loop()
+                except RuntimeError:
+                    loop = None
+                if loop is not None:
+                    t = loop.create_task(
+                        self._retain_with_pull(tid, e, reason))
+                    self._tasks.add(t)
+                    t.add_done_callback(self._tasks.discard)
+                    return
         self._commit(e.spans, (), reason, e.root)
 
     async def _retain_with_pull(self, tid: int, e: _PendingTrace,
@@ -501,10 +558,30 @@ class SpanCollector:
                 self._ret["kept"] += 1
                 self._ret["pulled"] += 1
                 self._forced.pop(trace_id, None)
+                self._remote_hints.pop(trace_id, None)
             out.extend(s.to_dict() for s in e.spans)
         if limit is not None and len(out) > limit:
             out = out[-limit:]
         return out
+
+    def mark_remote(self, trace_id: int) -> None:
+        """Record that a leg of ``trace_id`` left this process (stamped by
+        the send paths: MessageCenter egress, client transmit). Retention
+        only fans ``ctl_trace_spans`` out to peers for marked traces —
+        silo-local traces skip the pull entirely (``pull_skipped``)."""
+        if not self.tail:
+            return
+        e = self.pending.get(trace_id)
+        if e is not None:
+            e.remote = True
+            return
+        if trace_id in self._remote_hints:
+            return
+        if len(self._remote_hints) >= 4096:
+            # bounded: evict the OLDEST hint — a lost hint degrades to a
+            # skipped pull (best-effort completeness), never an error
+            self._remote_hints.pop(next(iter(self._remote_hints)))
+        self._remote_hints[trace_id] = None
 
     def force_retain(self, trace_id: int) -> None:
         """Pin a trace through the tail decision regardless of policy
@@ -592,6 +669,7 @@ class SpanCollector:
             "kept": self._ret["kept"],
             "dropped": self._ret["dropped"],
             "pulled": self._ret["pulled"],
+            "pull_skipped": self._ret["pull_skipped"],
             "buffered": len(self.pending),
             "retained_spans": len(self.spans),
             "exported": 0, "export_dropped": 0,
@@ -627,6 +705,7 @@ class SpanCollector:
     def clear(self) -> None:
         self.spans.clear()
         self.pending.clear()
+        self._remote_hints.clear()
 
 
 def context_from_headers(request_context: dict | None
@@ -647,6 +726,19 @@ def context_from_headers(request_context: dict | None
         return (int(t), int(p), float(s))
     except (TypeError, ValueError):
         return None
+
+
+def mark_remote_if_traced(tracer, msg) -> None:
+    """Stamp the "went remote" retention hint for a traced message about
+    to leave its process — the ONE implementation behind every send-side
+    hook (silo fabric egress in MessageCenter.send_message; client
+    transmits in ClusterClient/GatewayClient). No-op outside tail mode
+    or for untraced messages; hardened header parsing like every other
+    runtime consumer of the baggage."""
+    if tracer is not None and tracer.tail and msg.request_context:
+        hdr = context_from_headers(msg.request_context)
+        if hdr is not None:
+            tracer.mark_remote(hdr[0])
 
 
 def restamp_header(request_context: dict | None) -> dict | None:

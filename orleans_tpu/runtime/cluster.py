@@ -214,6 +214,7 @@ class ClusterClient(RuntimeClient):
 
     def transmit(self, msg: Message) -> None:
         msg.sending_silo = self._address
+        self._mark_remote_trace(msg)  # client sends always leave the client
         gateways = self.fabric.alive_silos()
         if not gateways:
             raise SiloUnavailableError("no gateways available")

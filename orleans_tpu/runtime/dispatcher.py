@@ -51,7 +51,10 @@ if TYPE_CHECKING:
 
 log = logging.getLogger("orleans.dispatcher")
 
+from ..observability.stats import INGEST_STATS as _INGEST  # noqa: E402
 
+_QUEUE_WAIT = _INGEST["queue_wait"]
+_TURNS = _INGEST["turns"]
 
 MAX_FORWARD_COUNT = 2  # SiloMessagingOptions.MaxForwardCount default
 
@@ -60,6 +63,10 @@ class Dispatcher:
     def __init__(self, silo: "Silo"):
         self.silo = silo
         self.detect_deadlocks = silo.config.detect_deadlocks
+        # ingest stage metrics (observability.stats.INGEST_STATS): the
+        # silo's registry when metrics_enabled, else None — cached here so
+        # the per-turn guard is one attribute load
+        self._istats = silo.ingest_stats
         # in-flight device-tier state recoveries: (class, key_hash) →
         # future; concurrent calls for one recovering key share the load
         self._vector_recoveries: dict = {}
@@ -110,7 +117,8 @@ class Dispatcher:
         if msg.direction == Direction.RESPONSE:
             self.silo.runtime_client.receive_response(msg)
             return
-        if self.silo.tracer is not None and msg.received_at is None:
+        if msg.received_at is None and (self.silo.tracer is not None
+                                        or self._istats is not None):
             # arrival stamp for queue-wait attribution (covers the
             # loopback path; fabric arrivals are stamped at deliver)
             msg.received_at = time.monotonic()
@@ -242,6 +250,9 @@ class Dispatcher:
         if msg.is_expired:
             log.warning("dropping expired vector request %s", msg.method_name)
             return
+        # (no queue-wait observe here: vector requests record it in the
+        # engine, enqueue -> batch start, so only the OWNING silo's tick
+        # counts it — a forwarded/rejected hop must not add samples)
         # single-owner routing: device-tier state for a key lives in ONE
         # silo's table (the single-activation constraint); ring ownership
         # decides which, exactly like directory partitioning. Forward-count
@@ -403,6 +414,13 @@ class Dispatcher:
         token_a = current_activation.set(activation)
         RequestContext.import_(msg.request_context)
         t0 = time.monotonic()
+        ist = self._istats
+        if ist is not None and msg.received_at is not None:
+            # ingest queue-wait stage: fabric hand-off (or loopback
+            # arrival) -> this turn actually starting — inbound queue +
+            # mailbox + task scheduling, the backpressure signal
+            ist.observe(_QUEUE_WAIT, t0 - msg.received_at)
+            ist.increment(_TURNS)
         # server span: header presence == sampled (head-based sampling at
         # the root). Covers queue wait (arrival stamp → turn start) plus
         # execution, recorded separately; the network leg is derived from

@@ -34,6 +34,7 @@ from ..observability.tracing import (
     TRACE_KEY,
     context_from_headers,
     current_trace,
+    mark_remote_if_traced,
 )
 from .cancellation import register_outgoing_tokens
 from .context import (
@@ -161,25 +162,29 @@ class RuntimeClient:
                        tail: bool = False, tail_window: float = 0.25,
                        slow_threshold: float | None = None,
                        slow_percentile: float | None = None,
+                       auto_threshold: bool = False,
                        leg_ttl: float | None = None,
                        max_pending: int = 256,
                        policy=None, otlp_endpoint: str | None = None):
         """Install a SpanCollector so calls through this client open
         root client spans (head-based sampling at ``sample_rate``).
         ``tail=True`` defers keep/drop to trace completion (slow/errored/
-        forced survive — see TracingOptions.tail_*); ``otlp_endpoint``
+        forced survive — see TracingOptions.tail_*); ``auto_threshold``
+        self-tunes the slow threshold from the root-duration percentile
+        history (the ``trace_tail_auto`` knob); ``otlp_endpoint``
         attaches a streaming OTLP/HTTP sink for retained spans."""
         from ..observability.tracing import (LatencyErrorPolicy,
                                              SpanCollector)
         if policy is None and (slow_threshold is not None
-                               or slow_percentile is not None):
+                               or slow_percentile is not None
+                               or auto_threshold):
             # an omitted threshold keeps the class default (matching the
             # silo-side SiloConfig default) so one with_tracing() call
             # yields the SAME policy for client- and silo-rooted traces
             policy = LatencyErrorPolicy(
                 LatencyErrorPolicy().slow_threshold
                 if slow_threshold is None else slow_threshold,
-                slow_percentile or 0.0)
+                slow_percentile or 0.0, auto=auto_threshold)
         kw = {}
         if leg_ttl is not None:
             kw["leg_ttl"] = leg_ttl
@@ -192,6 +197,15 @@ class RuntimeClient:
             self.tracer.sinks.append(OtlpSink(otlp_endpoint,
                                               service_name=name))
         return self.tracer
+
+    def _mark_remote_trace(self, msg: Message) -> None:
+        """Stamp the "went remote" retention hint for a traced message
+        leaving this process (tail mode only): client transmits always
+        cross a process/collector boundary, so the rooting collector must
+        pull peer legs before export. Called by the client transmit paths
+        (ClusterClient/GatewayClient); silo egress stamps the same hint in
+        MessageCenter.send_message through the same shared helper."""
+        mark_remote_if_traced(self.tracer, msg)
 
     def try_direct_interleave(self, grain_id, method_name: str,
                               args: tuple, kwargs: dict):

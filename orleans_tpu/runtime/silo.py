@@ -25,6 +25,10 @@ from ..core.ids import GrainId, SiloAddress
 from ..core.message import Category, Direction, Message
 from ..core.serialization import copy_call_body, copy_result
 from ..observability.stats import DISPATCH_STATS, StatsRegistry
+from ..observability.stats import INGEST_STATS as _INGEST
+from ..observability.tracing import mark_remote_if_traced
+
+_INGEST_ENQUEUE = _INGEST["enqueue"]
 from .activation import ActivationState
 from ..storage.core import StorageManager
 from .cancellation import TokenInterner
@@ -141,6 +145,9 @@ class SiloConfig:
     trace_tail_window: float = 0.25
     trace_tail_slow_threshold: float = 0.1
     trace_tail_slow_percentile: float = 0.0
+    # auto-tune the tail slow threshold from the root-duration percentile
+    # history (LatencyErrorPolicy auto mode; config.TracingOptions.tail_auto)
+    trace_tail_auto: bool = False
     trace_tail_leg_ttl: float = 2.0
     trace_tail_max_pending: int = 256
     # streaming OTLP/HTTP export of retained spans (export.OtlpSink);
@@ -165,6 +172,22 @@ class SiloConfig:
     # local gate-admitting calls. Off → every call takes the full messaging
     # path (the perf-floor A/B lever; semantics are identical either way)
     hot_lane_enabled: bool = True
+    # live metrics pipeline (observability.metrics / config.MetricsOptions):
+    # stage-level ingest instrumentation (decode/enqueue/queue-wait/
+    # staging/transfer/tick histograms against the envelope's received_at
+    # stamp) + the queue/backpressure sampler loop. Off = one attribute
+    # check per instrumented site (guarded by
+    # tests/test_perf_floors.py::test_floor_metrics_overhead when on).
+    metrics_enabled: bool = False
+    metrics_sample_period: float = 1.0
+    metrics_window: float = 60.0
+    # Prometheus/OpenMetrics pull endpoint (GET /metrics, stdlib HTTP):
+    # None = no server, 0 = ephemeral port (read back from
+    # silo.metrics_server.port)
+    metrics_port: int | None = None
+    # periodic OTLP metrics push (export.OtlpMetricsSink); None = no sink
+    metrics_otlp_endpoint: str | None = None
+    metrics_otlp_period: float = 5.0
 
 
 class GrainRegistry:
@@ -203,6 +226,9 @@ class MessageCenter:
         self.inbound: dict[Category, asyncio.Queue[Message]] = {}
         self._pumps: list[asyncio.Task] = []
         self.running = False
+        # ingest stage metrics (INGEST_STATS): cached so _route pays one
+        # attribute load when metrics are off
+        self._istats = silo.ingest_stats
 
     def start(self) -> None:
         self.running = True
@@ -221,9 +247,12 @@ class MessageCenter:
         """Called by the fabric when a message arrives for this silo."""
         if not self.running:
             return
-        if self.silo.tracer is not None and msg.received_at is None:
+        if msg.received_at is None and (self.silo.tracer is not None
+                                        or self.silo.ingest_stats is not None):
             # arrival stamp: queue-wait attribution measures from HERE
-            # (inbound queue + mailbox) to turn start
+            # (inbound queue + mailbox) to turn start — tracing and the
+            # ingest stage metrics share the one envelope slot (socket
+            # arrivals were already stamped at decode)
             msg.received_at = time.monotonic()
         cfg = self.silo.config
         if (cfg.load_shedding_enabled
@@ -286,6 +315,16 @@ class MessageCenter:
                       for c in Category}
 
     def _route(self, msg: Message) -> None:
+        ist = self._istats
+        if ist is not None and msg.received_at is not None:
+            # ingest enqueue stage: decode/arrival -> leaving the inbound
+            # queue (inline routing makes this ~0; a backlogged category
+            # shows its queue dwell here). Observed and re-stamped BEFORE
+            # routing — the dispatcher may consume (and even recycle) the
+            # envelope synchronously, so this is the last safe touch.
+            now = time.monotonic()
+            ist.observe(_INGEST_ENQUEUE, now - msg.received_at)
+            msg.received_at = now
         self.silo.stats.increment(self._RECEIVED_STAT[msg.category])
         if msg.direction != Direction.RESPONSE and (
                 msg.target_silo is None
@@ -301,6 +340,12 @@ class MessageCenter:
         """Outbound to another silo/client via the fabric
         (MessageCenter.SendMessage:177-191)."""
         self.silo.stats.increment("messaging.sent")
+        # "went remote" hint: any traced leg leaving this process means
+        # retention must pull peers before export; traces that never pass
+        # here are provably silo-local and skip the pull fan-out
+        # (silo-local traffic loops back in dispatcher.transmit and never
+        # reaches this method)
+        mark_remote_if_traced(self.silo.tracer, msg)
         if msg.target_silo is not None and \
                 self.silo.fabric.is_dead(msg.target_silo):
             # dead target (MessageCenter SiloDeadOracle, Silo.cs:347):
@@ -455,6 +500,16 @@ class Silo:
         self.storage_manager = storage
         self.silo_address = fabric.allocate_address(config.name)
         self.stats = StatsRegistry()
+        # ingest stage instrumentation (observability.stats.INGEST_STATS):
+        # the registry when metrics are enabled, else None — every stage
+        # site (socket decode, message-center enqueue, dispatcher
+        # queue-wait, engine staging/transfer/tick) guards on that None,
+        # so the disabled hot path pays one attribute check
+        self.ingest_stats = self.stats if config.metrics_enabled else None
+        # metrics pipeline handles (installed at start when configured)
+        self.metrics = None          # observability.metrics.MetricsSampler
+        self.metrics_server = None   # observability.metrics.MetricsHttpServer
+        self.metrics_sink = None     # observability.export.OtlpMetricsSink
         # distributed tracing (observability.tracing): None unless enabled
         # — every hot-path site guards on that None
         self.tracer = None
@@ -467,7 +522,8 @@ class Silo:
                 tail=config.trace_tail_enabled,
                 tail_window=config.trace_tail_window,
                 policy=LatencyErrorPolicy(config.trace_tail_slow_threshold,
-                                          config.trace_tail_slow_percentile),
+                                          config.trace_tail_slow_percentile,
+                                          auto=config.trace_tail_auto),
                 leg_ttl=config.trace_tail_leg_ttl,
                 max_pending=config.trace_tail_max_pending)
             if config.trace_otlp_endpoint:
@@ -549,6 +605,23 @@ class Silo:
             self._eager_installed = True
         self.message_center.start()          # RuntimeServices
         self.catalog.start()
+        if self.config.metrics_enabled:
+            from ..observability.metrics import MetricsSampler
+            if self.config.metrics_otlp_endpoint:
+                from ..observability.export import OtlpMetricsSink
+                self.metrics_sink = OtlpMetricsSink(
+                    self.config.metrics_otlp_endpoint,
+                    service_name=self.config.name)
+            self.metrics = MetricsSampler(
+                self, period=self.config.metrics_sample_period,
+                window=self.config.metrics_window,
+                otlp_sink=self.metrics_sink,
+                otlp_period=self.config.metrics_otlp_period)
+            self.metrics.start()
+        if self.config.metrics_port is not None:
+            from ..observability.metrics import MetricsHttpServer
+            self.metrics_server = await MetricsHttpServer(self).start(
+                self.config.metrics_port)
         # replicated journaled grains need the notification target up
         # before any replica confirms events (eventsourcing notifications)
         for cls in self.registry.all_classes():
@@ -628,6 +701,18 @@ class Silo:
         if self.tracer is not None:
             # graceful: decide + export what's buffered; kill: drop it
             await self.tracer.aclose(flush=graceful)
+        if self.metrics is not None:
+            self.metrics.stop()
+            if graceful and self.metrics_sink is not None:
+                # final snapshot so the collector sees the end state
+                self.metrics.push_snapshot()
+            self.metrics = None
+        if self.metrics_sink is not None:
+            await self.metrics_sink.aclose(flush=graceful)
+            self.metrics_sink = None
+        if self.metrics_server is not None:
+            await self.metrics_server.aclose()
+            self.metrics_server = None
         self.message_center.stop()
         self.runtime_client.close()
         self.fabric.unregister_silo(self, dead=not graceful)

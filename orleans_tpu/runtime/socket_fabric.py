@@ -410,9 +410,24 @@ class SocketFabric:
                 self._route_owner[peer_addr] = silo.silo_address
                 self._client_native[peer_addr] = bool(
                     hs.get("hotwire", False))
-            async for headers, body in frame_stream(reader):
+            # ingest stage metrics (observability.stats.INGEST_STATS):
+            # decode is timed inside decode_message (which also stamps the
+            # envelope's received_at) and frames-per-read lands in the
+            # batch histogram. The later stages (enqueue/queue_wait) are
+            # observed downstream where the envelope is provably still
+            # live — routing can consume a message synchronously (inline
+            # turns, response correlation + recycle), so NOTHING here may
+            # touch msg after _route_inbound returns.
+            ist = silo.ingest_stats
+            on_batch = None
+            if ist is not None:
+                from ..observability.stats import COUNT_BOUNDS, INGEST_STATS
+                on_batch = ist.histogram_with(
+                    INGEST_STATS["frame_batch"], COUNT_BOUNDS).observe
+            async for headers, body in frame_stream(reader,
+                                                    on_batch=on_batch):
                 try:
-                    msg = decode_message(headers, body)
+                    msg = decode_message(headers, body, ist)
                 except _BodyDecodeError as e:
                     self._bounce_undecodable(e.message, str(e))
                     continue
@@ -635,6 +650,7 @@ class GatewayClient(RuntimeClient):
         return [c for c in self.conns if c.live]
 
     def transmit(self, msg: Message) -> None:
+        self._mark_remote_trace(msg)  # client sends always leave the client
         live = self._live()
         if not live:
             raise SiloUnavailableError("no live gateway connections")

@@ -32,6 +32,12 @@ from ..core import message as _msg_mod
 from ..core.ids import SiloAddress
 from ..core.message import Message
 from ..core.serialization import deserialize, serialize, serialize_portable
+from ..observability.stats import INGEST_STATS as _INGEST
+from ..observability.stats import SIZE_BOUNDS as _SIZE_BOUNDS
+
+_DECODE_SECONDS = _INGEST["decode"]
+_DECODE_BYTES = _INGEST["decode_bytes"]
+_FRAMES = _INGEST["frames"]
 
 __all__ = [
     "MAX_FRAME_SEGMENT", "FrameError", "WireDecodeError",
@@ -75,7 +81,8 @@ async def read_frame(reader: asyncio.StreamReader) -> tuple[bytes, bytes]:
     return headers, body
 
 
-async def frame_stream(reader: asyncio.StreamReader, chunk_size: int = 1 << 16):
+async def frame_stream(reader: asyncio.StreamReader, chunk_size: int = 1 << 16,
+                       on_batch=None):
     """Yield (headers, body) frames from a buffered chunk reader.
 
     The per-frame path (`read_frame`) costs three readexactly awaits per
@@ -83,11 +90,17 @@ async def frame_stream(reader: asyncio.StreamReader, chunk_size: int = 1 << 16):
     complete frame out of it (the IncomingMessageBuffer batching,
     IncomingMessageBuffer.cs:125). Ends cleanly at EOF on a frame
     boundary; raises IncompleteReadError for a mid-frame EOF and
-    FrameError for an oversized announcement (connection must drop)."""
+    FrameError for an oversized announcement (connection must drop).
+
+    ``on_batch`` (metrics): called with the number of complete frames
+    parsed out of each socket read — the receive-side batching-degree
+    signal (frames-per-wakeup ≈ how hard the sender/backlog is driving
+    this link)."""
     buf = bytearray()
     pos = 0
     while True:
         end = len(buf)
+        n_frames = 0
         while end - pos >= 8:
             hlen, blen = _LEN.unpack_from(buf, pos)
             if hlen > MAX_FRAME_SEGMENT or blen > MAX_FRAME_SEGMENT:
@@ -98,6 +111,9 @@ async def frame_stream(reader: asyncio.StreamReader, chunk_size: int = 1 << 16):
             h0 = pos + 8
             yield bytes(buf[h0:h0 + hlen]), bytes(buf[h0 + hlen:pos + total])
             pos += total
+            n_frames += 1
+        if on_batch is not None and n_frames:
+            on_batch(n_frames)
         if pos:
             del buf[:pos]
             pos = 0
@@ -195,7 +211,14 @@ def encode_message(msg: Message, native: bool = True) -> bytes:
     return encode_frame(headers, body)
 
 
-def decode_message(headers: bytes, body: bytes) -> Message:
+def decode_message(headers: bytes, body: bytes, stats=None) -> Message:
+    """Decode one frame into a Message. ``stats`` (a StatsRegistry, passed
+    by metrics-enabled receive paths) times the whole decode — native
+    hotwire or pickle fallback alike — into the ingest stage histograms
+    and stamps the envelope's ``received_at`` with the post-decode
+    monotonic clock, the single stamp every later ingest stage measures
+    against (and re-stamps at its own boundary)."""
+    t0 = time.monotonic() if stats is not None else 0.0
     msg = Message.__new__(Message)
     try:
         if headers[:1] == b"\xa7" and _HW_FRAMES and \
@@ -235,6 +258,13 @@ def decode_message(headers: bytes, body: bytes) -> Message:
     except Exception as e:  # noqa: BLE001 — body failure is per-message
         msg.body = None
         raise _BodyDecodeError(msg, e) from e
+    if stats is not None:
+        now = time.monotonic()
+        stats.observe(_DECODE_SECONDS, now - t0)
+        stats.histogram_with(_DECODE_BYTES, _SIZE_BOUNDS).observe(
+            len(headers) + len(body))
+        stats.increment(_FRAMES)
+        msg.received_at = now  # ingest stage stamp (enqueue measures next)
     return msg
 
 

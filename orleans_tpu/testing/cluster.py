@@ -117,6 +117,28 @@ class TestClusterBuilder:
             lambda b: add_transactions(b, **kw))
         return self
 
+    def with_metrics(self, sample_period: float = 0.1,
+                     window: float = 60.0, *,
+                     port: int | None = None,
+                     otlp_endpoint: str | None = None,
+                     otlp_period: float = 0.25) -> "TestClusterBuilder":
+        """Live metrics pipeline on every silo (ingest stage
+        instrumentation + queue/backpressure sampler; optionally the
+        Prometheus endpoint — ``port=0`` binds ephemeral, read back from
+        ``silo.metrics_server.port`` — and OTLP metrics push). Test-sized
+        defaults: the sampler ticks fast enough for short tests to see
+        windows fill."""
+        cfg = dict(metrics_enabled=True,
+                   metrics_sample_period=sample_period,
+                   metrics_window=window)
+        if port is not None:
+            cfg["metrics_port"] = port
+        if otlp_endpoint is not None:
+            cfg["metrics_otlp_endpoint"] = otlp_endpoint
+            cfg["metrics_otlp_period"] = otlp_period
+        self.config.update(cfg)
+        return self
+
     def with_tracing(self, sample_rate: float = 1.0,
                      buffer_size: int = 4096, *, tail: bool = False,
                      tail_window: float = 0.25,

@@ -1,0 +1,68 @@
+"""Shared fake OTLP/HTTP collector for the export tests (spans AND
+metrics sinks — one implementation, parameterized by path)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+__all__ = ["FakeCollector"]
+
+
+class FakeCollector:
+    """Minimal local OTLP/HTTP collector: records request bodies; can be
+    scripted to fail the first N posts (503 by default) to exercise the
+    sinks' retry/backoff path."""
+
+    def __init__(self, fail_first: int = 0, fail_status: int = 503,
+                 path: str = "/v1/traces"):
+        self.bodies: list[dict] = []
+        self.path = path
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802 — http.server API
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n)
+                with outer._lock:
+                    if outer.fail_first > 0:
+                        outer.fail_first -= 1
+                        self.send_response(fail_status)
+                        self.end_headers()
+                        return
+                    outer.bodies.append(json.loads(raw))
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):  # keep test output clean
+                pass
+
+        self.fail_first = fail_first
+        self.server = HTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://127.0.0.1:{self.server.server_port}{self.path}"
+
+    def span_count(self) -> int:
+        with self._lock:
+            return sum(len(sp)
+                       for b in self.bodies
+                       for rs in b["resourceSpans"]
+                       for ss in rs["scopeSpans"]
+                       for sp in [ss["spans"]])
+
+    def metric_names(self) -> set[str]:
+        with self._lock:
+            return {m["name"]
+                    for b in self.bodies
+                    for rm in b["resourceMetrics"]
+                    for sm in rm["scopeMetrics"]
+                    for m in sm["metrics"]}
+
+    def close(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()

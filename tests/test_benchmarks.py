@@ -18,6 +18,40 @@ async def test_ping_harness():
         _check(r)
 
 
+async def test_ingest_attribution_harness():
+    """ISSUE 6 acceptance: the ingest-attribution point reports a
+    per-stage breakdown whose shares sum to ≈1.0 of the measured ingest
+    wall time, covering both the host stages (decode/enqueue/queue_wait,
+    counted per socket frame) and the device stages
+    (staging/transfer/tick, counted per vector batch)."""
+    from benchmarks import ingest_attribution
+
+    r = await ingest_attribution.run(seconds=0.5, concurrency=8,
+                                     n_grains=16, n_keys=16)
+    _check(r)
+    shares = r["extra"]["stage_shares"]
+    assert set(shares) == {"decode", "enqueue", "queue_wait", "staging",
+                           "transfer", "tick"}
+    assert abs(sum(shares.values()) - 1.0) < 0.01
+    counts = r["extra"]["stage_counts"]
+    # every socket frame is decoded once and passes the inbound-queue
+    # boundary once; every call (host turn or vector item) records one
+    # queue_wait sample on the owning silo
+    assert counts["decode"] == counts["enqueue"] >= r["extra"]["calls"]
+    assert counts["queue_wait"] >= r["extra"]["calls"]
+    assert counts["tick"] >= 1 and counts["staging"] == counts["tick"]
+    assert r["extra"]["frames_decoded"] >= r["extra"]["calls"]
+
+
+async def test_metrics_overhead_harness():
+    from benchmarks.ping import bench_metrics_overhead
+
+    r = await bench_metrics_overhead(n_grains=16, concurrency=8,
+                                     seconds=0.3)
+    _check(r)
+    assert r["extra"]["metered_calls_per_sec"] > 0
+
+
 async def test_mapreduce_harness():
     r = await mapreduce.run(n_mappers=4, n_reducers=2, words_per_block=200,
                             repeats=1)

@@ -7,10 +7,7 @@ rejection/resend span events; the response-leg network span; and the
 sampled-trace hot lane rolling the head die inside the lane."""
 
 import asyncio
-import json
-import threading
 import time
-from http.server import BaseHTTPRequestHandler, HTTPServer
 
 import pytest
 
@@ -271,53 +268,7 @@ async def test_cross_silo_leg_pull_via_control_path():
 # ----------------------------------------------------------------------
 # OTLP sink: batching / payload shape / retry / drop
 # ----------------------------------------------------------------------
-class _FakeCollector:
-    """Minimal local OTLP/HTTP collector: records request bodies; can be
-    scripted to fail the first N posts."""
-
-    def __init__(self, fail_first: int = 0, fail_status: int = 503):
-        self.bodies: list[dict] = []
-        self._lock = threading.Lock()
-        outer = self
-
-        class Handler(BaseHTTPRequestHandler):
-            def do_POST(self):  # noqa: N802 — http.server API
-                n = int(self.headers.get("Content-Length", 0))
-                raw = self.rfile.read(n)
-                with outer._lock:
-                    if outer.fail_first > 0:
-                        outer.fail_first -= 1
-                        self.send_response(fail_status)
-                        self.end_headers()
-                        return
-                    outer.bodies.append(json.loads(raw))
-                self.send_response(200)
-                self.end_headers()
-
-            def log_message(self, *a):  # keep test output clean
-                pass
-
-        self.fail_first = fail_first
-        self.server = HTTPServer(("127.0.0.1", 0), Handler)
-        self.thread = threading.Thread(target=self.server.serve_forever,
-                                       daemon=True)
-        self.thread.start()
-
-    @property
-    def endpoint(self) -> str:
-        return f"http://127.0.0.1:{self.server.server_port}/v1/traces"
-
-    def span_count(self) -> int:
-        with self._lock:
-            return sum(len(sp)
-                       for b in self.bodies
-                       for rs in b["resourceSpans"]
-                       for ss in rs["scopeSpans"]
-                       for sp in [ss["spans"]])
-
-    def close(self) -> None:
-        self.server.shutdown()
-        self.server.server_close()
+from fake_otlp import FakeCollector as _FakeCollector  # noqa: E402
 
 
 def _mk_span_dicts(n, trace_id=0xabc, error_on=None, events_on=None):
@@ -581,3 +532,129 @@ async def test_hotlane_rate_zero_and_one_unchanged():
                 await g.ping(i)
             engaged = cluster.client.hot_hits - h0 == 20
             assert engaged is expect_hot, (rate, engaged)
+
+
+# ----------------------------------------------------------------------
+# Adaptive tail threshold (trace_tail_auto) — ISSUE 6 satellite
+# ----------------------------------------------------------------------
+def test_latency_policy_auto_threshold_adapts_down_and_retains_outlier():
+    """Auto mode converges slow_threshold onto the root-duration
+    percentile cut: a badly hand-set threshold (10s) self-tunes down to
+    the workload's actual latency band, after which a real outlier
+    retains while the uniform baseline keeps dropping."""
+    pol = LatencyErrorPolicy(slow_threshold=10.0, auto=True)
+    c = SpanCollector("s", tail=True, tail_window=0.0, policy=pol)
+
+    def one(dur):
+        root = c.open("op", "client", trace_id=c.new_trace_id(),
+                      parent_id=None)
+        c.close(root, duration=dur)
+        c.flush_tail(force=True)
+
+    for _ in range(64):
+        one(0.01)                       # uniform fast workload
+    assert c.retention_stats()["kept"] == 0   # strictly-above: all drop
+    assert pol.slow_threshold < 0.1           # converged down from 10.0
+    one(0.2)                                  # 20x outlier
+    assert c.retention_stats()["kept"] == 1
+    root = [s for s in c.snapshot() if s["parent_id"] is None][0]
+    assert root["attrs"]["retained"] == "slow_auto"
+
+
+def test_latency_policy_auto_uses_static_threshold_until_warm():
+    """Below _MIN_HISTORY roots the configured static threshold applies
+    unchanged (no percentile to tune against yet)."""
+    pol = LatencyErrorPolicy(slow_threshold=0.05, auto=True)
+    c = SpanCollector("s", tail=True, tail_window=0.0, policy=pol)
+    root = c.open("op", "client", trace_id=c.new_trace_id(),
+                  parent_id=None)
+    c.close(root, duration=0.2)   # > static threshold, history cold
+    c.flush_tail(force=True)
+    assert c.retention_stats()["kept"] == 1
+    assert pol.slow_threshold == 0.05  # untouched before warm-up
+
+
+async def test_tail_auto_knob_wires_through_silo_config():
+    from orleans_tpu.runtime import SiloBuilder
+
+    silo = (SiloBuilder().with_name("auto-tail")
+            .with_config(trace_enabled=True, trace_tail_enabled=True,
+                         trace_tail_auto=True).build())
+    assert silo.tracer.policy.auto is True
+
+
+# ----------------------------------------------------------------------
+# Local-trace pull skip ("went remote" hint) — ISSUE 6 satellite
+# ----------------------------------------------------------------------
+async def test_retention_pull_skipped_for_local_trace_and_runs_for_remote():
+    fetched = []
+
+    async def fetcher(tid):
+        fetched.append(tid)
+        return []
+
+    pol = LatencyErrorPolicy(slow_threshold=1e-9)  # keep everything
+    c = SpanCollector("s", tail=True, tail_window=0.0, policy=pol)
+    c.remote_fetcher = fetcher
+
+    # trace 1: never marked remote -> retained WITHOUT fanning the pull
+    t1 = c.new_trace_id()
+    c.close(c.open("local", "client", t1, None), duration=0.01)
+    c.flush_tail(force=True)
+    await c.drain_tail()
+    assert c.retention_stats()["kept"] == 1
+    assert c.retention_stats()["pull_skipped"] == 1
+    assert fetched == []
+
+    # trace 2: marked remote BEFORE any span closed (hint path) -> pulled
+    t2 = c.new_trace_id()
+    c.mark_remote(t2)
+    c.close(c.open("remote", "client", t2, None), duration=0.01)
+    c.flush_tail(force=True)
+    await c.drain_tail()
+    assert fetched == [t2]
+    assert c.retention_stats()["kept"] == 2
+    assert c.retention_stats()["pull_skipped"] == 1
+
+    # trace 3: marked remote AFTER a leg closed (live pending entry)
+    t3 = c.new_trace_id()
+    c.close(c.open("child", "server", t3, 7), duration=0.001)
+    c.mark_remote(t3)
+    c.close(c.open("root", "client", t3, None), duration=0.01)
+    c.flush_tail(force=True)
+    await c.drain_tail()
+    assert fetched == [t2, t3]
+
+
+async def test_silo_local_trace_skips_control_path_fanout():
+    """A silo-rooted trace whose call never leaves the silo retains
+    without the ctl_trace_spans fan-out (pull_skipped counts it); the
+    spans are all local so the export is already whole."""
+    cluster = (TestClusterBuilder(1).add_grains(ProxyGrain, SlowEchoGrain)
+               .with_tracing(tail=True, tail_window=0.1,
+                             slow_threshold=0.05, client=False)
+               .build())
+    async with cluster:
+        silo = cluster.silos[0]
+        pulls = []
+        real_fetcher = silo.tracer.remote_fetcher
+        assert real_fetcher is not None
+
+        async def spying_fetcher(tid):
+            pulls.append(tid)
+            return await real_fetcher(tid)
+
+        silo.tracer.remote_fetcher = spying_fetcher
+        # ProxyGrain.relay roots the trace silo-side; SlowEchoGrain lives
+        # on the same (only) silo, so no leg ever crosses the fabric
+        assert await cluster.grain(ProxyGrain, 1).relay(1, 5) == 5
+        await cluster.drain_traces()
+        stats = silo.tracer.retention_stats()
+        assert stats["kept"] >= 1
+        assert stats["pull_skipped"] >= 1
+        assert pulls == []  # the fan-out never ran
+        # the retained trace is complete: root + callee server turn
+        spans = silo.tracer.snapshot()
+        tids = {s["trace_id"] for s in spans if s["parent_id"] is None}
+        assert any(s["kind"] == "server" and s["trace_id"] in tids
+                   for s in spans)
