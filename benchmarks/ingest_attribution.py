@@ -62,9 +62,12 @@ def _make_vector_grain():
 
 
 async def run(seconds: float = 2.0, concurrency: int = 32,
-              n_grains: int = 64, n_keys: int = 64) -> dict:
+              n_grains: int = 64, n_keys: int = 64,
+              batched: bool = True) -> dict:
     """One silo over real TCP, metrics on, mixed host + device traffic;
-    returns the stage breakdown in the BENCH extra."""
+    returns the stage breakdown in the BENCH extra. ``batched=False``
+    flips the silo to the per-frame ingest path (the A/B lever) so the
+    stage shares can be compared at the same concurrency."""
     import numpy as np
 
     from orleans_tpu.dispatch import add_vector_grains
@@ -74,7 +77,8 @@ async def run(seconds: float = 2.0, concurrency: int = 32,
     fabric = SocketFabric()
     b = (SiloBuilder().with_name("ingest-silo").with_fabric(fabric)
          .add_grains(EchoGrain)
-         .with_config(metrics_enabled=True, metrics_sample_period=0.25))
+         .with_config(metrics_enabled=True, metrics_sample_period=0.25,
+                      batched_ingress=batched))
     add_vector_grains(b, EchoVec, mesh=make_mesh(1),
                       dense={EchoVec: n_keys})
     silo = b.build()
@@ -136,6 +140,7 @@ async def run(seconds: float = 2.0, concurrency: int = 32,
         "vs_baseline": None,
         "extra": {
             "seconds": seconds, "concurrency": concurrency,
+            "batched": batched,
             "calls": calls,
             "stage_seconds": {k: round(v, 4)
                               for k, v in stage_seconds.items()},
@@ -154,12 +159,148 @@ async def run(seconds: float = 2.0, concurrency: int = 32,
     }
 
 
+async def _drain(silo) -> None:
+    """Let one injection round fully retire: vector ticks flush, host
+    turn tasks complete."""
+    rt = silo.vector
+    while True:
+        if rt is not None and rt.pending:
+            await rt.flush()
+        if not any(not t.done() for t in silo.dispatcher._turn_tasks):
+            return
+        await asyncio.sleep(0)
+
+
+async def run_ab(n_msgs: int = 512, seconds: float = 1.5,
+                 host_every: int = 8) -> dict:
+    """Batched-vs-per-frame ingest hand-off A/B (the PR-7 lever, measured
+    at the boundary the queue-wait attribution blamed).
+
+    One silo, mixed messaging+vector traffic: a wire batch of ``n_msgs``
+    ONE_WAY requests (1-in-``host_every`` host-tier pings, the rest
+    device-tier vector pings — the regime the ingest wall is about) is
+    pre-encoded once, then injected repeatedly for ``seconds`` through
+    each hand-off:
+
+      per_frame   the PR-6 path: Python length-prefix walk, one
+                  decode_message + one MessageCenter.deliver per frame
+                  (addressing + rt.call per message)
+      batched     ONE decode_frames pass (a single unpack_batch C call)
+                  + ONE deliver_batch (vector calls grouped into
+                  call_group engine enqueues)
+
+    Both sides decode the same bytes and retire the same work (ticks +
+    turns drain between rounds), so the ratio isolates the hand-off —
+    interpreter-independent, like the hot-lane margin floor."""
+    import numpy as np
+
+    from orleans_tpu.core.ids import GrainId, GrainType
+    from orleans_tpu.core.message import Direction, make_request
+    from orleans_tpu.dispatch import add_vector_grains
+    from orleans_tpu.parallel import make_mesh
+    from orleans_tpu.runtime.cluster import InProcFabric
+    from orleans_tpu.runtime.wire import (decode_frames, decode_message,
+                                          encode_message)
+
+    EchoVec = _make_vector_grain()
+    b = (SiloBuilder().with_name("ingest-ab")
+         .with_fabric(InProcFabric())
+         .add_grains(EchoGrain))
+    add_vector_grains(b, EchoVec, mesh=make_mesh(1), dense={EchoVec: n_msgs})
+    silo = b.build()
+    await silo.start()
+    try:
+        # warmup: activate the host grains, compile the vector kernels
+        # (both bucket sizes the rounds will hit)
+        hostg = GrainType.of("EchoGrain")
+        vecg = GrainType.of("EchoVec")
+        frames = []
+        n_host = 0
+        for i in range(n_msgs):
+            if i % host_every == 0:
+                msg = make_request(
+                    target_grain=GrainId.for_grain(hostg, i),
+                    interface_name="EchoGrain", method_name="ping",
+                    body=((i,), {}), direction=Direction.ONE_WAY)
+                n_host += 1
+            else:
+                # plain-int payloads ride the native value codec (an
+                # np.int32 body would pickle-escape per message, and that
+                # decode cost — identical on both sides — only dilutes
+                # the hand-off ratio being measured)
+                msg = make_request(
+                    target_grain=GrainId.for_grain(vecg, i),
+                    interface_name="EchoVec", method_name="ping",
+                    body=((), {"x": i & 0x7FFF}),
+                    direction=Direction.ONE_WAY)
+            frames.append(encode_message(msg))
+        batch = bytearray(b"".join(frames))
+        mc = silo.message_center
+
+        def inject_per_frame() -> int:
+            import struct
+            pos, end = 0, len(batch)
+            n = 0
+            while end - pos >= 8:
+                hlen, blen = struct.unpack_from("<II", batch, pos)
+                h0 = pos + 8
+                headers = bytes(batch[h0:h0 + hlen])
+                body = bytes(batch[h0 + hlen:h0 + hlen + blen])
+                pos = h0 + hlen + blen
+                mc.deliver(decode_message(headers, body))
+                n += 1
+            return n
+
+        def inject_batched() -> int:
+            _, msgs, _ = decode_frames(batch)
+            mc.deliver_batch(msgs)
+            return len(msgs)
+
+        async def measure(inject) -> float:
+            # warmup round compiles kernels / fills caches
+            inject()
+            await _drain(silo)
+            total = 0
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < seconds:
+                total += inject()
+                await _drain(silo)
+            return total / (time.perf_counter() - t0)
+
+        per_frame = await measure(inject_per_frame)
+        batched = await measure(inject_batched)
+    finally:
+        await silo.stop()
+    ratio = batched / per_frame if per_frame else 0.0
+    return {
+        "metric": "batched_ingest_speedup",
+        "value": round(ratio, 2),
+        "unit": "x",
+        "vs_baseline": None,
+        "extra": {
+            "per_frame_msgs_per_sec": round(per_frame, 1),
+            "batched_msgs_per_sec": round(batched, 1),
+            "n_msgs": n_msgs, "host_frac": round(n_host / n_msgs, 3),
+            "seconds": seconds,
+        },
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seconds", type=float, default=3.0)
     ap.add_argument("--concurrency", type=int, default=32)
+    ap.add_argument("--ab", action="store_true",
+                    help="run the batched-vs-per-frame hand-off A/B")
+    ap.add_argument("--per-frame", action="store_true",
+                    help="attribution with batched ingress OFF (the "
+                         "share-comparison baseline)")
     a = ap.parse_args()
-    print(json.dumps(asyncio.run(run(a.seconds, a.concurrency))))
+    if a.ab:
+        print(json.dumps(asyncio.run(run_ab(seconds=a.seconds))))
+    else:
+        print(json.dumps(asyncio.run(run(a.seconds, a.concurrency,
+                                         batched=not a.per_frame))))
 
 
 if __name__ == "__main__":

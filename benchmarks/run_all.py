@@ -52,9 +52,21 @@ def main() -> None:
         n_grains=128, concurrency=50, seconds=1.5))))
     # ingest attribution: socket -> decode/enqueue/queue-wait ->
     # staging/transfer/tick stage breakdown (shares sum to 1.0 of the
-    # measured ingest wall — the substrate the ingest-wall work lands on)
+    # measured ingest wall — the substrate the ingest-wall work lands
+    # on), emitted batched AND per-frame at the same concurrency so the
+    # queue-wait share drop is read side by side (PR 7: below
+    # saturation the share falls ~0.92 -> ~0.75; at closed-loop
+    # saturation wait is Little's-law-bound and only the absolute
+    # per-message wait drops)
     print(json.dumps(asyncio.run(ingest_attribution.run(
-        seconds=2.0, concurrency=32))))
+        seconds=2.0, concurrency=8))))
+    print(json.dumps(asyncio.run(ingest_attribution.run(
+        seconds=2.0, concurrency=8, batched=False))))
+    # batched-vs-per-frame ingest hand-off A/B (one decode_frames +
+    # deliver_batch vs N decode_message + deliver for identical bytes;
+    # CI floor 1.5x in test_floor_batched_ingest, measured 3-5x)
+    print(json.dumps(asyncio.run(ingest_attribution.run_ab(
+        n_msgs=512, seconds=1.5))))
     print(json.dumps(asyncio.run(mapreduce.run())))
     for r in serialization.run():
         print(json.dumps(r))

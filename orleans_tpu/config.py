@@ -56,11 +56,15 @@ class ClusterOptions:
 @dataclass
 class MessagingOptions:
     """MessagingOptions / SiloMessagingOptions: timeouts, queue limits,
-    stuck-turn age limit (MaxRequestProcessingTime)."""
+    stuck-turn age limit (MaxRequestProcessingTime), and the batched
+    ingress pipeline switch (``batched_ingress=False`` restores the
+    per-frame decode + per-message hand-off — the A/B lever; wire bytes
+    are identical either way)."""
 
     response_timeout: float = 30.0
     max_enqueued_requests: int = 5000
     max_request_processing_time: float = 60.0
+    batched_ingress: bool = True
 
     def validate(self) -> None:
         # no cross-field rule tying max_request_processing_time to
@@ -130,13 +134,26 @@ class MembershipOptions:
 class LoadSheddingOptions:
     """LoadSheddingOptions: gateway ingress shed under overload. The
     reference sheds on CPU%; the host-tier analog sheds on application
-    inbound queue depth."""
+    inbound queue depth — and, when ``queue_wait_limit`` > 0, on the
+    WINDOWED ingest queue-wait trend (the INGEST_STATS backpressure
+    signal fed from host turn starts and device batch starts): depth
+    alone misses slow-drain overload where the queue stays short but
+    every message waits long."""
 
     enabled: bool = False
     limit: int = 10_000
+    # shed while the mean observed queue-wait over the last
+    # ``queue_wait_window`` seconds exceeds this many seconds; 0 disables
+    # the trend signal (depth-only, the pre-trend behavior)
+    queue_wait_limit: float = 0.0
+    queue_wait_window: float = 5.0
 
     def validate(self) -> None:
-        _positive(self, "limit")
+        _positive(self, "limit", "queue_wait_window")
+        if self.queue_wait_limit < 0:
+            raise ConfigurationError(
+                "load shedding queue_wait_limit must be >= 0 "
+                "(0 disables the trend signal)")
 
 
 @dataclass
@@ -292,6 +309,7 @@ _FLAT_MAP = {
     "max_enqueued_requests": (MessagingOptions, "max_enqueued_requests"),
     "max_request_processing_time": (MessagingOptions,
                                     "max_request_processing_time"),
+    "batched_ingress": (MessagingOptions, "batched_ingress"),
     "turn_warning_length": (SchedulingOptions, "turn_warning_length"),
     "detect_deadlocks": (SchedulingOptions, "detect_deadlocks"),
     "collection_age": (GrainCollectionOptions, "collection_age"),
@@ -313,6 +331,8 @@ _FLAT_MAP = {
                                        "cache_refresh_period"),
     "load_shedding_enabled": (LoadSheddingOptions, "enabled"),
     "load_shedding_limit": (LoadSheddingOptions, "limit"),
+    "load_shedding_queue_wait": (LoadSheddingOptions, "queue_wait_limit"),
+    "load_shedding_window": (LoadSheddingOptions, "queue_wait_window"),
     "rebalance_period": (RebalanceOptions, "period"),
     "rebalance_budget": (RebalanceOptions, "budget"),
     "rebalance_imbalance_ratio": (RebalanceOptions, "imbalance_ratio"),

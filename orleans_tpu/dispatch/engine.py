@@ -27,6 +27,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+import warnings
 from functools import partial
 from typing import Any
 
@@ -132,12 +133,58 @@ class _DensePlan:
         return jax.tree_util.tree_map(one, results)
 
 
+class _StagingSet:
+    """One preallocated ``[n_shards, B, ...]`` host staging buffer set for
+    a (class, method) batch bucket: the batch operands (slots/key-hashes/
+    fresh/valid) plus one array per schema field. Two sets per bucket
+    alternate between "filling from ingress" and "donated to the tick
+    kernel" (see ``VectorRuntime._staging_acquire``), so steady-state
+    ingest never allocates — and never touches a buffer whose device
+    upload could still be in flight."""
+
+    __slots__ = ("slots", "khash", "fresh", "valid", "args", "used", "sink")
+
+    def __init__(self, n: int, B: int, sink: int, schema: dict):
+        self.slots = np.full((n, B), sink, dtype=np.int32)
+        self.khash = np.zeros((n, B), dtype=np.int32)
+        self.fresh = np.zeros((n, B), dtype=bool)
+        self.valid = np.zeros((n, B), dtype=bool)
+        self.args = {f: np.zeros((n, B, *shape), dtype=dtype)
+                     for f, (dtype, shape) in schema.items()}
+        self.used = [0] * n  # lanes filled per shard on the LAST use
+        self.sink = sink     # the junk row every idle lane points at
+
+    def reset(self, sink: int) -> None:
+        """Re-arm for the next fill: only the previously-used lane prefix
+        needs slots→sink + valid→False (stale khash/fresh/args lanes are
+        inert once their slot is the junk sink row and valid is False;
+        re-filled lanes are fully overwritten). When the sink itself
+        moved — a table grow() turns the OLD sink row (== old capacity)
+        into a real allocatable slot — every lane must re-point, not
+        just the used prefix: a stale idle lane still aimed at the old
+        sink would otherwise scatter into a live actor's row."""
+        if sink != self.sink:
+            self.slots[:] = sink
+            self.valid[:] = False
+            self.fresh[:] = False
+            self.sink = sink
+            self.used = [0] * len(self.used)
+            return
+        for s, c in enumerate(self.used):
+            if c:
+                self.slots[s, :c] = sink
+                self.valid[s, :c] = False
+            self.used[s] = 0
+
+
 class _Pending:
     """One queued invocation in the hashed (per-key) path. ``t_enq`` is
     the monotonic enqueue stamp (0.0 with metrics off): the engine's
     queue-wait stage measures it against batch start, so tick-scheduling
     delay AND conflict-deferred extra ticks are attributed, on the owning
-    silo only."""
+    silo only. ``future`` may be None (one-way batched-ingress calls —
+    nothing consumes the per-lane result, so the batch skips the
+    future/callback machinery for them entirely)."""
 
     __slots__ = ("key_hash", "shard", "slot", "fresh", "args", "future",
                  "t_enq")
@@ -205,6 +252,16 @@ class VectorRuntime:
         # integer add on an already-deferring path)
         self.track_load = False
         self.conflicts_deferred = 0
+        # double-buffered host staging (the batched-ingress hand-off):
+        # per (class, method) → per buffer signature → two _StagingSets
+        # alternating fill/in-flight, plus the last-batch fill count (the
+        # sampler's staging-occupancy gauge)
+        self._staging: dict[tuple, dict] = {}
+        self.staging_fill = 0
+        # load-shed queue-wait trend (observability.stats.QueueWaitTrend),
+        # set by dispatch.hosting when the owning silo sheds on trend:
+        # device batch starts feed it beside the INGEST queue_wait stage
+        self.shed_trend = None
         # distributed-tracing collector (observability.tracing), set by
         # dispatch.hosting when the owning silo traces: each batch records
         # a "device_tick" span AND opens a jax.profiler.TraceAnnotation so
@@ -339,9 +396,66 @@ class VectorRuntime:
         fut = loop.create_future()
         self.pending.setdefault((grain_class, method), []).append(
             _Pending(key_hash, shard, slot, fresh, args, fut,
-                     time.monotonic() if self.stats is not None else 0.0))
+                     time.monotonic()
+                     if (self.stats is not None
+                         or self.shed_trend is not None) else 0.0))
         self._schedule_tick(loop)
         return fut
+
+    def call_group(self, grain_class: type, method: str,
+                   items: list) -> list:
+        """Grouped enqueue — the engine half of the batched ingress
+        hand-off. ``items`` is a list of ``(key_hash, kwargs,
+        want_future)`` triples for ONE (class, method); every invocation
+        joins the pending batch with a single method/table resolution,
+        one enqueue stamp, and one tick schedule, instead of N
+        :meth:`call` hops. Returns one entry per item in item order
+        (within-batch arrival order is preserved into the tick's lane
+        layout): a future where ``want_future`` was set, else None —
+        one-way calls skip the future/set_result/callback machinery
+        entirely, which is a large slice of the per-message hand-off
+        cost at batch sizes. A per-item schema violation resolves THAT
+        item's future with the error (or drops the one-way item, the
+        per-message one-way contract); the rest of the group proceeds."""
+        m = self.method_of(grain_class, method)
+        schema = m.args_schema
+        skeys = schema.keys() if schema is not None else None
+        tbl = self.table(grain_class)
+        loop = asyncio.get_running_loop()
+        t_enq = time.monotonic() if (self.stats is not None or
+                                     self.shed_trend is not None) else 0.0
+        pend: list | None = None  # created on first ENQUEUED item so an
+        # all-failed group never leaves an empty pending entry behind (a
+        # tick over it would crash first-batch schema inference)
+        dense_n, per = tbl.dense_n, tbl.dense_per_shard
+        futs: list = []
+        for key_hash, args, want_future in items:
+            fut = loop.create_future() if want_future else None
+            futs.append(fut)
+            try:
+                if skeys is not None and args.keys() != skeys:
+                    _validate_args(grain_class, method, schema, args)
+                if 0 <= key_hash < dense_n:
+                    shard = key_hash // per
+                    slot = key_hash % per
+                    fresh = not bool(tbl.dense_active[key_hash])
+                    tbl.dense_active[key_hash] = True
+                else:
+                    shard, slot, fresh = tbl.lookup_or_allocate(key_hash)
+            except Exception as e:  # noqa: BLE001 — schema violation or
+                # slot-allocation failure: scoped to THIS item (a raise
+                # escaping mid-loop would error-bounce the whole group
+                # while already-enqueued items still tick)
+                if fut is not None:
+                    fut.set_exception(e)
+                continue
+            if pend is None:
+                pend = self.pending.setdefault((grain_class, method), [])
+            pend.append(_Pending(key_hash, shard, slot, fresh, args, fut,
+                                 t_enq))
+        if pend is not None:
+            self._schedule_tick(loop)
+        return futs
 
     # -- write-behind dirty tracking (consumed by storage.checkpoint) ----
     def enable_dirty_tracking(self) -> None:
@@ -382,6 +496,43 @@ class VectorRuntime:
             return np.zeros(0, dtype=np.int64)
         return np.unique(np.concatenate(batches))
 
+    def _staging_acquire(self, cls: type, method: str, tbl,
+                         B: int, schema: dict) -> _StagingSet:
+        """Check out the "filling" half of the double-buffered staging
+        pair for this (class, method, B, schema) bucket. The OTHER half
+        is the one the in-flight tick's device upload consumed — by the
+        time a buffer rotates back here its tick has synced (the batch
+        materializes results on host before resolving futures), so
+        refilling can never race a kernel still reading it."""
+        pool = self._staging.get((cls, method))
+        if pool is None:
+            pool = self._staging[(cls, method)] = {}
+        sig = (tbl.n_shards, B, tuple(sorted(
+            (f, np.dtype(d).str, tuple(int(x) for x in shape))
+            for f, (d, shape) in schema.items())))
+        entry = pool.get(sig)
+        if entry is None:
+            entry = pool[sig] = [[], 0]
+        sets, idx = entry
+        if len(sets) < 2:
+            st = _StagingSet(tbl.n_shards, B, tbl.sink_slot, schema)
+            sets.append(st)
+            entry[1] = len(sets) % 2
+            return st
+        st = sets[idx]
+        entry[1] = idx ^ 1
+        st.reset(tbl.sink_slot)
+        return st
+
+    def staging_lanes(self) -> int:
+        """Total preallocated staging lanes across every double-buffer
+        set (the staging-buffer footprint gauge)."""
+        total = 0
+        for pool in self._staging.values():
+            for (n, B, _sig), (sets, _idx) in pool.items():
+                total += n * B * len(sets)
+        return total
+
     def _schedule_tick(self, loop) -> None:
         if not self._tick_scheduled:
             self._tick_scheduled = True
@@ -406,7 +557,7 @@ class VectorRuntime:
                 log.exception("vector tick failed for %s.%s",
                               cls.__name__, method)
                 for p in items:
-                    if not p.future.done():
+                    if p.future is not None and not p.future.done():
                         p.future.set_exception(e)
         self.ticks += 1
         if self.pending:  # conflict-deferred work → next tick
@@ -417,7 +568,10 @@ class VectorRuntime:
         t_stage = now_mono = 0.0
         if st is not None:
             t_stage = time.perf_counter()
+        if st is not None or self.shed_trend is not None:
             now_mono = time.monotonic()  # queue-wait ends at batch start
+            # (the shed trend needs the stamp even with metrics off —
+            # t_enq is gated the same way in call/call_group)
         tbl = self.tables[cls]
         m = tbl.methods[method]
         # schema inference is committed only after a successful batch so a
@@ -445,23 +599,25 @@ class VectorRuntime:
         for p in ready:
             per_shard[p.shard].append(p)
         B = _bucket(max(len(ps) for ps in per_shard))
-        slots = np.full((n, B), tbl.sink_slot, dtype=np.int32)
-        # key hashes ride to the device as 31-bit ints (x64 is disabled;
-        # initial_state only needs a per-actor seed, not the full hash)
-        khash = np.zeros((n, B), dtype=np.int32)
-        fresh = np.zeros((n, B), dtype=bool)
-        valid = np.zeros((n, B), dtype=bool)
-        args_stacked: dict[str, np.ndarray] = {}
-        for fname, (dtype, shape) in schema.items():
-            args_stacked[fname] = np.zeros((n, B, *shape), dtype=dtype)
+        # double-buffered staging: one preallocated buffer set fills here
+        # while its twin may still back the previous tick's device upload
+        # — steady-state ingest allocates nothing host-side
+        stg = self._staging_acquire(cls, method, tbl, B, schema)
+        slots, khash = stg.slots, stg.khash
+        fresh, valid = stg.fresh, stg.valid
+        args_stacked = stg.args
         for s, ps in enumerate(per_shard):
+            stg.used[s] = len(ps)
             for i, p in enumerate(ps):
                 slots[s, i] = p.slot
+                # key hashes ride to the device as 31-bit ints (x64 is
+                # disabled; initial_state only needs a per-actor seed)
                 khash[s, i] = p.key_hash & 0x7FFFFFFF
                 fresh[s, i] = p.fresh
                 valid[s, i] = True
                 for fname in schema:
                     args_stacked[fname][s, i] = p.args[fname]
+        self.staging_fill = len(ready)
         if inferred:
             m.args_schema = schema  # needed by the kernel builder
         t_xfer = t_tick = 0.0
@@ -474,10 +630,20 @@ class VectorRuntime:
             for p in ready:
                 if p.t_enq:
                     st.observe(_QUEUE_WAIT, max(0.0, now_mono - p.t_enq))
+        if self.shed_trend is not None:
+            # feed the load-shed trend with this batch's mean queue wait
+            stamped = [now_mono - p.t_enq for p in ready if p.t_enq]
+            if stamped:
+                self.shed_trend.note(
+                    max(0.0, sum(stamped) / len(stamped)))
         tracer = self.tracer
         tick_span = None
         try:
-            kernel = self._kernel(cls, method, B)
+            # operand buffers are donated: these device arrays are fresh
+            # per tick (never the cached _DensePlan operands), so XLA may
+            # reuse them as the kernel's output/scratch — the device_put
+            # below becomes a donation hand-off, not a second copy
+            kernel = self._kernel(cls, method, B, donate_operands=True)
             kernel_args = (
                 tbl.state, jnp.asarray(slots), jnp.asarray(khash),
                 jnp.asarray(fresh), jnp.asarray(valid),
@@ -516,6 +682,14 @@ class VectorRuntime:
             tbl.record_hits(slots, valid)
         # resolve futures from the result batch
         host = jax.tree_util.tree_map(np.asarray, results)
+        if not jax.tree_util.tree_leaves(host):
+            # result-less method: no np.asarray above synced anything, so
+            # block on the state output before this tick's staging
+            # buffers can rotate back to "filling" — on async-transfer
+            # backends (TPU) the operands' host→device upload must have
+            # provably completed before the numpy buffers are reused
+            # (free on CPU, where the transfer copies synchronously)
+            jax.block_until_ready(new_state)
         if st is not None:
             # tick closes AFTER the host transfer for the same reason the
             # span below does: jax dispatch is async, and the np.asarray
@@ -530,7 +704,7 @@ class VectorRuntime:
             tracer.close(tick_span, batch=len(ready))
         for s, ps in enumerate(per_shard):
             for i, p in enumerate(ps):
-                if not p.future.done():
+                if p.future is not None and not p.future.done():
                     p.future.set_result(jax.tree_util.tree_map(
                         lambda a: a[s, i], host))
         self.messages_processed += len(ready)
@@ -917,18 +1091,39 @@ class VectorRuntime:
     # Kernel construction
     # ------------------------------------------------------------------
     def _kernel(self, cls: type, method: str, B: int,
-                contiguous: bool = False):
+                contiguous: bool = False, donate_operands: bool = False):
         tbl = self.tables[cls]
-        key = (cls, method, B, tbl.capacity, tbl.n_shards, contiguous)
+        key = (cls, method, B, tbl.capacity, tbl.n_shards, contiguous,
+               donate_operands)
         k = self._kernel_cache.get(key)
         if k is None:
-            k = self._build_kernel(cls, method, contiguous=contiguous)
+            k = self._build_kernel(cls, method, contiguous=contiguous,
+                                   donate_operands=donate_operands)
             self._kernel_cache[key] = k
+            if donate_operands:
+                # first invocation compiles, and compiling an operand-
+                # donating kernel emits a known-benign UserWarning for
+                # buffers XLA cannot alias (the bool masks always;
+                # slots/khash when no same-shape output remains —
+                # donation stays correct, they just aren't aliased).
+                # Suppress it for THAT call only: the cache holds the
+                # raw kernel, so steady-state ticks never touch the
+                # process warnings filter, and application JAX code
+                # keeps the diagnostic for its own kernels.
+                raw = k
+
+                def k(*a, _raw=raw):
+                    with warnings.catch_warnings():
+                        warnings.filterwarnings(
+                            "ignore",
+                            message="Some donated buffers were not usable")
+                        return _raw(*a)
         return k
 
     def _build_kernel(self, cls: type, method: str, scan_rounds: int = 0,
                       contiguous: bool = False,
-                      scan_all_valid: bool = False):
+                      scan_all_valid: bool = False,
+                      donate_operands: bool = False):
         tbl = self.tables[cls]
         m = tbl.methods[method]
         handler = m.fn
@@ -1052,4 +1247,16 @@ class VectorRuntime:
                 check_vma=False)
         # else: single-shard — shard_map is semantically a no-op but pays a
         # large dispatch penalty (committed shardings); plain jit
-        return jax.jit(body, donate_argnums=(0,) if not read_only else ())
+        if read_only:
+            donate: tuple = ()
+        elif donate_operands:
+            # per-tick operand buffers (slots/khash/fresh/valid/args) are
+            # fresh arrays the caller never reuses — donate them alongside
+            # the state so the staging hand-off is zero-copy where XLA can
+            # alias and scratch-reuse elsewhere. NEVER set for kernels fed
+            # by cached _DensePlan.device_operands (those persist across
+            # ticks by design).
+            donate = (0, 1, 2, 3, 4, 5)
+        else:
+            donate = (0,)
+        return jax.jit(body, donate_argnums=donate)

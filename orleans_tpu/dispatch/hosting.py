@@ -66,6 +66,10 @@ def add_vector_grains(builder, *grain_classes: type[VectorGrain],
             # device-half ingest attribution (staging/transfer/tick land
             # in the silo's registry beside the host-side stages)
             silo.vector.stats = silo.ingest_stats
+        if silo.shed_trend is not None:
+            # device-tier queue-wait feeds the same load-shed trend the
+            # host turns feed (vector-heavy overload sheds too)
+            silo.vector.shed_trend = silo.shed_trend
         silo.vector.register(*grain_classes)
         for cls in grain_classes:
             silo.vector_interfaces[cls.__name__] = cls

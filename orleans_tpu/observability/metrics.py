@@ -144,14 +144,55 @@ class MetricsSampler:
                         lambda: len(_rc_mod._CB_POOL))
         self.add_source("turns.in_flight",
                         lambda: len(silo.dispatcher._turn_tasks))
+        # storage/journal queue depths (ROADMAP metrics follow-on): the
+        # write-path backpressure signals — operations awaiting a storage
+        # provider, and unconfirmed journaled events buffered grain-side
+        self.add_source("storage.inflight_ops",
+                        lambda: silo.storage_manager.inflight)
+        if self._has_journaled_grains():
+            # the unconfirmed-events walk is O(activations) per sample
+            # tick — only worth installing when a journaled class is
+            # actually registered
+            self.add_source("journal.unconfirmed_events",
+                            self._journal_unconfirmed)
         if silo.tracer is not None:
             self.add_source("trace.pending_traces",
                             lambda: len(silo.tracer.pending))
             self.add_source("trace.retained_spans",
                             lambda: len(silo.tracer.spans))
         if silo.vector is not None:
-            self.add_source("vector.queue_depth",
-                            lambda: silo.vector.queue_depth())
+            self._install_vector_sources()
+
+    def _install_vector_sources(self) -> None:
+        silo = self.silo
+        self.add_source("vector.queue_depth",
+                        lambda: silo.vector.queue_depth())
+        # batched-ingress staging: preallocated double-buffer footprint
+        # and the last batch's fill — occupancy of the staging hand-off
+        self.add_source("vector.staging_lanes",
+                        lambda: silo.vector.staging_lanes())
+        self.add_source("vector.staging_fill",
+                        lambda: silo.vector.staging_fill)
+
+    def _has_journaled_grains(self) -> bool:
+        from ..eventsourcing.journaled import JournaledGrain
+        return any(isinstance(c, type) and issubclass(c, JournaledGrain)
+                   for c in self.silo.registry.all_classes())
+
+    def _journal_unconfirmed(self) -> float:
+        """Unconfirmed (tentative) journaled events across every local
+        activation — >0 sustained means confirm_events is outrunning the
+        journal provider. Scoped to real JournaledGrain instances: an
+        application grain's private ``_pending`` attribute must not
+        inflate the gauge."""
+        from ..eventsourcing.journaled import JournaledGrain
+        total = 0
+        for act in self.silo.catalog.by_activation.values():
+            inst = act.grain_instance
+            if isinstance(inst, JournaledGrain):
+                # default for an instance still mid-activation
+                total += len(getattr(inst, "_pending", ()))
+        return float(total)
 
     def _queue_depth(self, cat) -> float:
         q = self.silo.message_center.inbound.get(cat)
@@ -162,8 +203,7 @@ class MetricsSampler:
         if self.silo.vector is not None and \
                 "vector.queue_depth" not in self._sources:
             # the device tier may have been installed after construction
-            self.add_source("vector.queue_depth",
-                            lambda: self.silo.vector.queue_depth())
+            self._install_vector_sources()
         self._task = asyncio.get_running_loop().create_task(self._loop())
 
     def stop(self) -> None:

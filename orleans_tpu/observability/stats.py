@@ -10,10 +10,12 @@ from __future__ import annotations
 
 import bisect
 import time
+from collections import deque
 from typing import Callable
 
-__all__ = ["StatsRegistry", "Histogram", "DISPATCH_STATS", "REBALANCE_STATS",
-           "INGEST_STATS", "INGEST_STAGES", "SIZE_BOUNDS", "COUNT_BOUNDS"]
+__all__ = ["StatsRegistry", "Histogram", "QueueWaitTrend", "DISPATCH_STATS",
+           "REBALANCE_STATS", "INGEST_STATS", "INGEST_STAGES", "SIZE_BOUNDS",
+           "COUNT_BOUNDS"]
 
 # Hot-lane dispatch counter pair (runtime.hotlane): hits = calls that ran
 # as frame-collapsed inline turns (including the always-interleave direct
@@ -180,6 +182,49 @@ class Histogram:
         h.total = int(d.get("count", sum(h.counts)))
         h.sum = float(d.get("sum", 0.0))
         return h
+
+
+class QueueWaitTrend:
+    """Windowed mean of the ingest queue-wait signal, for the load-shed
+    decision (ROADMAP metrics follow-on: shed on queue-wait TREND, not
+    instantaneous depth). Bounded (ts, seconds) samples over ``window``
+    seconds with an O(1) running sum; fed from the same sites that
+    observe ``INGEST_STATS['queue_wait']`` (host turn start + device
+    batch start), so a gateway sheds while messages are *waiting long*,
+    which depth alone misses when the queue is short but slow-draining.
+    Single-loop use only (no locking, like the registry itself)."""
+
+    __slots__ = ("window", "max_samples", "_samples", "_sum")
+
+    def __init__(self, window: float = 5.0, max_samples: int = 4096):
+        self.window = window
+        self.max_samples = max_samples
+        self._samples: deque[tuple[float, float]] = deque()
+        self._sum = 0.0
+
+    def note(self, seconds: float, now: float | None = None) -> None:
+        now = time.monotonic() if now is None else now
+        self._samples.append((now, seconds))
+        self._sum += seconds
+        if len(self._samples) > self.max_samples:
+            _, v = self._samples.popleft()
+            self._sum -= v
+        self._evict(now)
+
+    def _evict(self, now: float) -> None:
+        cutoff = now - self.window
+        samples = self._samples
+        while samples and samples[0][0] < cutoff:
+            _, v = samples.popleft()
+            self._sum -= v
+
+    def mean(self, now: float | None = None) -> float:
+        self._evict(time.monotonic() if now is None else now)
+        n = len(self._samples)
+        return self._sum / n if n else 0.0
+
+    def __len__(self) -> int:
+        return len(self._samples)
 
 
 # payload-size buckets (bytes) and small-count buckets (batch sizes) for

@@ -51,6 +51,10 @@ if TYPE_CHECKING:
 
 log = logging.getLogger("orleans.dispatcher")
 
+# default for _finish_vector_call's hdr: "not parsed yet" (None means a
+# parse already happened and found no trace header)
+_HDR_UNPARSED = object()
+
 from ..observability.stats import INGEST_STATS as _INGEST  # noqa: E402
 
 _QUEUE_WAIT = _INGEST["queue_wait"]
@@ -118,7 +122,8 @@ class Dispatcher:
             self.silo.runtime_client.receive_response(msg)
             return
         if msg.received_at is None and (self.silo.tracer is not None
-                                        or self._istats is not None):
+                                        or self._istats is not None
+                                        or self.silo.shed_trend is not None):
             # arrival stamp for queue-wait attribution (covers the
             # loopback path; fabric arrivals are stamped at deliver)
             msg.received_at = time.monotonic()
@@ -302,9 +307,20 @@ class Dispatcher:
             if msg.direction != Direction.ONE_WAY:
                 self.send_response(msg, make_error_response(msg, e))
             return
+        self._finish_vector_call(msg, fut)
+
+    def _finish_vector_call(self, msg: Message, fut: "asyncio.Future",
+                            hdr=_HDR_UNPARSED) -> None:
+        """Attach the response plumbing for one device-tier call: the
+        device span (host view of the batched kernel turn) and the
+        tick-resolved response callback. Shared by the per-message bridge
+        and the batched ingress path (receive_vector_batch, which hands
+        in the trace header it already parsed for the want-future
+        decision)."""
         tracer = self.silo.tracer
         if tracer is not None:
-            hdr = context_from_headers(msg.request_context)
+            if hdr is _HDR_UNPARSED:
+                hdr = context_from_headers(msg.request_context)
             if hdr is not None:
                 # device span: enqueue → tick-resolved future (the host
                 # view of the batched kernel turn; the engine's own tick
@@ -314,6 +330,10 @@ class Dispatcher:
                     hdr[0], hdr[1])
                 fut.add_done_callback(lambda f, s=vspan: tracer.close(s))
         if msg.direction == Direction.ONE_WAY:
+            # retrieve a failed tick's exception so the loop never logs
+            # "exception was never retrieved" for fire-and-forget calls
+            fut.add_done_callback(
+                lambda f: None if f.cancelled() else f.exception())
             return
 
         def done(f: "asyncio.Future") -> None:
@@ -326,6 +346,102 @@ class Dispatcher:
                 self.send_response(msg, make_response(msg, f.result()))
 
         fut.add_done_callback(done)
+
+    def receive_vector_batch(self, vcls: type, msgs: list) -> None:
+        """Batched twin of :meth:`_handle_vector_request`: one ingress
+        batch's calls for a device-tier class join the engine as grouped
+        per-method enqueues (``VectorRuntime.call_group``) — one method/
+        table resolution and ONE tick schedule for N messages instead of
+        N ``rt.call`` hops. This is the queue-wait killer on the vector
+        path: the whole socket read's calls land in the same tick batch.
+        Messages needing the slow path (ownership forward, storage
+        recovery, malformed bodies) peel off to the per-message handler,
+        which preserves their exact semantics."""
+        rt = self.silo.vector
+        my_addr = self.silo.silo_address
+        ring = self.silo.locator.ring
+        bridge = getattr(self.silo, "vector_bridges", {}).get(vcls)
+        tbl = rt.table(vcls)
+        tracer = self.silo.tracer
+        now = time.monotonic()
+        groups: dict[str, list] = {}
+        for msg in msgs:
+            if msg.expires_at is not None and now > msg.expires_at:
+                log.warning("dropping expired vector request %s",
+                            msg.method_name)
+                continue
+            owner = ring.owner(msg.target_grain.uniform_hash)
+            if owner is not None and owner != my_addr:
+                if msg.target_silo is None or msg.target_silo != my_addr:
+                    # unaddressed gateway ingress: address like the
+                    # per-frame _route (send_message, no forward budget
+                    # burned in steady state)
+                    try:
+                        msg.target_silo = None
+                        self.send_message(msg)
+                    except Exception:  # noqa: BLE001 — one message only
+                        log.exception("batched vector re-address failed "
+                                      "for %s", msg.method_name)
+                else:
+                    # a peer deliberately addressed this HERE and our
+                    # ring view disagrees — a real stale-view hop: the
+                    # per-message handler's forward_count++/bound keeps
+                    # split-view ping-pong finite (without it, two
+                    # batched silos with crossed views would relay a
+                    # message forever)
+                    self._handle_vector_request(vcls, msg)
+                continue
+            try:
+                args, kwargs = msg.body if msg.body is not None else ((), {})
+                if args:
+                    raise TypeError(
+                        f"vector grain methods take keyword arguments only "
+                        f"(schema-bound); got {len(args)} positional")
+                if not isinstance(kwargs, dict):
+                    # scope the bad payload HERE: a non-dict reaching
+                    # call_group would raise outside its per-item guard
+                    # and error-bounce the whole group
+                    raise TypeError(
+                        f"vector grain call body must carry a kwargs dict; "
+                        f"got {type(kwargs).__name__}")
+                key_hash = rt.key_hash_for(msg.target_grain.key,
+                                           msg.target_grain.uniform_hash)
+            except Exception as e:  # noqa: BLE001 — body shape → caller
+                if msg.direction != Direction.ONE_WAY:
+                    self.send_response(msg, make_error_response(msg, e))
+                continue
+            if bridge is not None and \
+                    self._vector_key_is_fresh(rt, vcls, key_hash):
+                # first touch with write-behind storage: recovery path
+                self._handle_vector_request(vcls, msg)
+                continue
+            tbl.note_route(key_hash, msg.target_grain.uniform_hash)
+            g = groups.get(msg.method_name)
+            if g is None:
+                g = groups[msg.method_name] = []
+            # one-way calls need no result plumbing — the engine skips
+            # their futures entirely. Exception: a SAMPLED one-way (trace
+            # header present) still needs its device span closed at tick
+            # resolution; the unsampled majority must not pay the
+            # future/callback cost just because a tracer is installed.
+            # Parsed once here and handed to _finish_vector_call below.
+            hdr = (context_from_headers(msg.request_context)
+                   if tracer is not None else None)
+            want = msg.direction != Direction.ONE_WAY or hdr is not None
+            g.append((msg, key_hash, kwargs, want, hdr))
+        for method, items in groups.items():
+            try:
+                futs = rt.call_group(vcls, method,
+                                     [(kh, kw, w) for _, kh, kw, w, _ in
+                                      items])
+            except Exception as e:  # noqa: BLE001 — unknown method etc.
+                for m, _, _, _, _ in items:
+                    if m.direction != Direction.ONE_WAY:
+                        self.send_response(m, make_error_response(m, e))
+                continue
+            for (m, _, _, _, hdr), fut in zip(items, futs):
+                if fut is not None:
+                    self._finish_vector_call(m, fut, hdr)
 
     @staticmethod
     def _vector_key_is_fresh(rt, vcls: type, key_hash: int) -> bool:
@@ -415,12 +531,18 @@ class Dispatcher:
         RequestContext.import_(msg.request_context)
         t0 = time.monotonic()
         ist = self._istats
-        if ist is not None and msg.received_at is not None:
-            # ingest queue-wait stage: fabric hand-off (or loopback
-            # arrival) -> this turn actually starting — inbound queue +
-            # mailbox + task scheduling, the backpressure signal
-            ist.observe(_QUEUE_WAIT, t0 - msg.received_at)
-            ist.increment(_TURNS)
+        if msg.received_at is not None:
+            if ist is not None:
+                # ingest queue-wait stage: fabric hand-off (or loopback
+                # arrival) -> this turn actually starting — inbound queue
+                # + mailbox + task scheduling, the backpressure signal
+                ist.observe(_QUEUE_WAIT, t0 - msg.received_at)
+                ist.increment(_TURNS)
+            trend = self.silo.shed_trend
+            if trend is not None:
+                # same signal feeds the load-shed trend (shed on windowed
+                # queue-wait, not instantaneous depth)
+                trend.note(max(0.0, t0 - msg.received_at), t0)
         # server span: header presence == sampled (head-based sampling at
         # the root). Covers queue wait (arrival stamp → turn start) plus
         # execution, recorded separately; the network leg is derived from

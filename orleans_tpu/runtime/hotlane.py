@@ -81,6 +81,9 @@ class HotTurnMarker:
 
 _MARKER_POOL: list[HotTurnMarker] = []
 _MARKER_POOL_CAP = 256
+# forced-yield cadence when the loop has nothing else ready (see the
+# batch-aware fairness note at the end of _hot_turn)
+_HOT_YIELD_EVERY = 64
 # ONE sequence of negative ids for every running-marker kind (hot-lane
 # markers here AND silo._DirectCallMarker): negative so they can never
 # collide with wire message ids in an activation's running_since map, and
@@ -271,11 +274,25 @@ async def _hot_turn(client, silo: "Silo", act: "ActivationData", inv,
         # messages that arrived during the call queued behind the running
         # marker; nothing else pumps them for an inline turn
         silo.dispatcher.run_message_pump(act)
-    # once-per-RPC fairness yield — the same contract the messaging path
-    # enforces in RuntimeClient._await_response: a tight loop of
-    # non-suspending hot calls crosses the event loop once per call, so
-    # background tasks (membership probes, reminders, tickers) keep
-    # running.  Costs ~30% of the collapsed turn's headroom and is the
-    # difference between a fast path and a liveness hazard.
-    await asyncio.sleep(0)
+    # Batch-aware fairness yield — the liveness contract the messaging
+    # path enforces in RuntimeClient._await_response, minus its tax when
+    # it buys nothing.  The old once-per-RPC unconditional sleep(0) cost
+    # ~30% of the collapsed turn's headroom; yielding is only USEFUL when
+    # the event loop actually has other ready callbacks to run (a starved
+    # ticker task, a queued turn, a completed IO wakeup).  So: yield when
+    # the loop's ready queue is non-empty (our own frame was popped off it
+    # before running, so anything in it is someone else), and otherwise
+    # force one yield every _HOT_YIELD_EVERY collapsed turns — timer
+    # callbacks (membership probes, reminders) sit in the SCHEDULED heap,
+    # not the ready queue, and only migrate across a loop iteration, so a
+    # ready-queue check alone would re-open the starvation hazard
+    # test_tight_call_loop guards (the bound keeps it to ~64 sub-30µs
+    # turns, far under any probe period).  Loops without a _ready deque
+    # (non-CPython event loops) keep the per-call yield.
+    ready = getattr(asyncio.get_running_loop(), "_ready", None)
+    client.hot_calls_since_yield += 1
+    if ready is None or ready or \
+            client.hot_calls_since_yield >= _HOT_YIELD_EVERY:
+        client.hot_calls_since_yield = 0
+        await asyncio.sleep(0)
     return result

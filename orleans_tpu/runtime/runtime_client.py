@@ -156,6 +156,10 @@ class RuntimeClient:
         self.hot_hits = 0
         self.hot_fallbacks = 0
         self.hot_lane_enabled = True
+        # batch-aware fairness (hotlane._hot_turn): collapsed turns since
+        # the last event-loop yield — bounds the forced-yield cadence when
+        # the loop has nothing else ready
+        self.hot_calls_since_yield = 0
 
     def enable_tracing(self, sample_rate: float = 1.0,
                        buffer_size: int = 4096, name: str = "client", *,

@@ -106,6 +106,18 @@ class SiloConfig:
     # reference's CPU-threshold shed)
     load_shedding_enabled: bool = False
     load_shedding_limit: int = 10_000
+    # queue-wait-trend shedding (the INGEST_STATS backpressure signal):
+    # when > 0, client ingress is also shed while the WINDOWED mean of
+    # observed ingest queue-wait (host turn start + device batch start)
+    # exceeds this many seconds — depth alone misses slow-drain overload
+    # where the queue stays short but every message waits long
+    load_shedding_queue_wait: float = 0.0
+    load_shedding_window: float = 5.0
+    # batched ingress (the batched-ingress pipeline, wire.decode_frames →
+    # MessageCenter.deliver_batch → grouped vector enqueue): off = the
+    # per-frame decode + per-message hand-off (the A/B lever; bytes on
+    # the wire are identical either way)
+    batched_ingress: bool = True
     collection_age: float = 2 * 3600.0
     collection_quantum: float = 60.0
     max_enqueued_requests: int = 5000
@@ -248,11 +260,13 @@ class MessageCenter:
         if not self.running:
             return
         if msg.received_at is None and (self.silo.tracer is not None
-                                        or self.silo.ingest_stats is not None):
+                                        or self.silo.ingest_stats is not None
+                                        or self.silo.shed_trend is not None):
             # arrival stamp: queue-wait attribution measures from HERE
-            # (inbound queue + mailbox) to turn start — tracing and the
-            # ingest stage metrics share the one envelope slot (socket
-            # arrivals were already stamped at decode)
+            # (inbound queue + mailbox) to turn start — tracing, the
+            # ingest stage metrics, and the shed trend share the one
+            # envelope slot (socket arrivals were already stamped at
+            # decode)
             msg.received_at = time.monotonic()
         cfg = self.silo.config
         if (cfg.load_shedding_enabled
@@ -260,12 +274,16 @@ class MessageCenter:
                 and msg.direction == Direction.REQUEST
                 and (msg.target_silo is None
                      or msg.target_silo != self.silo.silo_address)
-                and self.inbound[Category.APPLICATION].qsize()
-                >= cfg.load_shedding_limit):
+                and (self.inbound[Category.APPLICATION].qsize()
+                     >= cfg.load_shedding_limit
+                     or self._queue_wait_trending_high())):
             # gateway ingress under overload: shed before queueing
             # (Gateway load shedding, LoadSheddingOptions; rejection type
             # Message.cs:87-93 GatewayTooBusy). Silo-to-silo traffic is
-            # never shed — only client ingress.
+            # never shed — only client ingress. The shed signal is queue
+            # depth OR the windowed ingest queue-wait trend (when
+            # configured): depth misses slow-drain overload where the
+            # queue stays short but every message waits long.
             self.silo.stats.increment("messaging.gateway.shed")
             if msg.sending_silo is not None:
                 from ..core.message import RejectionType, make_rejection
@@ -292,6 +310,93 @@ class MessageCenter:
                               msg.method_name)
             return
         q.put_nowait(msg)
+
+    def _queue_wait_trending_high(self) -> bool:
+        trend = self.silo.shed_trend
+        return (trend is not None and
+                trend.mean() > self.silo.config.load_shedding_queue_wait)
+
+    def deliver_batch(self, msgs: list) -> None:
+        """Batched fabric arrival: the decoded contents of one socket
+        read in ONE hand-off. Routing the batch as a unit is the
+        queue-wait killer — vector-tier requests coalesce into grouped
+        engine enqueues (dispatcher.receive_vector_batch → one
+        ``call_group`` per method) instead of N per-message hops, and
+        host-tier messages keep their inline-route fast path. Falls back
+        to per-message :meth:`deliver` when shedding is enabled (queue
+        depth is the shed signal, so ingress must accumulate) or a
+        category is backlogged (queue semantics carry fairness then)."""
+        if not self.running:
+            return
+        if (self.silo.tracer is not None or self._istats is not None
+                or self.silo.shed_trend is not None):
+            now = time.monotonic()
+            for m in msgs:
+                if m.received_at is None:  # socket arrivals pre-stamped
+                    m.received_at = now
+        if not self.silo.config.batched_ingress or \
+                self.silo.config.load_shedding_enabled or \
+                any(q.qsize() for q in self.inbound.values()):
+            # per-message fall-back: the RECEIVING silo's A/B lever is
+            # honored even when a co-hosted batched-mode silo's fabric
+            # pump accepted the connection and grouped the read
+            for m in msgs:
+                self.deliver(m)
+            return
+        self._route_batch(msgs)
+
+    def _route_batch(self, msgs: list) -> None:
+        """Route one ingress batch inline (FIFO-preserving: nothing is
+        queued ahead — deliver_batch checked). Vector-tier requests are
+        peeled into per-class groups and handed to the dispatcher as
+        units; everything else takes the ordinary per-message route."""
+        ist = self._istats
+        silo = self.silo
+        vgroups: dict[type, list] = {}
+        now = time.monotonic() if ist is not None else 0.0
+        my_addr = silo.silo_address
+        vifaces = silo.vector_interfaces
+        cat_counts: dict = {}
+        for m in msgs:
+            if ist is not None and m.received_at is not None:
+                # ingest enqueue stage (~0 inline) — one clock read for
+                # the whole batch; re-stamped BEFORE routing, the last
+                # safe touch (routing may consume the envelope)
+                ist.observe(_INGEST_ENQUEUE, now - m.received_at)
+                m.received_at = now
+            cat_counts[m.category] = cat_counts.get(m.category, 0) + 1
+            if m.direction != Direction.RESPONSE and vifaces:
+                vcls = vifaces.get(m.interface_name)
+                if vcls is not None:
+                    # device-tier call: group — ownership/recovery checks
+                    # run in receive_vector_batch (the ring-owner check
+                    # there IS the addressing authority for vector keys,
+                    # so skipping send_message addressing changes nothing)
+                    g = vgroups.get(vcls)
+                    if g is None:
+                        g = vgroups[vcls] = []
+                    g.append(m)
+                    continue
+            try:
+                if m.direction != Direction.RESPONSE and (
+                        m.target_silo is None or m.target_silo != my_addr):
+                    m.target_silo = None
+                    silo.dispatcher.send_message(m)
+                else:
+                    silo.dispatcher.receive_message(m)
+            except Exception:  # noqa: BLE001 — same contract as the pump
+                log.exception("inbound routing failed for %s",
+                              m.method_name)
+        stats = silo.stats
+        for cat, c in cat_counts.items():
+            # one counter add per category per batch, not per message
+            stats.increment(self._RECEIVED_STAT[cat], c)
+        for vcls, group in vgroups.items():
+            try:
+                silo.dispatcher.receive_vector_batch(vcls, group)
+            except Exception:  # noqa: BLE001
+                log.exception("vector batch routing failed for %s",
+                              vcls.__name__)
 
     async def _pump(self, cat: Category) -> None:
         q = self.inbound[cat]
@@ -506,6 +611,14 @@ class Silo:
         # queue-wait, engine staging/transfer/tick) guards on that None,
         # so the disabled hot path pays one attribute check
         self.ingest_stats = self.stats if config.metrics_enabled else None
+        # queue-wait-trend shedding (observability.stats.QueueWaitTrend):
+        # installed only when the knob is armed — fed by the dispatcher's
+        # turn-start (and the engine's batch-start) queue-wait sites,
+        # read by MessageCenter's shed decision
+        self.shed_trend = None
+        if config.load_shedding_enabled and config.load_shedding_queue_wait > 0:
+            from ..observability.stats import QueueWaitTrend
+            self.shed_trend = QueueWaitTrend(config.load_shedding_window)
         # metrics pipeline handles (installed at start when configured)
         self.metrics = None          # observability.metrics.MetricsSampler
         self.metrics_server = None   # observability.metrics.MetricsHttpServer

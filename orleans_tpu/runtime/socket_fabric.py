@@ -49,11 +49,14 @@ from .wire import (
     FrameError,
     WireDecodeError,
     _BodyDecodeError,
+    decode_frames,
     decode_handshake,
     decode_message,
     encode_handshake,
     encode_message,
+    encode_message_batch,
     frame_stream,
+    leads_hostile_frame,
     read_frame,
 )
 
@@ -84,17 +87,35 @@ def _drain_batch(queue: "asyncio.Queue[Message]", first: Message) -> list:
     return batch
 
 
-def _encode_batch(batch: list, bounce, native: bool = True) -> list:
-    """Encode each message, routing per-message failures to ``bounce``
-    (encode errors are scoped to one message, never the connection).
-    ``native`` is the negotiated per-connection codec level."""
-    chunks = []
-    for m in batch:
-        try:
-            chunks.append(encode_message(m, native=native))
-        except Exception as e:  # noqa: BLE001 — per-message, not the link
-            bounce(m, e)
-    return chunks
+async def _read_frame_batches(reader: asyncio.StreamReader, ist=None, *,
+                              strict_tail: bool, chunk_size: int = 1 << 16):
+    """Shared chunked-receive state machine for the batched pumps (silo
+    and gateway sides): one ``decode_frames`` pass per socket read,
+    yielding ``(msgs, bounces)``; the partial tail of a frame stays
+    buffered for the next read. Raises :class:`FrameError` when a hostile
+    (oversized) announcement leads the remaining buffer — frames decoded
+    ahead of it were already yielded, matching the per-frame path's
+    deliver-then-drop behavior, and the link drops without waiting for
+    bytes the peer may never send. EOF mid-frame raises
+    ``IncompleteReadError`` under ``strict_tail`` (silo links surface the
+    torn tail) or just ends the pump (gateway: a torn tail is a clean
+    close)."""
+    buf = bytearray()
+    while True:
+        chunk = await reader.read(chunk_size)
+        if not chunk:
+            if buf and strict_tail:
+                raise asyncio.IncompleteReadError(bytes(buf), None)
+            return
+        buf += chunk
+        consumed, msgs, bounces = decode_frames(buf, ist)
+        if consumed:
+            del buf[:consumed]
+        if msgs or bounces:
+            yield msgs, bounces
+        if leads_hostile_frame(buf):
+            raise FrameError("oversized frame announced")
+
 
 
 # a peer that accepts TCP but never sends its handshake reply is wedged:
@@ -182,8 +203,9 @@ class _Sender:
                 if self.writer is None or self.writer.is_closing():
                     self.writer = await self._connect()
                 # encode AFTER the (re)connect: peer_native is per-link
-                chunks = _encode_batch(batch, self.fabric.bounce_unencodable,
-                                       native=self.peer_native)
+                chunks = encode_message_batch(
+                    batch, self.fabric.bounce_unencodable,
+                    native=self.peer_native)
                 if not chunks:
                     continue
                 self.writer.write(b"".join(chunks))
@@ -411,33 +433,39 @@ class SocketFabric:
                 self._client_native[peer_addr] = bool(
                     hs.get("hotwire", False))
             # ingest stage metrics (observability.stats.INGEST_STATS):
-            # decode is timed inside decode_message (which also stamps the
-            # envelope's received_at) and frames-per-read lands in the
-            # batch histogram. The later stages (enqueue/queue_wait) are
-            # observed downstream where the envelope is provably still
-            # live — routing can consume a message synchronously (inline
-            # turns, response correlation + recycle), so NOTHING here may
-            # touch msg after _route_inbound returns.
+            # decode is timed inside decode_frames/decode_message (which
+            # also stamp the envelope's received_at) and frames-per-read
+            # lands in the batch histogram. The later stages (enqueue/
+            # queue_wait) are observed downstream where the envelope is
+            # provably still live — routing can consume a message
+            # synchronously (inline turns, response correlation +
+            # recycle), so NOTHING here may touch msg after routing.
             ist = silo.ingest_stats
-            on_batch = None
-            if ist is not None:
-                from ..observability.stats import COUNT_BOUNDS, INGEST_STATS
-                on_batch = ist.histogram_with(
-                    INGEST_STATS["frame_batch"], COUNT_BOUNDS).observe
-            async for headers, body in frame_stream(reader,
-                                                    on_batch=on_batch):
-                try:
-                    msg = decode_message(headers, body, ist)
-                except _BodyDecodeError as e:
-                    self._bounce_undecodable(e.message, str(e))
-                    continue
-                except WireDecodeError as e:
-                    # headers undecodable: scoped to this message — the
-                    # frame was fully consumed, the connection is fine
-                    log.warning("dropping message with undecodable "
-                                "headers: %s", e)
-                    continue
-                self._route_inbound(silo, msg)
+            if silo.config.batched_ingress:
+                await self._pump_batched(silo, reader, ist)
+            else:
+                # per-frame hand-off (the batched-ingress A/B lever):
+                # decode + route one message per frame
+                on_batch = None
+                if ist is not None:
+                    from ..observability.stats import (COUNT_BOUNDS,
+                                                       INGEST_STATS)
+                    on_batch = ist.histogram_with(
+                        INGEST_STATS["frame_batch"], COUNT_BOUNDS).observe
+                async for headers, body in frame_stream(reader,
+                                                        on_batch=on_batch):
+                    try:
+                        msg = decode_message(headers, body, ist)
+                    except _BodyDecodeError as e:
+                        self._bounce_undecodable(e.message, str(e))
+                        continue
+                    except WireDecodeError as e:
+                        # headers undecodable: scoped to this message —
+                        # the frame was fully consumed, the link is fine
+                        log.warning("dropping message with undecodable "
+                                    "headers: %s", e)
+                        continue
+                    self._route_inbound(silo, msg)
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass  # clean EOF / peer died
         except FrameError as e:
@@ -454,6 +482,45 @@ class SocketFabric:
                 self._route_owner.pop(peer_addr, None)
                 self._client_native.pop(peer_addr, None)
             writer.close()
+
+    async def _pump_batched(self, silo: "Silo",
+                            reader: asyncio.StreamReader, ist) -> None:
+        """Batched receive pump: every complete frame buffered after one
+        socket read decodes in ONE ``decode_frames`` pass (a single
+        ``unpack_batch`` C call on the native build) and the decoded list
+        rides one batched hand-off into the message center — the
+        receive-side symmetric of the sender's greedy ``_drain_batch``."""
+        async for msgs, bounces in _read_frame_batches(reader, ist,
+                                                       strict_tail=True):
+            for e in bounces:
+                self._bounce_undecodable(e.message, str(e))
+            if msgs:
+                self._route_inbound_batch(silo, msgs)
+
+    def _route_inbound_batch(self, silo: "Silo", msgs: list) -> None:
+        """Batched ``_route_inbound``: messages for a local silo ride ONE
+        ``MessageCenter.deliver_batch`` hand-off per destination (the
+        queue-wait killer); gateway-forwarded client deliveries and
+        relays peel off to the per-message path. Grouping preserves
+        arrival order per destination, which is all the wire ever
+        guaranteed (per-sender FIFO per target)."""
+        groups: dict[Any, list] = {}
+        for msg in msgs:
+            target = msg.target_silo
+            if target is None:
+                local = silo
+            else:
+                local = self.silos.get(target)
+            if local is not None:
+                g = groups.get(local.message_center)
+                if g is None:
+                    g = groups[local.message_center] = []
+                g.append(msg)
+            else:
+                # client route / stale target / relay: per-message path
+                self._route_inbound(silo, msg)
+        for center, batch in groups.items():
+            center.deliver_batch(batch)
 
     def _route_inbound(self, silo: "Silo", msg: Message) -> None:
         target = msg.target_silo
@@ -551,12 +618,13 @@ class _GatewayConnection:
         self.sender_task = loop.create_task(self._send_loop())
 
     async def _pump(self, reader: asyncio.StreamReader) -> None:
-        """Client message pump (OutsideRuntimeClient.RunClientMessagePump:235)."""
+        """Client message pump (OutsideRuntimeClient.RunClientMessagePump:235).
+        Batched like the silo side: one ``decode_frames`` pass per socket
+        read (header-undecodable frames are dropped with a log inside)."""
         try:
-            async for headers, body in frame_stream(reader):
-                try:
-                    msg = decode_message(headers, body)
-                except _BodyDecodeError as e:
+            async for msgs, bounces in _read_frame_batches(
+                    reader, strict_tail=False):
+                for e in bounces:
                     # a response we cannot decode still completes the call
                     msg = e.message
                     from ..core.message import ResponseKind
@@ -564,15 +632,13 @@ class _GatewayConnection:
                         msg.response_kind = ResponseKind.ERROR
                         msg.body = SiloUnavailableError(
                             f"undecodable response: {e}")
-                    else:
-                        continue
-                except WireDecodeError as e:
-                    log.warning("dropping message with undecodable "
-                                "headers: %s", e)
-                    continue
-                self.client.deliver(msg)
-        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
+                        self.client.deliver(msg)
+                for msg in msgs:
+                    self.client.deliver(msg)
+        except (ConnectionResetError, OSError):
             pass
+        except FrameError as e:
+            log.warning("gateway %s stream misaligned: %s", self.endpoint, e)
         finally:
             self.live = False
             if self.writer is not None:
@@ -590,8 +656,8 @@ class _GatewayConnection:
         while True:
             msg = await self.queue.get()
             batch = _drain_batch(self.queue, msg)
-            chunks = _encode_batch(batch, self._bounce_unencodable,
-                                   native=self.peer_native)
+            chunks = encode_message_batch(batch, self._bounce_unencodable,
+                                          native=self.peer_native)
             if not chunks:
                 continue
             try:

@@ -24,25 +24,31 @@ Departures from the reference:
 from __future__ import annotations
 
 import asyncio
+import logging
 import struct
 import time
 from typing import Any
+
+log = logging.getLogger("orleans.wire")
 
 from ..core import message as _msg_mod
 from ..core.ids import SiloAddress
 from ..core.message import Message
 from ..core.serialization import deserialize, serialize, serialize_portable
+from ..observability.stats import COUNT_BOUNDS as _COUNT_BOUNDS
 from ..observability.stats import INGEST_STATS as _INGEST
 from ..observability.stats import SIZE_BOUNDS as _SIZE_BOUNDS
 
 _DECODE_SECONDS = _INGEST["decode"]
 _DECODE_BYTES = _INGEST["decode_bytes"]
 _FRAMES = _INGEST["frames"]
+_FRAME_BATCH = _INGEST["frame_batch"]
 
 __all__ = [
     "MAX_FRAME_SEGMENT", "FrameError", "WireDecodeError",
     "encode_frame", "read_frame", "frame_stream",
     "encode_message", "decode_message",
+    "encode_message_batch", "decode_frames",
     "encode_handshake", "decode_handshake",
 ]
 
@@ -169,6 +175,12 @@ _HW_FRAMES = _ser._hotwire is not None and \
     hasattr(_ser._hotwire, "pack_frame")
 if _HW_FRAMES:
     _ser._hotwire.configure_headers(_HEADER_SLOTS, _ENUM_SPEC)
+# Vectorized frame-batch entry points (hotwire.c pack_batch/unpack_batch):
+# one C call per send batch / per socket read instead of one per frame.
+# Batch BYTES are identical to the per-frame form (pack_batch output ==
+# concatenated pack_frame frames; unpack_batch parses either), so every
+# mix of batched/per-frame/pickle peers interoperates.
+_HW_BATCH = _HW_FRAMES and hasattr(_ser._hotwire, "pack_batch")
 
 
 def encode_message(msg: Message, native: bool = True) -> bytes:
@@ -278,8 +290,163 @@ class _BodyDecodeError(WireDecodeError):
 
 
 # ---------------------------------------------------------------------------
+# Frame batches (the batched-ingress wire unit)
+# ---------------------------------------------------------------------------
+
+def encode_message_batch(msgs: list, bounce, native: bool = True) -> list:
+    """Encode a send batch into wire chunks: one contiguous frame-batch
+    buffer (a single ``pack_batch`` C call) on the native path, else one
+    chunk per message. Per-message encode failures route to ``bounce``
+    (scoped to the message, never the connection), matching
+    :func:`encode_message`; a batch-level native failure falls back to the
+    per-message path so the failing message is identified and bounced
+    alone. Output bytes are identical either way."""
+    hw = _ser._hotwire if native else None
+    if hw is not None and _HW_BATCH:
+        now = time.monotonic()
+        items = []
+        live = []
+        for m in msgs:
+            try:
+                if _msg_mod._DEBUG_POOL:
+                    # inside the try: a poisoned envelope bounces like any
+                    # other per-message failure (the per-frame path's
+                    # behavior) instead of killing the sender task
+                    _msg_mod.assert_live(m, "wire.encode_message_batch")
+                ttl = None
+                if m.expires_at is not None:
+                    ttl = max(0.0, m.expires_at - now)
+                items.append((m, ttl, serialize(m.body)))
+                live.append(m)
+            except Exception as e:  # noqa: BLE001 — per-message body failure
+                bounce(m, e)
+        if not items:
+            return []
+        try:
+            return [hw.pack_batch(items)]
+        except Exception:  # noqa: BLE001 — a header refused batch encode:
+            # retry per-message below so the failure scopes to one frame
+            msgs = live
+    chunks = []
+    for m in msgs:
+        try:
+            chunks.append(encode_message(m, native=native))
+        except Exception as e:  # noqa: BLE001 — per-message, not the link
+            bounce(m, e)
+    return chunks
+
+
+def decode_frames(buf, stats=None) -> tuple[int, list, list]:
+    """Parse every COMPLETE frame out of one receive buffer in a single
+    pass: returns ``(consumed, msgs, bounces)``. ``consumed`` is how many
+    bytes were fully parsed (the caller keeps the partial tail for the
+    next socket read); ``bounces`` are :class:`_BodyDecodeError`\\ s whose
+    headers survived (route an error back); header-undecodable frames are
+    dropped with a log, exactly like the per-frame path.
+
+    Native path: ONE ``unpack_batch`` C call decodes every hotwire frame
+    straight into blank Message shells; pickle-peer frames in the same
+    buffer fall through to :func:`decode_message`. Fallback path
+    (``ORLEANS_TPU_NATIVE=0`` or no toolchain): Python length-prefix walk
+    + per-frame :func:`decode_message` — the wire bytes are identical, so
+    mixed-build peers interoperate frame for frame.
+
+    ``stats`` (metrics-enabled receive paths): the whole batch decode is
+    timed as one ``decode`` observation (stage *sums* stay truthful — the
+    share math divides summed seconds), ``decode_bytes`` observes the
+    consumed byte count, ``frames`` counts messages, and the per-read
+    batching degree lands in ``frame_batch``. Every decoded envelope is
+    stamped with the same post-decode ``received_at``."""
+    t0 = time.monotonic() if stats is not None else 0.0
+    msgs: list[Message] = []
+    bounces: list[_BodyDecodeError] = []
+    consumed = 0
+    if _HW_BATCH and _ser._hotwire is not None:
+        try:
+            consumed, entries = _ser._hotwire.unpack_batch(buf, Message)
+        except ValueError as e:
+            # oversized/hostile frame announcement: connection must drop
+            raise FrameError(str(e)) from e
+        for msg, ttl, body in entries:
+            if msg is None:
+                # pickle-peer (or corrupt-native) frame: ttl/body carry the
+                # raw header/body segments — ordinary per-frame decode
+                try:
+                    msgs.append(decode_message(ttl, body))
+                except _BodyDecodeError as e:
+                    bounces.append(e)
+                except WireDecodeError as e:
+                    log.warning("dropping message with undecodable "
+                                "headers: %s", e)
+                continue
+            msg.expires_at = None if ttl is None else time.monotonic() + ttl
+            msg.received_at = None  # stamped once for the whole batch below
+            msg._pool_free = False  # full slot set (see decode_message)
+            msg._pool_gen = 0
+            try:
+                msg.body = deserialize(body)
+            except Exception as e:  # noqa: BLE001 — body failure per-message
+                msg.body = None
+                bounces.append(_BodyDecodeError(msg, e))
+                continue
+            msgs.append(msg)
+    else:
+        end = len(buf)
+        pos = 0
+        while end - pos >= 8:
+            hlen, blen = _LEN.unpack_from(buf, pos)
+            if hlen > MAX_FRAME_SEGMENT or blen > MAX_FRAME_SEGMENT:
+                if pos > 0:
+                    # deliver the frames parsed ahead of the hostile
+                    # announcement (per-frame parity); the next call sees
+                    # it at position 0 and raises then
+                    break
+                raise FrameError(f"oversized frame announced: {hlen}+{blen}")
+            total = 8 + hlen + blen
+            if end - pos < total:
+                break
+            h0 = pos + 8
+            headers = bytes(buf[h0:h0 + hlen])
+            body = bytes(buf[h0 + hlen:pos + total])
+            pos += total
+            try:
+                msgs.append(decode_message(headers, body))
+            except _BodyDecodeError as e:
+                bounces.append(e)
+            except WireDecodeError as e:
+                log.warning("dropping message with undecodable headers: %s",
+                            e)
+        consumed = pos
+    if stats is not None and (msgs or bounces):
+        now = time.monotonic()
+        n = len(msgs) + len(bounces)
+        stats.observe(_DECODE_SECONDS, now - t0)
+        stats.histogram_with(_DECODE_BYTES, _SIZE_BOUNDS).observe(consumed)
+        stats.increment(_FRAMES, n)
+        stats.histogram_with(_FRAME_BATCH, _COUNT_BOUNDS).observe(n)
+        for m in msgs:
+            m.received_at = now
+        for e in bounces:
+            e.message.received_at = now
+    return consumed, msgs, bounces
+
+
+# ---------------------------------------------------------------------------
 # Handshake
 # ---------------------------------------------------------------------------
+
+def leads_hostile_frame(buf) -> bool:
+    """True when the buffer's leading length prefix announces an
+    oversized frame. :func:`decode_frames` stops BEFORE such a prefix
+    when valid frames precede it (so they are still delivered) — the
+    receive pump calls this afterwards to drop the link immediately
+    instead of waiting for the hostile peer's next (never-coming)
+    bytes."""
+    if len(buf) < 8:
+        return False
+    hlen, blen = _LEN.unpack_from(buf, 0)
+    return hlen > MAX_FRAME_SEGMENT or blen > MAX_FRAME_SEGMENT
+
 
 def encode_handshake(kind: str, address: SiloAddress,
                      extra: dict[str, Any] | None = None) -> bytes:

@@ -231,35 +231,65 @@ class LatencyStorage(GrainStorage):
 
 class StateStorageBridge:
     """Per-activation storage facade holding the current etag
-    (StateStorageBridge.cs:11,49,80,107)."""
+    (StateStorageBridge.cs:11,49,80,107). ``manager`` (when attached)
+    counts in-flight operations — the storage queue-depth signal the
+    metrics sampler reads."""
 
     def __init__(self, provider: GrainStorage, grain_type: str,
-                 grain_id: GrainId):
+                 grain_id: GrainId, manager: "StorageManager | None" = None):
         self.provider = provider
         self.grain_type = grain_type
         self.grain_id = grain_id
         self.etag: str | None = None
+        self.manager = manager
 
     async def read(self):
-        state, self.etag = await self.provider.read(self.grain_type, self.grain_id)
+        mgr = self.manager
+        if mgr is not None:
+            mgr.inflight += 1
+        try:
+            state, self.etag = await self.provider.read(
+                self.grain_type, self.grain_id)
+        finally:
+            if mgr is not None:
+                mgr.inflight -= 1
         return state
 
     async def write(self, state) -> None:
-        self.etag = await self.provider.write(
-            self.grain_type, self.grain_id, state, self.etag)
+        mgr = self.manager
+        if mgr is not None:
+            mgr.inflight += 1
+        try:
+            self.etag = await self.provider.write(
+                self.grain_type, self.grain_id, state, self.etag)
+        finally:
+            if mgr is not None:
+                mgr.inflight -= 1
 
     async def clear(self) -> None:
-        await self.provider.clear(self.grain_type, self.grain_id, self.etag)
+        mgr = self.manager
+        if mgr is not None:
+            mgr.inflight += 1
+        try:
+            await self.provider.clear(self.grain_type, self.grain_id,
+                                      self.etag)
+        finally:
+            if mgr is not None:
+                mgr.inflight -= 1
         self.etag = None
 
 
 class StorageManager:
-    """Named-provider registry (the DI provider registration analog)."""
+    """Named-provider registry (the DI provider registration analog).
+    ``inflight`` is the number of storage operations currently awaiting
+    their provider (reads + writes + clears across every bridge minted by
+    this manager) — sampled as ``storage.inflight_ops``."""
 
     DEFAULT = "Default"
 
     def __init__(self) -> None:
         self.providers: dict[str, GrainStorage] = {}
+        self.inflight = 0
 
     def add(self, name: str, provider: GrainStorage) -> None:
         self.providers[name] = provider
@@ -278,4 +308,5 @@ class StorageManager:
         provider = self.get(
             getattr(activation.grain_class, "STORAGE_PROVIDER", None))
         return StateStorageBridge(
-            provider, activation.grain_class.__name__, activation.grain_id)
+            provider, activation.grain_class.__name__, activation.grain_id,
+            manager=self)

@@ -19,11 +19,12 @@ async def test_ping_harness():
 
 
 async def test_ingest_attribution_harness():
-    """ISSUE 6 acceptance: the ingest-attribution point reports a
-    per-stage breakdown whose shares sum to ≈1.0 of the measured ingest
-    wall time, covering both the host stages (decode/enqueue/queue_wait,
-    counted per socket frame) and the device stages
-    (staging/transfer/tick, counted per vector batch)."""
+    """ISSUE 6 acceptance (updated for the ISSUE 7 batched pipeline):
+    the ingest-attribution point reports a per-stage breakdown whose
+    shares sum to ≈1.0 of the measured ingest wall time, covering both
+    the host stages (decode — one timed observation per decode_frames
+    pass on the batched path — enqueue/queue_wait per message) and the
+    device stages (staging/transfer/tick, counted per vector batch)."""
     from benchmarks import ingest_attribution
 
     r = await ingest_attribution.run(seconds=0.5, concurrency=8,
@@ -34,13 +35,28 @@ async def test_ingest_attribution_harness():
                            "transfer", "tick"}
     assert abs(sum(shares.values()) - 1.0) < 0.01
     counts = r["extra"]["stage_counts"]
-    # every socket frame is decoded once and passes the inbound-queue
-    # boundary once; every call (host turn or vector item) records one
-    # queue_wait sample on the owning silo
-    assert counts["decode"] == counts["enqueue"] >= r["extra"]["calls"]
+    # batched ingress: decode is timed once per decode_frames pass (the
+    # whole socket read is one C call — stage SUMS stay truthful, which
+    # is what the share math divides), while every message still records
+    # one enqueue sample at routing and one queue_wait sample (host turn
+    # or vector item) on the owning silo
+    assert 1 <= counts["decode"] <= counts["enqueue"]
+    assert counts["enqueue"] >= r["extra"]["calls"]
     assert counts["queue_wait"] >= r["extra"]["calls"]
     assert counts["tick"] >= 1 and counts["staging"] == counts["tick"]
     assert r["extra"]["frames_decoded"] >= r["extra"]["calls"]
+
+
+async def test_ingest_ab_harness():
+    """ISSUE 7: the batched-vs-per-frame hand-off A/B runs end to end and
+    reports both sides' throughput (the ratio floor lives in
+    test_perf_floors — this only proves the harness)."""
+    from benchmarks import ingest_attribution
+
+    r = await ingest_attribution.run_ab(n_msgs=64, seconds=0.3)
+    _check(r)
+    assert r["extra"]["per_frame_msgs_per_sec"] > 0
+    assert r["extra"]["batched_msgs_per_sec"] > 0
 
 
 async def test_metrics_overhead_harness():

@@ -102,3 +102,38 @@ def test_truncations_never_crash(value, cut):
         ser.deserialize(blob[:max(2, len(blob) - cut)])
     except Exception:  # noqa: BLE001
         pass
+
+
+@settings(max_examples=300, deadline=None)
+@given(st.binary(min_size=0, max_size=300))
+def test_unpack_batch_random_bytes_never_crash(data):
+    """Hostile receive buffers through the batched frame parser (PR 7):
+    any input must either parse (consumed <= len, entries well-formed
+    triples) or raise a clean Python exception — never crash or over-read
+    (the wire.decode_frames contract for untrusted peers)."""
+    from orleans_tpu.core.message import Message
+    try:
+        consumed, entries = ser._hotwire.unpack_batch(data, Message)
+    except Exception:  # noqa: BLE001 — oversized/hostile announcement
+        return
+    assert 0 <= consumed <= len(data)
+    for e in entries:
+        assert isinstance(e, tuple) and len(e) == 3
+
+
+@settings(max_examples=150, deadline=None)
+@given(st.integers(min_value=1, max_value=120))
+def test_unpack_batch_truncated_real_frames_never_crash(cut):
+    """A real frame batch cut mid-stream: the parser must stop cleanly at
+    the last complete frame and report the partial tail unconsumed."""
+    from orleans_tpu.core.ids import GrainId
+    from orleans_tpu.core.message import Message, make_request
+    from orleans_tpu.runtime.wire import encode_message
+    msgs = [make_request(target_grain=GrainId.for_grain(_GT, i),
+                         interface_name="fuzz.I", method_name="m",
+                         body=(i, "x" * i)) for i in range(4)]
+    whole = b"".join(encode_message(m) for m in msgs)
+    data = whole[:max(0, len(whole) - cut)]
+    consumed, entries = ser._hotwire.unpack_batch(data, Message)
+    assert 0 <= consumed <= len(data)
+    assert len(entries) <= len(msgs)

@@ -176,3 +176,27 @@ async def test_floor_hotlane_beats_messaging_path():
     assert speedup >= HOTLANE_MARGIN, \
         f"hot lane only {speedup:.2f}x over the messaging path " \
         f"(floor {HOTLANE_MARGIN}x) — the lane is not engaging"
+
+
+# Batched ingest hand-off over the per-frame path: half-band margin (the
+# PR-7 A/B measures 3-5x on the 3.10 container — one decode_frames pass +
+# one deliver_batch vs N decode_message + deliver for identical bytes —
+# so 1.5x trips only when the batched pipeline stops engaging, e.g. the
+# receive pump silently falling back to per-frame). A same-process ratio:
+# interpreter speed cancels out, like the hot-lane margin above.
+BATCHED_INGEST_MARGIN = 1.5
+
+
+async def test_floor_batched_ingest():
+    from benchmarks import ingest_attribution
+
+    async def once():
+        r = await ingest_attribution.run_ab(n_msgs=512, seconds=1.0)
+        return r["value"]
+    ratio = await once()
+    if ratio < BATCHED_INGEST_MARGIN * 1.25:
+        ratio = max(ratio, await once())
+    assert ratio >= BATCHED_INGEST_MARGIN, \
+        f"batched ingest hand-off only {ratio:.2f}x over per-frame " \
+        f"(floor {BATCHED_INGEST_MARGIN}x) — the batched pipeline is " \
+        f"not engaging"
