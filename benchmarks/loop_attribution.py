@@ -1,0 +1,155 @@
+"""Loop-attribution benchmark — what actually occupies the silo's event
+loop at closed-loop saturation.
+
+PR 7 left the residual: at c=32 the queue-wait share stays ~0.95, and the
+ROADMAP attributes it to "event-loop contention between host turns and
+the ~1.8 ms device tick" — an inference, not a measurement. This harness
+turns it into a measured split: the same saturated mixed host+vector
+harness as ``ingest_attribution`` (GatewayClient over real TCP, c=32),
+with the host-loop occupancy profiler on (``profiling_enabled``), then
+reads the per-category loop shares back out:
+
+    turns                    host grain turns
+    tick_schedule/staging/
+    tick_transfer/tick_sync  the device tick, segmented — tick_sync is
+                             the host materialize where async device
+                             dispatch is actually PAID on the loop (the
+                             off-loop-tick-sync lever's reclaimable slice)
+    pump                     socket reads + wire decode + batched routing
+    storage/observability    provider IO / our own telemetry machinery
+    other / idle             unattributed callbacks / select() wait
+
+Shares are contiguous per-callback wall-time segments plus inter-callback
+idle, so they sum to ~1.0 of measured loop wall time by construction —
+``shares_sum`` is emitted as the self-check. ``--profiling-off`` runs the
+same harness bare (the overhead A/B the CI floor reads via
+``ping.bench_profiling_overhead``)."""
+
+import argparse
+import asyncio
+import json
+import time
+
+if __package__ in (None, ""):
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from orleans_tpu.runtime import SiloBuilder
+from orleans_tpu.runtime.socket_fabric import GatewayClient, SocketFabric
+
+# same saturated mixed workload as the ingest harness this is modeled on
+# (one definition: the two benches must measure identical traffic, or
+# cross-bench share comparisons in the ROADMAP stop meaning anything)
+from benchmarks.ingest_attribution import EchoGrain, _make_vector_grain
+
+
+async def run(seconds: float = 2.0, concurrency: int = 32,
+              n_grains: int = 64, n_keys: int = 64) -> dict:
+    """One silo over real TCP, profiling on, mixed host + device traffic
+    at closed-loop saturation; returns the loop-occupancy breakdown."""
+    import numpy as np
+
+    from orleans_tpu.dispatch import add_vector_grains
+    from orleans_tpu.parallel import make_mesh
+
+    EchoVec = _make_vector_grain()
+    fabric = SocketFabric()
+    b = (SiloBuilder().with_name("loop-silo").with_fabric(fabric)
+         .add_grains(EchoGrain)
+         .with_config(profiling_enabled=True, profiling_window=0.25))
+    add_vector_grains(b, EchoVec, mesh=make_mesh(1),
+                      dense={EchoVec: n_keys})
+    silo = b.build()
+    await silo.start()
+    client = await GatewayClient([silo.silo_address.endpoint]).connect()
+    try:
+        host_refs = [client.get_grain(EchoGrain, k) for k in range(n_grains)]
+        vec_refs = [client.get_grain(EchoVec, k) for k in range(n_keys)]
+        # warmup: activate host grains, compile the vector kernels
+        await asyncio.gather(*(g.ping(0) for g in host_refs))
+        await asyncio.gather(*(v.ping(x=np.int32(0)) for v in vec_refs[:8]))
+
+        # profiler totals are cumulative since install: snapshot them
+        # AFTER warmup so the reported shares cover only the measured
+        # saturation interval — warmup activation + one-time JIT kernel
+        # compilation are loop-blocking tick work that would otherwise
+        # skew the very split this harness exists to measure
+        lp = silo.loop_prof
+        base_sec = dict(lp.profile(windows=0, snapshots=False)["seconds"])
+
+        stop_at = time.perf_counter() + seconds
+        calls = 0
+
+        async def host_worker(wid: int) -> None:
+            nonlocal calls
+            i = wid
+            while time.perf_counter() < stop_at:
+                await host_refs[i % n_grains].ping(i)
+                i += 1
+                calls += 1
+
+        async def vec_worker(wid: int) -> None:
+            nonlocal calls
+            i = wid
+            while time.perf_counter() < stop_at:
+                await vec_refs[i % n_keys].ping(x=np.int32(i & 0x7FFF))
+                i += 1
+                calls += 1
+
+        t0 = time.perf_counter()
+        half = max(1, concurrency // 2)
+        await asyncio.gather(
+            *(host_worker(w) for w in range(half)),
+            *(vec_worker(w) for w in range(half)))
+        elapsed = time.perf_counter() - t0
+
+        # read the profile BEFORE stop (stop uninstalls the profiler)
+        # and diff against the post-warmup snapshot: interval-only split
+        prof = silo.loop_prof.profile(windows=4)
+        sec = {k: round(v - base_sec.get(k, 0.0), 6)
+               for k, v in prof["seconds"].items()
+               if v - base_sec.get(k, 0.0) > 1e-9}
+        wall = sum(sec.values())
+        shares = {k: round(v / wall, 4) for k, v in sec.items()} \
+            if wall else {}
+        top = (prof["windows"][-1]["top"][:4]
+               if prof["windows"] else [])
+    finally:
+        await client.close_async()
+        await silo.stop()
+    busy = round(1.0 - shares.get("idle", 0.0), 4)
+    tick_total = round(sum(v for k, v in shares.items()
+                           if k.startswith("tick_")), 4)
+    return {
+        "metric": "loop_occupancy_busy_share",
+        "value": busy,
+        "unit": "share of loop wall time",
+        "vs_baseline": None,
+        "extra": {
+            "seconds": seconds, "concurrency": concurrency,
+            "calls": calls,
+            "calls_per_sec": round(calls / elapsed, 1),
+            "shares": shares,
+            "shares_sum": round(sum(shares.values()), 4),
+            "seconds_by_category": sec,
+            "device_tick_share": tick_total,
+            "device_sync_share": shares.get("tick_sync", 0.0),
+            "turns_share": shares.get("turns", 0.0),
+            "pump_share": shares.get("pump", 0.0),
+            "observability_share": shares.get("observability", 0.0),
+            "top_callbacks_last_window": top,
+        },
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=3.0)
+    ap.add_argument("--concurrency", type=int, default=32)
+    a = ap.parse_args()
+    print(json.dumps(asyncio.run(run(a.seconds, a.concurrency))))
+
+
+if __name__ == "__main__":
+    main()

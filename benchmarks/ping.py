@@ -40,7 +40,8 @@ async def bench_host_tier(n_grains: int, concurrency: int,
                           trace_sample: float | None = None,
                           hot_lane: bool = True,
                           tail: bool = False,
-                          metrics: bool = False) -> dict:
+                          metrics: bool = False,
+                          profiling: bool = False) -> dict:
     """``trace_sample``: None runs untraced (no collector installed);
     a float enables distributed tracing at that head-sampling rate — the
     overhead-tracking variant wired into run_all and the perf floor.
@@ -60,6 +61,8 @@ async def bench_host_tier(n_grains: int, concurrency: int,
                           trace_tail_enabled=tail)
     if metrics:
         b = b.with_config(metrics_enabled=True, metrics_sample_period=0.2)
+    if profiling:
+        b = b.with_config(profiling_enabled=True, profiling_window=0.25)
     silo = b.build()
     await silo.start()
     client = await ClusterClient(silo.fabric).connect()
@@ -97,7 +100,8 @@ async def bench_host_tier(n_grains: int, concurrency: int,
     await client.close_async()
     await silo.stop()
     return {
-        "metric": ("ping_host_metered_calls_per_sec" if metrics
+        "metric": ("ping_host_profiled_calls_per_sec" if profiling
+                   else "ping_host_metered_calls_per_sec" if metrics
                    else "ping_host_calls_per_sec" if trace_sample is None
                    else "ping_host_tail_traced_calls_per_sec" if tail
                    else "ping_host_traced_calls_per_sec"),
@@ -224,6 +228,44 @@ async def bench_metrics_overhead(n_grains: int = 128, concurrency: int = 50,
         "extra": {
             "bare_calls_per_sec": base["value"],
             "metered_calls_per_sec": metered["value"],
+            "n_grains": n_grains, "concurrency": concurrency,
+        },
+    }
+
+
+async def bench_profiling_overhead(n_grains: int = 128,
+                                   concurrency: int = 50,
+                                   seconds: float = 1.5) -> dict:
+    """profiling_overhead: the host-loop occupancy profiler (per-callback
+    interposition + category accounting + the flight-recorder ring) vs a
+    bare silo, as a ratio — interpreter-independent like the tail/metrics
+    ratios. The floor companion
+    (tests/test_perf_floors.py::test_floor_profiling_overhead) keeps this
+    >= 0.85; the profiling-OFF path installs nothing at all (asserted in
+    tests/test_loop_profiler.py), so the off side of this A/B IS the
+    unprofiled baseline.
+
+    Both sides run with the hot lane off: hot-lane calls collapse the
+    messaging frame and skip most loop callbacks, so a hot-lane baseline
+    would measure the lane's margin instead of the per-callback
+    interposition tax this ratio exists to guard. A gc.collect before
+    each side keeps gen2 pauses from prior silo builds in one process
+    from landing asymmetrically on one side."""
+    import gc
+    gc.collect()
+    base = await bench_host_tier(n_grains, concurrency, seconds,
+                                 hot_lane=False)
+    gc.collect()
+    profiled = await bench_host_tier(n_grains, concurrency, seconds,
+                                     hot_lane=False, profiling=True)
+    return {
+        "metric": "profiling_overhead",
+        "value": round(profiled["value"] / base["value"], 3),
+        "unit": "ratio (profiled / bare)",
+        "vs_baseline": None,
+        "extra": {
+            "bare_calls_per_sec": base["value"],
+            "profiled_calls_per_sec": profiled["value"],
             "n_grains": n_grains, "concurrency": concurrency,
         },
     }

@@ -17,6 +17,7 @@ from benchmarks import (
     chirper_fanout,
     gpstracker_stream,
     ingest_attribution,
+    loop_attribution,
     mxu_handler,
     mapreduce,
     ping,
@@ -67,6 +68,17 @@ def main() -> None:
     # CI floor 1.5x in test_floor_batched_ingest, measured 3-5x)
     print(json.dumps(asyncio.run(ingest_attribution.run_ab(
         n_msgs=512, seconds=1.5))))
+    # loop attribution: per-category occupancy of the silo's event loop
+    # at closed-loop saturation (c=32 mixed host+vector over TCP) — the
+    # measured split behind "residual queue-wait is loop contention":
+    # turns vs device tick (schedule/staging/transfer/SYNC) vs pump vs
+    # observability vs idle, shares summing to ~1.0 of loop wall time
+    print(json.dumps(asyncio.run(loop_attribution.run(
+        seconds=2.0, concurrency=32))))
+    # profiler overhead as a ratio vs a bare silo (per-callback
+    # interposition + category accounting; CI floor 0.85)
+    print(json.dumps(asyncio.run(ping.bench_profiling_overhead(
+        n_grains=128, concurrency=50, seconds=1.5))))
     print(json.dumps(asyncio.run(mapreduce.run())))
     for r in serialization.run():
         print(json.dumps(r))

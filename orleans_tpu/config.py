@@ -25,7 +25,7 @@ __all__ = [
     "ClusterOptions", "MessagingOptions", "SchedulingOptions",
     "GrainCollectionOptions", "MembershipOptions", "DirectoryOptions",
     "LoadSheddingOptions", "DispatchOptions", "RebalanceOptions",
-    "TracingOptions", "MetricsOptions",
+    "TracingOptions", "MetricsOptions", "ProfilingOptions",
     "flatten", "apply_options", "validate_options", "log_options",
 ]
 
@@ -290,6 +290,34 @@ class MetricsOptions:
 
 
 @dataclass
+class ProfilingOptions:
+    """Host-loop occupancy profiler + flight recorder
+    (observability.profiling.LoopProfiler — the Watchdog/per-component
+    cycle-stats analog of the reference, grown into continuous loop
+    attribution): when ``enabled`` the silo interposes on its event
+    loop's scheduling entry points and buckets every callback's wall
+    time into named categories (turns / device tick schedule-staging-
+    transfer-SYNC / pump / storage / observability / idle) in
+    ``window``-second slices, keeping a ``ring``-deep flight ring with
+    the ``top_k`` slowest callbacks per window. Anomalies (load shed,
+    watchdog/sampler lag over ``lag_threshold``, queue-wait-trend
+    breach, tail-retained traces) snapshot the ring, rate-limited to one
+    per ``trigger_interval`` seconds per reason. Disabled: nothing is
+    installed — the loop keeps its class methods."""
+
+    enabled: bool = False
+    window: float = 1.0
+    ring: int = 120
+    top_k: int = 8
+    trigger_interval: float = 1.0
+    lag_threshold: float = 0.25
+
+    def validate(self) -> None:
+        _positive(self, "window", "ring", "top_k", "trigger_interval",
+                  "lag_threshold")
+
+
+@dataclass
 class DispatchOptions:
     """TPU vector-dispatch tier (no reference analog — the batched engine's
     knobs): per-shard slot-pool capacity and exchange lane capacity."""
@@ -355,6 +383,12 @@ _FLAT_MAP = {
     "metrics_port": (MetricsOptions, "port"),
     "metrics_otlp_endpoint": (MetricsOptions, "otlp_endpoint"),
     "metrics_otlp_period": (MetricsOptions, "otlp_period"),
+    "profiling_enabled": (ProfilingOptions, "enabled"),
+    "profiling_window": (ProfilingOptions, "window"),
+    "profiling_ring": (ProfilingOptions, "ring"),
+    "profiling_top_k": (ProfilingOptions, "top_k"),
+    "profiling_trigger_interval": (ProfilingOptions, "trigger_interval"),
+    "profiling_lag_threshold": (ProfilingOptions, "lag_threshold"),
 }
 
 

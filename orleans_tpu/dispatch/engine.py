@@ -274,6 +274,13 @@ class VectorRuntime:
         # device execution + host materialize) histograms — the device
         # half of the socket->tick ingest attribution
         self.stats = None
+        # host-loop occupancy profiler (observability.profiling), set by
+        # the owning silo when profiling_enabled: each tick callback is
+        # segmented into tick_schedule / tick_staging / tick_transfer /
+        # tick_sync occupancy slices — tick_sync (host materialize, where
+        # async device dispatch is actually paid) is the loop time the
+        # off-loop-sync lever would reclaim
+        self.loop_prof = None
         # stateless-worker (mesh-replicated) hosts per class — see
         # dispatch.replicated (StatelessWorkerPlacement.cs:6 on device)
         self._replicated_hosts: dict[type, Any] = {}
@@ -549,6 +556,12 @@ class VectorRuntime:
         self._tick_scheduled = False
         if not self.pending:
             return
+        lp = self.loop_prof
+        if lp is not None:
+            # this call_soon callback IS the device tick: everything not
+            # re-segmented below (claiming, conflict defer, rescheduling)
+            # is tick scheduling work on the loop
+            lp.set_category("tick_schedule")
         work, self.pending = self.pending, {}
         for (cls, method), items in work.items():
             try:
@@ -565,6 +578,13 @@ class VectorRuntime:
 
     def _run_batch(self, cls: type, method: str, items: list[_Pending]) -> None:
         st = self.stats
+        lp = self.loop_prof
+        if lp is not None:
+            # loop occupancy: claim/defer + staging-fill from here; the
+            # label tuple names this batch in the flight recorder's
+            # top-K and is only string-joined on admission — every tick
+            # pays no format on this path
+            lp.set_category("tick_staging", ("tick", cls.__name__, method))
         t_stage = now_mono = 0.0
         if st is not None:
             t_stage = time.perf_counter()
@@ -618,6 +638,9 @@ class VectorRuntime:
                 for fname in schema:
                     args_stacked[fname][s, i] = p.args[fname]
         self.staging_fill = len(ready)
+        if lp is not None:
+            # staging done: operand upload + kernel dispatch next
+            lp.set_category("tick_transfer")
         if inferred:
             m.args_schema = schema  # needed by the kernel builder
         t_xfer = t_tick = 0.0
@@ -681,6 +704,12 @@ class VectorRuntime:
         if self.track_load:
             tbl.record_hits(slots, valid)
         # resolve futures from the result batch
+        if lp is not None:
+            # THE distinct device-sync occupancy: jax dispatch is async,
+            # so the host materialize below is where device execution is
+            # actually paid on the loop — the slice the off-loop-tick-sync
+            # ROADMAP lever would reclaim
+            lp.set_category("tick_sync")
         host = jax.tree_util.tree_map(np.asarray, results)
         if not jax.tree_util.tree_leaves(host):
             # result-less method: no np.asarray above synced anything, so
@@ -702,6 +731,9 @@ class VectorRuntime:
             # actually paid — closing at kernel return would record ~0
             # for exactly the hot ticks tracing exists to attribute
             tracer.close(tick_span, batch=len(ready))
+        if lp is not None:
+            # sync paid: future resolution is scheduling work again
+            lp.set_category("tick_schedule")
         for s, ps in enumerate(per_shard):
             for i, p in enumerate(ps):
                 if p.future is not None and not p.future.done():

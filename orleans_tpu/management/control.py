@@ -155,6 +155,24 @@ class SiloControl:
             snap["windows"] = sampler.window_snapshot()
         return snap
 
+    async def ctl_loop_profile(self, windows: int = 20,
+                               snapshots: bool = True) -> dict:
+        """Host-loop occupancy profile + flight recorder
+        (observability.profiling.LoopProfiler): cumulative per-category
+        seconds/shares of loop wall time (summing to ~1.0 incl. idle),
+        the last ``windows`` per-window slices with their top-K slowest
+        callbacks, and — when ``snapshots`` — the anomaly-triggered
+        flight-recorder snapshots. {} when profiling is disabled. NOTE:
+        co-hosted silos on one event loop share one profiler (occupancy
+        is a loop property), so their payloads are views of the same
+        loop."""
+        lp = self.silo.loop_prof
+        if lp is None:
+            return {}
+        out = lp.profile(windows, snapshots=snapshots)
+        out["silo"] = self.silo.config.name
+        return out
+
     async def ctl_histogram(self, name: str) -> dict | None:
         """One named histogram's summary (with per-bucket counts so the
         ManagementGrain can merge silos losslessly); None if unknown."""

@@ -141,6 +141,38 @@ class ManagementGrain(Grain):
             "per_silo": per_silo,
         }
 
+    async def get_cluster_loop_profile(self, windows: int = 20) -> dict:
+        """Cluster-wide host-loop occupancy merge over every silo's
+        ``ctl_loop_profile``: per-category loop seconds sum across
+        silos (shares recomputed over the summed wall), flight-recorder
+        trigger counts sum, and the per-silo payloads — windows, top-K
+        slow callbacks, and anomaly snapshots — ride along for
+        drill-down. One call answers "what occupies the cluster's loops"
+        and "which silo's loop is the outlier". Caveat: silos co-hosted
+        on ONE event loop share one profiler, so the merged totals count
+        that loop once per resident silo — read per_silo for the truth
+        on shared-loop test clusters."""
+        per_silo = await self._fan_out("ctl_loop_profile", windows)
+        seconds: dict[str, float] = {}
+        triggers: dict[str, int] = {}
+        snapshots = 0
+        for snap in per_silo.values():
+            for k, v in (snap.get("seconds") or {}).items():
+                seconds[k] = seconds.get(k, 0.0) + float(v)
+            for k, v in (snap.get("triggers") or {}).items():
+                triggers[k] = triggers.get(k, 0) + int(v)
+            snapshots += len(snap.get("snapshots") or ())
+        wall = sum(seconds.values())
+        return {
+            "wall_s": round(wall, 6),
+            "seconds": {k: round(v, 6) for k, v in seconds.items()},
+            "shares": {k: round(v / wall, 4)
+                       for k, v in seconds.items()} if wall else {},
+            "triggers": triggers,
+            "snapshot_count": snapshots,
+            "per_silo": per_silo,
+        }
+
     async def get_cluster_histogram(self, name: str) -> dict | None:
         """One named latency histogram aggregated across every silo
         (Histogram.merge over the per-bucket counts each SiloControl

@@ -5,6 +5,8 @@ The reference runs its serializer/transport hot path in compiled code
 holds the TPU build's native equivalents.  Components:
 
 * ``_hotwire`` — wire-tier value codec (see ``hotwire.c``).
+* ``_hotloop`` — per-callback runner for the host-loop occupancy
+  profiler (see ``hotloop.c``).
 
 Build strategy: compile-on-first-import into this directory with the
 system toolchain (gcc/cc), guarded by a marker of the source hash so edits

@@ -15,7 +15,18 @@ from .metrics import (  # noqa: F401
     WindowedGauge,
     prometheus_exposition,
 )
-from .profiling import Profiler, StepTimer, annotate, traced  # noqa: F401
+from .profiling import (  # noqa: F401
+    LOOP_CATEGORIES,
+    LoopProfiler,
+    Profiler,
+    StepTimer,
+    annotate,
+    install_loop_profiler,
+    loop_profiler,
+    mark_loop_category,
+    traced,
+    uninstall_loop_profiler,
+)
 from .stats import (  # noqa: F401
     INGEST_STAGES,
     INGEST_STATS,

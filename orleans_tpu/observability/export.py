@@ -40,15 +40,29 @@ __all__ = ["chrome_trace_events", "write_chrome_trace",
 log = logging.getLogger("orleans.export")
 
 
-def chrome_trace_events(spans) -> list[dict]:
+def chrome_trace_events(spans, loop_profiles: dict | None = None
+                        ) -> list[dict]:
     """Convert span dicts (``Span.to_dict`` form) into Chrome trace
     events: one complete ("ph": "X") event per span plus process/thread
     naming metadata. Timestamps are microseconds relative to the earliest
-    span so the timeline starts at zero."""
+    span so the timeline starts at zero.
+
+    ``loop_profiles``: optional ``{silo_name: [occupancy slices]}`` (the
+    :meth:`LoopProfiler.profile` ``windows`` lists) rendered as Perfetto
+    COUNTER tracks ("ph": "C") beside the span rows — per-category loop
+    occupancy shares sampled once per window, on the same zeroed
+    timeline, so a span's latency lines up with what occupied the loop
+    around it. Span links ride into ``args`` (``links``) for the
+    selection panel."""
     dicts = [s if isinstance(s, dict) else s.to_dict() for s in spans]
-    if not dicts:
+    starts = [s["start"] for s in dicts]
+    for slices in (loop_profiles or {}).values():
+        starts.extend(sl["ts"] - sl.get("wall_s", 0.0) for sl in slices)
+    if not starts:
+        # no spans and no finalized occupancy slices (e.g. a silo too
+        # young for its first profiling window) — nothing to render
         return []
-    t0 = min(s["start"] for s in dicts)
+    t0 = min(starts)
     pids: dict[str, int] = {}
     tids: dict[tuple[int, int], int] = {}
     events: list[dict] = []
@@ -71,6 +85,9 @@ def chrome_trace_events(spans) -> list[dict]:
         args["span_id"] = f"{s['span_id']:016x}"
         if s.get("parent_id"):
             args["parent_id"] = f"{s['parent_id']:016x}"
+        if s.get("links"):
+            args["links"] = [f"{int(lt):016x}/{int(ls):016x}"
+                             for lt, ls in s["links"]]
         events.append({
             "name": s["name"], "cat": s["kind"], "ph": "X",
             "ts": (s["start"] - t0) * 1e6,
@@ -79,17 +96,37 @@ def chrome_trace_events(spans) -> list[dict]:
             "dur": max(s["duration"], 1e-9) * 1e6,
             "pid": pid, "tid": tid, "args": args,
         })
+    for silo, slices in (loop_profiles or {}).items():
+        pid = pids.get(silo)
+        if pid is None:
+            pid = pids[silo] = len(pids) + 1
+            events.append({"ph": "M", "name": "process_name", "pid": pid,
+                           "tid": 0, "args": {"name": silo}})
+        for sl in slices:
+            shares = sl.get("shares") or {}
+            if not shares:
+                continue
+            # one counter sample per occupancy window, at the window END
+            # (when the slice was cut); Perfetto stacks the args series
+            events.append({
+                "ph": "C", "name": "loop occupancy", "pid": pid, "tid": 0,
+                "ts": (sl["ts"] - t0) * 1e6,
+                "args": {k: v for k, v in sorted(shares.items())},
+            })
     return events
 
 
-def write_chrome_trace(path: str, spans) -> str:
+def write_chrome_trace(path: str, spans,
+                       loop_profiles: dict | None = None) -> str:
     """Write spans as a Chrome-trace JSON file; returns ``path``.
+    ``loop_profiles`` adds per-silo loop-occupancy counter tracks
+    (``{silo: profile["windows"]}``) beside the span rows.
 
     One-liner for a test cluster::
 
         cluster.export_trace("/tmp/trace.json")   # → ui.perfetto.dev
     """
-    payload = {"traceEvents": chrome_trace_events(spans),
+    payload = {"traceEvents": chrome_trace_events(spans, loop_profiles),
                "displayTimeUnit": "ms"}
     with open(path, "w") as f:
         json.dump(payload, f)
@@ -151,6 +188,13 @@ def spans_to_otlp(span_dicts, service_name: str = "orleans_tpu") -> dict:
         }
         if s.get("parent_id"):
             span["parentSpanId"] = f"{s['parent_id']:016x}"
+        links = s.get("links")
+        if links:
+            # span links (timer/reminder/stream arming context): OTLP
+            # carries causality to the arming trace without merging them
+            span["links"] = [{"traceId": f"{int(lt):032x}",
+                              "spanId": f"{int(ls):016x}"}
+                             for lt, ls in links]
         events = s.get("events")
         if events:
             span["events"] = [
@@ -227,6 +271,8 @@ class _OtlpHttpSink:
 
     # -- flusher -----------------------------------------------------------
     async def _run(self) -> None:
+        from .profiling import mark_loop_category
+        mark_loop_category("observability")  # flusher steps are our tax
         self._wake = wake = asyncio.Event()
         try:
             while self._q:

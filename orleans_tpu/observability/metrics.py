@@ -212,14 +212,25 @@ class MetricsSampler:
             self._task = None
 
     async def _loop(self) -> None:
+        from .profiling import mark_loop_category
+        mark_loop_category("observability")  # this task's steps are ours
         loop_lag = WindowedGauge(self.window)
         self.windows["sampler.loop_lag"] = loop_lag
         self.silo.stats.register_gauge("sampler.loop_lag", loop_lag.last)
+        lag_threshold = getattr(self.silo.config,
+                                "profiling_lag_threshold", 0.25)
         while True:
             t0 = time.monotonic()
             await asyncio.sleep(self.period)
             now = time.monotonic()
-            loop_lag.add(max(0.0, (now - t0) - self.period), now)
+            lag = max(0.0, (now - t0) - self.period)
+            loop_lag.add(lag, now)
+            lp = self.silo.loop_prof
+            if lp is not None and lag > lag_threshold:
+                # the loop is visibly stalling: snapshot the flight
+                # recorder (covers silos that run no Watchdog; the
+                # watchdog has its own trigger at its lag_warning)
+                lp.trigger("sampler_lag", lag=round(lag, 4))
             self.sample_once(now)
             if self.otlp_sink is not None and now >= self._next_push:
                 self._next_push = now + self.otlp_period
@@ -266,14 +277,20 @@ def _fmt(v: float) -> str:
 
 def prometheus_exposition(snapshot: dict, windows: dict | None = None,
                           prefix: str = "orleans",
-                          labels: dict | None = None) -> str:
+                          labels: dict | None = None,
+                          openmetrics: bool = False) -> str:
     """Render a ``StatsRegistry.snapshot()`` (plus optional sampler
-    window summaries) as Prometheus text exposition format 0.0.4.
+    window summaries) as Prometheus text exposition format 0.0.4, or —
+    with ``openmetrics`` — as OpenMetrics 1.0 text (``_total`` counter
+    samples, ``# EOF`` terminator, and histogram-bucket exemplars).
 
     Histograms serve their native fixed buckets — cumulative counts with
     ``le`` labels from :meth:`Histogram.bucket_labels` — plus ``_sum``
     and ``_count``; window summaries become ``_min``/``_max``/``_avg``
-    gauge triples beside the live gauge."""
+    gauge triples beside the live gauge.  Exemplars (the sampled trace
+    id riding a slow bucket) are only legal in the OpenMetrics format —
+    the classic 0.0.4 rendering omits them so strict parsers never see
+    tokens after the sample value."""
     lbl = ""
     if labels:
         def esc(v) -> str:
@@ -284,7 +301,8 @@ def prometheus_exposition(snapshot: dict, windows: dict | None = None,
     for name, v in sorted(snapshot.get("counters", {}).items()):
         n = _prom_name(name, prefix)
         lines.append(f"# TYPE {n} counter")
-        lines.append(f"{n}{lbl} {_fmt(v)}")
+        # OpenMetrics requires counter samples to carry the _total suffix
+        lines.append(f"{n}{'_total' if openmetrics else ''}{lbl} {_fmt(v)}")
     for name, v in sorted(snapshot.get("gauges", {}).items()):
         n = _prom_name(name, prefix)
         lines.append(f"# TYPE {n} gauge")
@@ -294,12 +312,25 @@ def prometheus_exposition(snapshot: dict, windows: dict | None = None,
         n = _prom_name(name, prefix)
         hist = Histogram.from_snapshot(h)
         lines.append(f"# TYPE {n} histogram")
-        for le, cum in zip(hist.bucket_labels(), hist.cumulative_counts()):
+        exemplars = hist.exemplars or {}
+        for i, (le, cum) in enumerate(zip(hist.bucket_labels(),
+                                          hist.cumulative_counts())):
             if lbl:
                 blbl = lbl[:-1] + f',le="{le}"}}'
             else:
                 blbl = f'{{le="{le}"}}'
-            lines.append(f"{n}_bucket{blbl} {cum}")
+            line = f"{n}_bucket{blbl} {cum}"
+            ex = exemplars.get(i) if openmetrics else None
+            if ex is not None:
+                # OpenMetrics exemplar syntax: the sampled trace id on the
+                # bucket its observation landed in — a slow bucket links
+                # straight into the tail-retained trace that filled it.
+                # Same 32-hex width as the OTLP span export so backends
+                # joining exemplar -> trace by exact id string match.
+                v, tid, ts = ex
+                line += (f' # {{trace_id="{int(tid):032x}"}} '
+                         f'{float(v):.6g} {float(ts):.3f}')
+            lines.append(line)
         lines.append(f"{n}_sum{lbl} {repr(float(hist.sum))}")
         lines.append(f"{n}_count{lbl} {hist.total}")
     for name, w in sorted((windows or {}).items()):
@@ -308,6 +339,8 @@ def prometheus_exposition(snapshot: dict, windows: dict | None = None,
                             ("_window_avg", "mean")):
             lines.append(f"# TYPE {n}{suffix} gauge")
             lines.append(f"{n}{suffix}{lbl} {repr(float(w.get(key, 0.0)))}")
+    if openmetrics:
+        lines.append("# EOF")
     return "\n".join(lines) + "\n"
 
 
@@ -331,32 +364,40 @@ class MetricsHttpServer:
                  self.silo.config.name, self.host, self.port)
         return self
 
-    def render(self) -> str:
+    def render(self, openmetrics: bool = False) -> str:
         windows = None
         sampler = self.silo.metrics
         if sampler is not None:
             windows = sampler.window_snapshot()
         return prometheus_exposition(
             self.silo.stats.snapshot(), windows,
-            labels={"silo": self.silo.config.name})
+            labels={"silo": self.silo.config.name},
+            openmetrics=openmetrics)
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
         try:
             request_line = await reader.readline()
-            # drain headers to the blank line (scrapers send a few)
+            # drain headers to the blank line, watching for the scraper
+            # negotiating OpenMetrics (exemplars are only legal there)
+            openmetrics = False
             while True:
                 line = await reader.readline()
                 if line in (b"\r\n", b"\n", b""):
                     break
+                if line[:7].lower() == b"accept:" and \
+                        b"application/openmetrics-text" in line:
+                    openmetrics = True
             parts = request_line.split()
             path = parts[1].decode("latin-1") if len(parts) >= 2 else "/"
             if len(parts) >= 1 and parts[0] == b"GET" and \
                     path.split("?", 1)[0] in ("/metrics", "/"):
-                body = self.render().encode()
+                body = self.render(openmetrics).encode()
+                ctype = (b"application/openmetrics-text; version=1.0.0; "
+                         b"charset=utf-8" if openmetrics else
+                         b"text/plain; version=0.0.4; charset=utf-8")
                 head = (b"HTTP/1.1 200 OK\r\n"
-                        b"Content-Type: text/plain; version=0.0.4; "
-                        b"charset=utf-8\r\n"
+                        b"Content-Type: " + ctype + b"\r\n"
                         b"Content-Length: " + str(len(body)).encode() +
                         b"\r\nConnection: close\r\n\r\n")
                 writer.write(head + body)

@@ -107,11 +107,28 @@ class Histogram:
         self.counts = [0] * len(self.bounds)
         self.total = 0
         self.sum = 0.0
+        # per-bucket OpenMetrics exemplars: bucket index -> (value,
+        # trace_id, wall ts). Lazily allocated — the common untraced
+        # histogram carries None and pays one attr slot
+        self.exemplars: dict[int, tuple[float, int, float]] | None = None
 
     def observe(self, value: float) -> None:
         self.counts[bisect.bisect_left(self.bounds, value)] += 1
         self.total += 1
         self.sum += value
+
+    def exemplar(self, value: float, trace_id: int) -> None:
+        """Attach an OpenMetrics exemplar to the bucket ``value`` lands
+        in (last-writer-wins per bucket, the standard exemplar
+        discipline): the observation was made by a SAMPLED request, so a
+        slow bucket on the exposition endpoint links straight into the
+        tail-retained trace that filled it. Separate from observe() so
+        the unsampled hot path never takes an extra argument."""
+        ex = self.exemplars
+        if ex is None:
+            ex = self.exemplars = {}
+        ex[min(bisect.bisect_left(self.bounds, value),
+               len(self.counts) - 1)] = (value, trace_id, time.time())
 
     def percentile(self, p: float) -> float:
         """Approximate percentile from bucket bounds (upper bound of the
@@ -152,35 +169,76 @@ class Histogram:
         return self.sum / self.total if self.total else 0.0
 
     def merge(self, other: "Histogram") -> "Histogram":
-        """Fold another histogram in (same buckets) — the management
-        grain aggregates per-silo histograms cluster-wide with this."""
-        for i, c in enumerate(other.counts):
-            self.counts[i] += c
+        """Fold another histogram in — the management grain aggregates
+        per-silo histograms cluster-wide with this.
+
+        Mismatched per-instance bucket bounds (one silo created a series
+        with SIZE_BOUNDS, another with the latency defaults — the
+        first-creation-wins ``histogram_with`` race across silos) widen
+        DETERMINISTICALLY instead of silently mis-bucketing positionally:
+        each source bucket folds into the target bucket whose range
+        contains the source bucket's upper bound (counts can only move
+        coarser, never into a lower bucket, so merged quantiles are
+        conservative upper bounds). Exemplars re-locate by their exact
+        observed value either way."""
+        if other.bounds == self.bounds:
+            for i, c in enumerate(other.counts):
+                self.counts[i] += c
+        else:
+            last = len(self.counts) - 1
+            for b, c in zip(other.bounds, other.counts):
+                if c:
+                    self.counts[min(bisect.bisect_left(self.bounds, b),
+                                    last)] += c
         self.total += other.total
         self.sum += other.sum
+        if other.exemplars:
+            for v, tid, ts in other.exemplars.values():
+                mine = self.exemplars or {}
+                idx = min(bisect.bisect_left(self.bounds, v),
+                          len(self.counts) - 1)
+                cur = mine.get(idx)
+                if cur is None or ts >= cur[2]:  # newest exemplar wins
+                    mine[idx] = (v, tid, ts)
+                    self.exemplars = mine
         return self
 
     def summary(self) -> dict:
         """The snapshot form (per-bucket counts — and non-default bounds
-        — ride along so summaries merge losslessly via
+        and exemplars — ride along so summaries merge losslessly via
         :meth:`from_snapshot`)."""
         out = {"count": self.total, "sum": self.sum, "mean": self.mean,
                "p50": self.percentile(0.5), "p95": self.percentile(0.95),
                "p99": self.percentile(0.99), "buckets": list(self.counts)}
         if self.bounds is not self.BOUNDS:
             out["bounds"] = list(self.bounds)
+        if self.exemplars:
+            # str keys: the snapshot is a wire/JSON form
+            out["exemplars"] = {str(i): list(e)
+                                for i, e in self.exemplars.items()}
         return out
 
     @classmethod
     def from_snapshot(cls, d: dict) -> "Histogram":
         """Rebuild from a :meth:`summary` dict (cross-silo aggregation:
-        snapshots travel the wire, histogram objects do not)."""
+        snapshots travel the wire, histogram objects do not). A bucket
+        list that disagrees with its own bounds is corrupt — raise
+        rather than mis-state counts against the wrong buckets."""
         h = cls(d.get("bounds"))
         counts = d.get("buckets")
-        if counts and len(counts) == len(h.counts):
+        if counts:
+            if len(counts) != len(h.counts):
+                raise ValueError(
+                    f"histogram snapshot carries {len(counts)} buckets "
+                    f"for {len(h.counts)} bounds — refusing to "
+                    "mis-bucket a corrupt snapshot")
             h.counts = [int(c) for c in counts]
         h.total = int(d.get("count", sum(h.counts)))
         h.sum = float(d.get("sum", 0.0))
+        ex = d.get("exemplars")
+        if ex:
+            h.exemplars = {int(i): (float(v), int(t), float(ts))
+                           for i, (v, t, ts) in ex.items()}
         return h
 
 

@@ -97,6 +97,8 @@ class TelemetryManager:
             c.close()
 
     async def _loop(self) -> None:
+        from .profiling import mark_loop_category
+        mark_loop_category("observability")
         while True:
             await asyncio.sleep(self.period)
             self.flush()
@@ -148,6 +150,8 @@ class Watchdog:
             self._task = None
 
     async def _loop(self) -> None:
+        from .profiling import mark_loop_category
+        mark_loop_category("observability")
         while True:
             t0 = time.monotonic()
             await asyncio.sleep(self.period)
@@ -157,6 +161,12 @@ class Watchdog:
             self.silo.stats.observe("watchdog.loop_lag", max(lag, 0.0))
             if lag > self.lag_warning:
                 self.silo.stats.increment("watchdog.lag_warnings")
+                lp = getattr(self.silo, "loop_prof", None)
+                if lp is not None:
+                    # flight recorder: the occupancy ring at the moment
+                    # of the stall IS the diagnosis the watchdog can't
+                    # make alone (which category ate the loop)
+                    lp.trigger("watchdog_lag", lag=round(lag, 4))
                 log.warning(
                     "%s: event loop lagged %.3fs (long turn or blocked "
                     "call starving the cooperative scheduler)",

@@ -65,7 +65,7 @@ __all__ = [
     "TRACE_KEY", "Span", "SpanCollector", "current_trace",
     "new_trace_id", "new_span_id", "critical_path_breakdown",
     "RetentionPolicy", "LatencyErrorPolicy", "span_from_dict",
-    "mark_remote_if_traced",
+    "mark_remote_if_traced", "arm_root_link", "pending_root_link",
 ]
 
 log = logging.getLogger("orleans.tracing")
@@ -82,6 +82,24 @@ TRACE_KEY = "orleans.trace"
 current_trace: contextvars.ContextVar[tuple[int, int] | None] = (
     contextvars.ContextVar("orleans_current_trace", default=None)
 )
+
+# The arming context for deferred work (span links): a timer/reminder/
+# stream registration that happens inside a traced turn records
+# (trace_id, span_id) here before the deferred callback runs; when that
+# callback's outgoing calls ROOT a fresh trace, the new root carries the
+# arming context as a span LINK — Perfetto/OTLP show causality without
+# merging the two traces. None (the default) everywhere else: roots of
+# ordinary client calls pay one ContextVar.get.
+pending_root_link: contextvars.ContextVar[tuple[int, int] | None] = (
+    contextvars.ContextVar("orleans_pending_root_link", default=None)
+)
+
+
+def arm_root_link(link: tuple[int, int] | None) -> None:
+    """Declare the arming context for work the CURRENT task triggers:
+    new roots opened downstream link back to ``link``. Pass None to
+    clear (e.g. a stream pump switching to an unlinked subscription)."""
+    pending_root_link.set(link)
 
 # span kinds a collector records; critical_path_breakdown buckets by these
 # ("event" is the zero-duration annotation kind — rejections, forward hops —
@@ -104,12 +122,14 @@ class Span:
     is a monotonic-clock delta (set by :meth:`SpanCollector.close`)."""
 
     __slots__ = ("trace_id", "span_id", "parent_id", "name", "kind",
-                 "silo", "start", "duration", "attrs", "events", "_t0")
+                 "silo", "start", "duration", "attrs", "events", "links",
+                 "_t0")
 
     def __init__(self, trace_id: int, span_id: int, parent_id: int | None,
                  name: str, kind: str, silo: str, start: float,
                  duration: float = 0.0, attrs: dict | None = None,
-                 events: list | None = None):
+                 events: list | None = None,
+                 links: list | None = None):
         self.trace_id = trace_id
         self.span_id = span_id
         self.parent_id = parent_id
@@ -120,6 +140,10 @@ class Span:
         self.duration = duration
         self.attrs = attrs
         self.events = events
+        # span links: [(trace_id, span_id), ...] — causal references to
+        # OTHER traces (the arming context of timer/reminder/stream-
+        # triggered roots). None for the common unlinked span.
+        self.links = links
         self._t0 = 0.0
 
     def add_event(self, name: str, **attrs) -> None:
@@ -142,6 +166,8 @@ class Span:
         }
         if self.events:
             d["events"] = self.events
+        if self.links:
+            d["links"] = [list(lk) for lk in self.links]
         return d
 
     def __repr__(self) -> str:  # pragma: no cover — debugging aid
@@ -156,7 +182,9 @@ def span_from_dict(d: dict) -> Span:
                 d["name"], d["kind"], d.get("silo") or "?",
                 d["start"], d.get("duration", 0.0),
                 dict(d.get("attrs") or {}) or None,
-                list(d["events"]) if d.get("events") else None)
+                list(d["events"]) if d.get("events") else None,
+                [tuple(lk) for lk in d["links"]]
+                if d.get("links") else None)
 
 
 class RetentionPolicy:
@@ -298,6 +326,11 @@ class SpanCollector:
         # async ``fetch(trace_id) -> list[span dict]`` pulling remote legs
         # of a trace this collector retained (silo: ctl_trace_spans fan-out)
         self.remote_fetcher = None
+        # ``fn(root_span | None, reason)`` called once per RETAINED trace
+        # before export — the silo wires the flight recorder here so a
+        # tail-retained slow trace snapshots the loop-occupancy ring it
+        # was slow IN (and may stamp attrs on the root before it ships)
+        self.on_retain = None
         self._ret = {"kept": 0, "dropped": 0, "pulled": 0,
                      "pull_skipped": 0}
         # insertion-ordered so the bound evicts the OLDEST pin, not all
@@ -529,6 +562,13 @@ class SpanCollector:
         if reason is not None and root is not None:
             root.attrs = dict(root.attrs or {})
             root.attrs["retained"] = reason
+        if self.on_retain is not None:
+            # BEFORE the sink batch is built: the hook may stamp attrs on
+            # the root (flight-snapshot marker) that must ride the export
+            try:
+                self.on_retain(root, reason)
+            except Exception:  # noqa: BLE001 — a hook must not break commit
+                log.exception("on_retain hook failed")
         self.spans.extend(spans)
         remote_spans = [span_from_dict(d) for d in remote_dicts]
         self.spans.extend(remote_spans)
@@ -614,6 +654,8 @@ class SpanCollector:
         # inside a traced turn): pulls triggered by this sweeper must not
         # join — and permanently pin — whatever trace was live at spawn.
         current_trace.set(None)
+        from .profiling import mark_loop_category
+        mark_loop_category("observability")  # sweeper steps are our tax
         period = max(0.01, min(self.tail_window, self.leg_ttl) / 2)
         while self.pending:
             await asyncio.sleep(period)

@@ -112,6 +112,10 @@ class LocalReminderService:
         self.ring = VirtualBucketRing(buckets_per_silo)
         self.refresh_period = refresh_period
         self.local: dict[tuple[GrainId, str], _ReminderTimer] = {}
+        # (grain_id, name) -> the registering turn's (trace_id, span_id):
+        # span-link arming context for tick-rooted traces (bounded by the
+        # table rows this silo ever registered; popped on unregister)
+        self._arm_links: dict[tuple[GrainId, str], tuple] = {}
         self.target = ReminderTarget(self)
         silo.register_system_target(self.target, REMINDER_TARGET)
         self._refresh_wanted = asyncio.Event()
@@ -179,12 +183,21 @@ class LocalReminderService:
         entry = ReminderEntry(
             grain_id=grain_id, interface_name=iface, name=name,
             start_at=time.time() + due, period=period)
+        from ..observability.tracing import current_trace
+        link = current_trace.get()
+        if link is not None:
+            # arming context for span links: tick-rooted traces on THIS
+            # silo link back to the registering turn's trace. Best-effort
+            # and silo-local by design — the link does not ride the table
+            # row, so a tick fired by a different owner roots unlinked.
+            self._arm_links[(grain_id, name)] = link
         etag = await self.table.upsert_row(entry)
         await self._notify_owner(grain_id)
         return ReminderHandle(grain_id, name, etag)
 
     async def unregister(self, grain_id: GrainId, name: str) -> None:
         removed = await self.table.remove_row(grain_id, name)
+        self._arm_links.pop((grain_id, name), None)
         if not removed:
             raise ReminderError(f"no reminder {name!r} for {grain_id}")
         await self._notify_owner(grain_id)
@@ -232,6 +245,12 @@ class LocalReminderService:
                         entry.name, entry.interface_name)
             return
         self.silo.stats.increment("reminders.ticks")
+        from ..observability.tracing import arm_root_link
+        # tick turns root fresh traces; carry the registering turn's
+        # context as a span link on the new root (set each tick — the
+        # timer task's context persists, and an unlinked entry must
+        # clear a predecessor's link)
+        arm_root_link(self._arm_links.get((entry.grain_id, entry.name)))
         fut = self.silo.runtime_client.send_request(
             target_grain=entry.grain_id, grain_class=cls,
             interface_name=entry.interface_name,

@@ -46,18 +46,38 @@ DEFAULT_MAX_ENQUEUED = 5000  # LimitManager default analog for overload check
 
 class GrainTimerHandle:
     """Disposable timer registration (GrainTimer.cs:11). Ticks are routed
-    through the activation gate so they respect turn semantics."""
+    through the activation gate so they respect turn semantics.
+
+    ``link`` is the ARMING trace context — the (trace_id, span_id) of the
+    turn that registered the timer, when that turn was sampled. Tick
+    turns root fresh traces (timer messages carry no headers); arming the
+    link in this task's context makes every such root carry a span LINK
+    back to the arming trace (observability.tracing.pending_root_link),
+    so Perfetto/OTLP show causality without merging the traces."""
 
     def __init__(self, activation: "ActivationData", callback, due: float,
-                 period: float | None):
+                 period: float | None, link: tuple | None = None):
         self._activation = activation
         self._callback = callback
         self._period = period
+        self._link = link
         self._cancelled = False
         self._task = asyncio.get_running_loop().create_task(self._run(due))
 
     async def _run(self, due: float) -> None:
         try:
+            if self._link is not None:
+                # The task context COPIED the arming turn's ambient trace
+                # at create_task time; left in place, every tick's calls
+                # would join (and keep re-opening) a trace whose root
+                # closed long ago — exactly the stale-span pollution tail
+                # retention cannot decide. Clear it so tick work roots
+                # FRESH traces, and arm the link so each new root carries
+                # the arming context as a span link instead.
+                from ..observability.tracing import (arm_root_link,
+                                                     current_trace)
+                current_trace.set(None)
+                arm_root_link(self._link)
             await asyncio.sleep(due)
             while not self._cancelled:
                 if self._activation.state not in (
@@ -196,7 +216,9 @@ class ActivationData:
     # -- timers ----------------------------------------------------------
     def register_timer(self, callback, due: float,
                        period: float | None) -> GrainTimerHandle:
-        h = GrainTimerHandle(self, callback, due, period)
+        from ..observability.tracing import current_trace
+        h = GrainTimerHandle(self, callback, due, period,
+                             link=current_trace.get())
         self.timers.append(h)
         return h
 

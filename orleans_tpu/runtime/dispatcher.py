@@ -71,6 +71,10 @@ class Dispatcher:
         # silo's registry when metrics_enabled, else None — cached here so
         # the per-turn guard is one attribute load
         self._istats = silo.ingest_stats
+        # host-loop occupancy profiler (observability.profiling): set by
+        # Silo._install_loop_profiler when profiling_enabled, else None —
+        # the per-turn guard is one attribute load
+        self._loop_prof = None
         # in-flight device-tier state recoveries: (class, key_hash) →
         # future; concurrent calls for one recovering key share the load
         self._vector_recoveries: dict = {}
@@ -530,43 +534,70 @@ class Dispatcher:
         token_a = current_activation.set(activation)
         RequestContext.import_(msg.request_context)
         t0 = time.monotonic()
-        ist = self._istats
-        if msg.received_at is not None:
-            if ist is not None:
-                # ingest queue-wait stage: fabric hand-off (or loopback
-                # arrival) -> this turn actually starting — inbound queue
-                # + mailbox + task scheduling, the backpressure signal
-                ist.observe(_QUEUE_WAIT, t0 - msg.received_at)
-                ist.increment(_TURNS)
-            trend = self.silo.shed_trend
-            if trend is not None:
-                # same signal feeds the load-shed trend (shed on windowed
-                # queue-wait, not instantaneous depth)
-                trend.note(max(0.0, t0 - msg.received_at), t0)
-        # server span: header presence == sampled (head-based sampling at
-        # the root). Covers queue wait (arrival stamp → turn start) plus
-        # execution, recorded separately; the network leg is derived from
-        # the sender's wall-clock stamp. Nested sends from inside the turn
-        # parent under this span via the current_trace contextvar.
+        lp = self._loop_prof
+        ptok = None
+        if lp is not None:
+            # loop-occupancy attribution: this task's steps are a host
+            # grain turn (timer ticks bucket separately — they are loop
+            # load the grain's own traffic didn't cause). The label tuple
+            # feeds the flight recorder's top-K records; it is only
+            # string-joined if this turn actually lands in the top-K, so
+            # the per-turn path pays no format.
+            ptok = lp.enter(
+                "timers" if msg.method_name == "__timer__" else "turns",
+                (msg.interface_name, msg.method_name))
         tracer = self.silo.tracer
         tspan = ttoken = None
         t_queue = 0.0
-        if tracer is not None:
-            hdr = context_from_headers(msg.request_context)
-            if hdr is not None:
-                trace_id, parent_id, sent_at = hdr
-                if msg.received_at is not None:
-                    t_queue = max(0.0, t0 - msg.received_at)
-                recv_wall = time.time() - (time.monotonic() - t0) - t_queue
-                tracer.record(trace_id, parent_id, "network", "network",
-                              sent_at, recv_wall - sent_at)
-                tspan = tracer.open(
-                    f"{msg.interface_name}.{msg.method_name}", "server",
-                    trace_id, parent_id)
-                tspan.start = recv_wall
-                ttoken = current_trace.set((trace_id, tspan.span_id))
         turn_error = None
+        # the observability setup below lives INSIDE the try: its
+        # exceptions must run the same finally that pairs lp.exit with
+        # the enter above (and resets the activation), not leak the
+        # profiler category token for the rest of the task
         try:
+            ist = self._istats
+            if msg.received_at is not None:
+                if ist is not None:
+                    # ingest queue-wait stage: fabric hand-off (or
+                    # loopback arrival) -> this turn actually starting —
+                    # inbound queue + mailbox + task scheduling, the
+                    # backpressure signal
+                    ist.observe(_QUEUE_WAIT, t0 - msg.received_at)
+                    ist.increment(_TURNS)
+                trend = self.silo.shed_trend
+                if trend is not None:
+                    # same signal feeds the load-shed trend (shed on
+                    # windowed queue-wait, not instantaneous depth)
+                    trend.note(max(0.0, t0 - msg.received_at), t0)
+            # server span: header presence == sampled (head-based
+            # sampling at the root). Covers queue wait (arrival stamp →
+            # turn start) plus execution, recorded separately; the
+            # network leg is derived from the sender's wall-clock stamp.
+            # Nested sends from inside the turn parent under this span
+            # via the current_trace contextvar.
+            if tracer is not None:
+                hdr = context_from_headers(msg.request_context)
+                if hdr is not None:
+                    trace_id, parent_id, sent_at = hdr
+                    if msg.received_at is not None:
+                        t_queue = max(0.0, t0 - msg.received_at)
+                        if ist is not None:
+                            # OpenMetrics exemplar: the sampled trace id
+                            # rides the bucket this turn's queue-wait
+                            # landed in, so a slow bucket on the
+                            # Prometheus endpoint links straight into
+                            # the tail-retained trace
+                            ist.histogram(_QUEUE_WAIT).exemplar(
+                                t_queue, trace_id)
+                    recv_wall = (time.time() - (time.monotonic() - t0)
+                                 - t_queue)
+                    tracer.record(trace_id, parent_id, "network",
+                                  "network", sent_at, recv_wall - sent_at)
+                    tspan = tracer.open(
+                        f"{msg.interface_name}.{msg.method_name}",
+                        "server", trace_id, parent_id)
+                    tspan.start = recv_wall
+                    ttoken = current_trace.set((trace_id, tspan.span_id))
             result = await self.invoke(activation, msg)
             if msg.direction == Direction.REQUEST:
                 resp = make_response(msg, copy_result(result))
@@ -621,6 +652,8 @@ class Dispatcher:
             RequestContext.clear()
             current_activation.reset(token_a)
             activation.reset_running(msg)
+            if ptok is not None:
+                lp.exit(ptok)
             self.run_message_pump(activation)
 
     @staticmethod

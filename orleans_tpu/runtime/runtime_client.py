@@ -35,6 +35,7 @@ from ..observability.tracing import (
     context_from_headers,
     current_trace,
     mark_remote_if_traced,
+    pending_root_link,
 )
 from .cancellation import register_outgoing_tokens
 from .context import (
@@ -368,6 +369,14 @@ class RuntimeClient:
                     "directory" if interface_name == "DirectoryTarget"
                     else "client",
                     trace_id, parent_id)
+                if parent_id is None:
+                    # fresh root: timer/reminder/stream-triggered work
+                    # carries its ARMING context as a span link, so the
+                    # new trace shows causality to the trace that armed
+                    # it without the two merging
+                    link = pending_root_link.get()
+                    if link is not None:
+                        span.links = [tuple(link)]
                 req_ctx = dict(req_ctx) if req_ctx else {}
                 req_ctx[TRACE_KEY] = (trace_id, span.span_id, span.start)
         # One clock read serves both the caller-side callback deadline and

@@ -200,6 +200,25 @@ class SiloConfig:
     # periodic OTLP metrics push (export.OtlpMetricsSink); None = no sink
     metrics_otlp_endpoint: str | None = None
     metrics_otlp_period: float = 5.0
+    # host-loop occupancy profiler + flight recorder (observability.
+    # profiling.LoopProfiler / config.ProfilingOptions): when enabled the
+    # silo interposes on its event loop's call_soon/call_at and buckets
+    # every callback's wall time into named categories (turns, device
+    # tick schedule/staging/transfer/SYNC, socket pump, storage IO,
+    # observability internals, idle), keeps a bounded ring of per-window
+    # occupancy slices + top-K slowest callbacks, and snapshots the ring
+    # on anomalies (load shed, watchdog lag, tail-retained traces). Off
+    # (default): NOTHING is installed — the loop keeps its class methods
+    # and hot paths pay one None check per site.
+    profiling_enabled: bool = False
+    profiling_window: float = 1.0          # seconds per occupancy slice
+    profiling_ring: int = 120              # slices retained (flight data)
+    profiling_top_k: int = 8               # slowest callbacks per window
+    profiling_trigger_interval: float = 1.0  # min seconds between
+    # snapshots per trigger reason (a shed storm -> one snapshot/interval)
+    profiling_lag_threshold: float = 0.25  # sampler loop-lag over this
+    # triggers a flight-recorder snapshot (watchdog triggers separately
+    # at its own lag_warning)
 
 
 class GrainRegistry:
@@ -285,6 +304,15 @@ class MessageCenter:
             # configured): depth misses slow-drain overload where the
             # queue stays short but every message waits long.
             self.silo.stats.increment("messaging.gateway.shed")
+            lp = self.silo.loop_prof
+            if lp is not None:
+                # anomaly hook: a shed is exactly the moment the loop's
+                # recent occupancy explains — snapshot the flight ring
+                # (rate-limited per reason inside trigger)
+                depth = self.inbound[Category.APPLICATION].qsize()
+                lp.trigger("queue_wait_trend"
+                           if depth < cfg.load_shedding_limit
+                           else "load_shed", queue_depth=depth)
             if msg.sending_silo is not None:
                 from ..core.message import RejectionType, make_rejection
                 rej = make_rejection(msg, RejectionType.GATEWAY_TOO_BUSY,
@@ -623,6 +651,12 @@ class Silo:
         self.metrics = None          # observability.metrics.MetricsSampler
         self.metrics_server = None   # observability.metrics.MetricsHttpServer
         self.metrics_sink = None     # observability.export.OtlpMetricsSink
+        # host-loop occupancy profiler (observability.profiling.
+        # LoopProfiler): installed at start when profiling_enabled — every
+        # hot-path site guards on this None, so the off path costs one
+        # attribute check
+        self.loop_prof = None
+        self._flight_hook = None     # this silo's telemetry trigger hook
         # distributed tracing (observability.tracing): None unless enabled
         # — every hot-path site guards on that None
         self.tracer = None
@@ -716,6 +750,8 @@ class Silo:
         if self.config.eager_turns:
             _install_eager_factory(asyncio.get_running_loop())
             self._eager_installed = True
+        if self.config.profiling_enabled:
+            self._install_loop_profiler(asyncio.get_running_loop())
         self.message_center.start()          # RuntimeServices
         self.catalog.start()
         if self.config.metrics_enabled:
@@ -826,6 +862,20 @@ class Silo:
         if self.metrics_server is not None:
             await self.metrics_server.aclose()
             self.metrics_server = None
+        if self.loop_prof is not None:
+            from ..observability.profiling import uninstall_loop_profiler
+            if self._flight_hook is not None:
+                try:
+                    self.loop_prof.trigger_hooks.remove(self._flight_hook)
+                except ValueError:
+                    pass
+                self._flight_hook = None
+            uninstall_loop_profiler(asyncio.get_running_loop())
+            self.loop_prof = None
+            self.dispatcher._loop_prof = None
+            self.storage_manager.loop_prof = None
+            if self.vector is not None:
+                self.vector.loop_prof = None
         self.message_center.stop()
         self.runtime_client.close()
         self.fabric.unregister_silo(self, dead=not graceful)
@@ -859,6 +909,54 @@ class Silo:
             if not isinstance(r, BaseException) and r:
                 out.extend(r)
         return out
+
+    def _install_loop_profiler(self, loop) -> None:
+        """Install (or join) the loop's occupancy profiler and wire this
+        silo's consumers: per-category occupancy gauges, the dispatcher/
+        engine/storage category hooks, the tail-retention flight trigger,
+        and the telemetry sink hook. Co-hosted silos on one loop share
+        one profiler (occupancy is a loop property); install is
+        refcounted, so the last silo to stop removes the interposition."""
+        from ..observability.profiling import (LOOP_CATEGORIES,
+                                               install_loop_profiler)
+        cfg = self.config
+        lp = install_loop_profiler(
+            loop, window=cfg.profiling_window, ring=cfg.profiling_ring,
+            top_k=cfg.profiling_top_k,
+            trigger_interval=cfg.profiling_trigger_interval)
+        self.loop_prof = lp
+        # cached refs so the hot paths pay one attribute load
+        self.dispatcher._loop_prof = lp
+        self.storage_manager.loop_prof = lp
+        if self.vector is not None:
+            self.vector.loop_prof = lp
+        for cat in LOOP_CATEGORIES:
+            # live per-category occupancy of the LAST completed window
+            # (the Prometheus gauges; cumulative shares ride ctl_loop_profile)
+            self.stats.register_gauge(
+                f"loop.occupancy.{cat}",
+                lambda c=cat, p=lp: p.last_shares.get(c, 0.0))
+        if self.tracer is not None:
+            # tail-retained traces snapshot the flight recorder and stamp
+            # the root span so the retained trace links to its loop state
+            def _retained(root, reason, _lp=lp):
+                snap = _lp.trigger(
+                    "trace_retained", reason=reason,
+                    trace_id=(f"{root.trace_id:x}"
+                              if root is not None else None))
+                if snap is not None and root is not None:
+                    root.attrs = dict(root.attrs or {})
+                    root.attrs["flight_snapshot"] = True
+            self.tracer.on_retain = _retained
+        tm = getattr(self, "telemetry", None)
+        if tm is not None:
+            # flight snapshots also land as telemetry events (the
+            # "attach it to the telemetry sink" half of the recorder)
+            def _hook(snap, _tm=tm):
+                _tm.track_event("flight_recorder", reason=snap["reason"],
+                                **snap["attrs"])
+            self._flight_hook = _hook
+            lp.trigger_hooks.append(_hook)
 
     def register_system_target(self, instance, name: str) -> GrainId:
         """Register a per-silo pseudo-grain at a well-known id

@@ -441,6 +441,13 @@ class SocketFabric:
             # synchronously (inline turns, response correlation +
             # recycle), so NOTHING here may touch msg after routing.
             ist = silo.ingest_stats
+            if silo.loop_prof is not None:
+                # loop-occupancy attribution: this handler task's steps —
+                # socket reads, wire decode, batched routing (including
+                # inline turns' first synchronous stretch until the turn
+                # re-labels itself) — are pump work on the loop
+                from ..observability.profiling import mark_loop_category
+                mark_loop_category("pump")
             if silo.config.batched_ingress:
                 await self._pump_batched(silo, reader, ist)
             else:

@@ -243,14 +243,26 @@ class StateStorageBridge:
         self.etag: str | None = None
         self.manager = manager
 
+    def _prof(self):
+        """Loop-occupancy hook: provider awaits run in THIS coroutine's
+        context, so an enter("storage") here labels every resumption step
+        during the provider call as storage IO on the loop (exit restores
+        the surrounding turn's category). None when profiling is off."""
+        mgr = self.manager
+        return mgr.loop_prof if mgr is not None else None
+
     async def read(self):
         mgr = self.manager
         if mgr is not None:
             mgr.inflight += 1
+        lp = self._prof()
+        tok = lp.enter("storage") if lp is not None else None
         try:
             state, self.etag = await self.provider.read(
                 self.grain_type, self.grain_id)
         finally:
+            if tok is not None:
+                lp.exit(tok)
             if mgr is not None:
                 mgr.inflight -= 1
         return state
@@ -259,10 +271,14 @@ class StateStorageBridge:
         mgr = self.manager
         if mgr is not None:
             mgr.inflight += 1
+        lp = self._prof()
+        tok = lp.enter("storage") if lp is not None else None
         try:
             self.etag = await self.provider.write(
                 self.grain_type, self.grain_id, state, self.etag)
         finally:
+            if tok is not None:
+                lp.exit(tok)
             if mgr is not None:
                 mgr.inflight -= 1
 
@@ -270,10 +286,14 @@ class StateStorageBridge:
         mgr = self.manager
         if mgr is not None:
             mgr.inflight += 1
+        lp = self._prof()
+        tok = lp.enter("storage") if lp is not None else None
         try:
             await self.provider.clear(self.grain_type, self.grain_id,
                                       self.etag)
         finally:
+            if tok is not None:
+                lp.exit(tok)
             if mgr is not None:
                 mgr.inflight -= 1
         self.etag = None
@@ -290,6 +310,10 @@ class StorageManager:
     def __init__(self) -> None:
         self.providers: dict[str, GrainStorage] = {}
         self.inflight = 0
+        # host-loop occupancy profiler (set by the owning silo when
+        # profiling_enabled): bridges label their provider awaits as
+        # "storage" loop time through this ref
+        self.loop_prof = None
 
     def add(self, name: str, provider: GrainStorage) -> None:
         self.providers[name] = provider

@@ -79,6 +79,13 @@ class SubscriptionHandle:
     # are clamped to the oldest cached — the reference's cache-window
     # replay contract). None = from now/oldest-cached as usual.
     from_token: int | None = None
+    # span-link arming context: the (trace_id, span_id) of the turn that
+    # SUBSCRIBED, when sampled. Stream deliveries from pulling agents
+    # root fresh traces; the new roots carry this as a span link so
+    # Perfetto/OTLP show which subscription armed the work
+    # (observability.tracing.pending_root_link). None for implicit
+    # subscribers and untraced subscribes.
+    link: tuple | None = None
 
 
 def consumer_of(handler: Callable) -> tuple[GrainId, str, str]:
@@ -167,11 +174,13 @@ class StreamRef:
                                   "same grain as the data handler")
         if batch is None:
             batch = bool(getattr(handler, "__orleans_stream_batch__", False))
+        from ..observability.tracing import current_trace
         handle = SubscriptionHandle(
             stream=self.stream_id, handle_id=uuid.uuid4().hex,
             grain_id=grain_id, interface_name=iface, method_name=method,
             batch=batch, from_token=from_token,
-            error_method_name=err_method, completed_method_name=comp_method)
+            error_method_name=err_method, completed_method_name=comp_method,
+            link=current_trace.get())
         await self.provider.register_consumer(handle)
         return handle
 

@@ -125,6 +125,12 @@ async def deliver_to_consumer(silo: "Silo", handle: SubscriptionHandle,
     reference's stream redelivery contract (consumers dedup by token)."""
     if progress is None:
         progress = {}
+    from ..observability.tracing import arm_root_link
+    # stream deliveries root fresh traces (the pump has no ambient trace):
+    # carry the subscribing turn's context as a span link on each new
+    # root. Set unconditionally — an unlinked handle must clear whatever
+    # a previous delivery armed in this pump task's context.
+    arm_root_link(getattr(handle, "link", None))
     ft = getattr(handle, "from_token", None)
     if ft is not None:
         # rewound subscription: trim below the resume token (batches

@@ -139,6 +139,23 @@ class TestClusterBuilder:
         self.config.update(cfg)
         return self
 
+    def with_profiling(self, window: float = 0.1, ring: int = 120,
+                       top_k: int = 8,
+                       trigger_interval: float = 0.2
+                       ) -> "TestClusterBuilder":
+        """Host-loop occupancy profiler + flight recorder on every silo
+        (observability.profiling.LoopProfiler). Test-sized defaults: the
+        window rolls fast enough for short tests to see slices, and the
+        trigger rate-limit is short enough that a forced anomaly
+        snapshots promptly. Note: TestCluster silos share one event loop,
+        so they share ONE profiler (occupancy is a loop property)."""
+        self.config.update(profiling_enabled=True,
+                           profiling_window=window,
+                           profiling_ring=ring,
+                           profiling_top_k=top_k,
+                           profiling_trigger_interval=trigger_interval)
+        return self
+
     def with_tracing(self, sample_rate: float = 1.0,
                      buffer_size: int = 4096, *, tail: bool = False,
                      tail_window: float = 0.25,

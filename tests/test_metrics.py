@@ -103,6 +103,74 @@ def test_registry_histogram_with_bounds_applied_once():
     assert h1 is h2 and h1.bounds == list(SIZE_BOUNDS)
 
 
+def test_histogram_mixed_bounds_merge_widens_deterministically():
+    """Cluster-merge guard: one silo created a series with SIZE_BOUNDS,
+    another with the latency defaults (the first-creation-wins race
+    across silos). Merging must widen deterministically — each source
+    bucket folds into the target bucket containing its upper bound —
+    never mis-bucket positionally or lose counts."""
+    target = Histogram()           # latency defaults
+    other = Histogram(SIZE_BOUNDS)
+    other.observe(100.0)           # -> size bucket le=256
+    other.observe(70_000.0)        # -> size bucket le=262144
+    before = target.total
+    target.merge(other)
+    assert target.total == before + 2
+    assert sum(target.counts) == 2
+    # every count landed in the terminal bucket of the default bounds
+    # (both SIZE upper bounds exceed the 30s latency cap -> +Inf), i.e.
+    # conservative coarsening, not silent positional mis-bucketing
+    assert target.counts[-1] == 2
+    # mixed-bounds merge twice is stable (pure widening, no drift)
+    t2 = Histogram()
+    t2.merge(other).merge(other)
+    assert t2.total == 4 and sum(t2.counts) == 4
+    # a corrupt snapshot (bucket list disagreeing with its bounds) raises
+    # instead of silently mis-stating
+    bad = other.summary()
+    bad["buckets"] = bad["buckets"][:-2]
+    try:
+        Histogram.from_snapshot(bad)
+    except ValueError:
+        pass
+    else:  # pragma: no cover
+        raise AssertionError("corrupt snapshot accepted")
+
+
+def test_histogram_exemplars_ride_snapshot_merge_and_exposition():
+    """Metrics exemplars: a sampled trace id attaches to the bucket its
+    observation landed in, survives snapshot round-trips and cluster
+    merge, and renders in OpenMetrics exemplar syntax on the endpoint."""
+    h = Histogram()
+    h.observe(0.003)
+    h.exemplar(0.003, 0xABC)       # slow-ish bucket, trace attached
+    s = h.summary()
+    assert "exemplars" in s
+    r = Histogram.from_snapshot(s)
+    assert r.exemplars and list(r.exemplars.values())[0][1] == 0xABC
+    # merge keeps the NEWEST exemplar per bucket and re-locates by value
+    other = Histogram(SIZE_BOUNDS)
+    other.observe(100.0)
+    other.exemplar(100.0, 0xDEF)
+    r.merge(other)
+    assert any(t == 0xDEF for _, t, _ in r.exemplars.values())
+    # OpenMetrics rendering carries the exemplar suffix on the bucket
+    snap = {"counters": {"c": 1}, "gauges": {}, "histograms":
+            {"qw": r.summary()}}
+    text = prometheus_exposition(snap, openmetrics=True)
+    # 32-hex trace id, the same width the OTLP span export uses, so
+    # exemplar -> trace joins match on exact id string
+    assert 'trace_id="%032x"' % 0xABC in text
+    line = [ln for ln in text.splitlines() if "0abc" in ln][0]
+    assert " # {" in line and line.startswith("orleans_qw_bucket")
+    assert "orleans_c_total 1" in text and text.rstrip().endswith("# EOF")
+    # the classic 0.0.4 rendering stays exemplar-free (strict parsers
+    # reject tokens after the sample value outside OpenMetrics)
+    plain = prometheus_exposition(snap)
+    assert "trace_id" not in plain and "# EOF" not in plain
+    assert "orleans_c 1" in plain
+
+
 # ----------------------------------------------------------------------
 # Sampler windowing
 # ----------------------------------------------------------------------

@@ -338,3 +338,71 @@ async def test_management_grain_aggregates_cluster_histograms():
         assert agg["count"] == 3
         assert agg["p95"] >= 4.0  # the slow silo's tail survives the merge
         assert await mgmt.get_cluster_histogram("no.such.histogram") is None
+
+
+# ----------------------------------------------------------------------
+# Satellite: span links carry the arming context of timer-triggered work
+# ----------------------------------------------------------------------
+async def test_timer_triggered_root_links_to_arming_trace():
+    """A timer registered inside a traced turn fires later and roots a
+    FRESH trace (timer messages carry no headers); the new root must
+    carry the arming turn's (trace_id, span_id) as a span link so
+    Perfetto/OTLP show causality without merging the traces."""
+
+    class ArmGrain(Grain):
+        async def arm(self) -> int:
+            self.register_timer(self._tick, 0.02, None)
+            return 1
+
+        async def _tick(self):
+            await self.get_grain(EchoGrain, 7).ping(7)
+
+    cluster = (TestClusterBuilder(1).add_grains(EchoGrain, ArmGrain)
+               .with_tracing().build())
+    async with cluster:
+        assert await cluster.grain(ArmGrain, 1).arm() == 1
+        arm_tid = _last_client_trace_id(cluster)
+        await asyncio.sleep(0.2)  # timer fires, tick pings EchoGrain
+        silo = cluster.silos[0]
+        linked = [s for s in silo.tracer.snapshot()
+                  if s.get("links") and s["parent_id"] is None]
+        assert linked, "timer-rooted trace carried no span link"
+        root = linked[-1]
+        assert root["name"] == "EchoGrain.ping"
+        assert root["trace_id"] != arm_tid  # a fresh trace, not a merge
+        link_tids = {lt for lt, _ in root["links"]}
+        assert arm_tid in link_tids
+        # the link's span id resolves to a span of the arming trace
+        arm_spans = {s["span_id"] for s in cluster.trace_spans(arm_tid)}
+        assert any(ls in arm_spans for _, ls in root["links"])
+        # links survive the OTLP encoding
+        from orleans_tpu.observability.export import spans_to_otlp
+        req = spans_to_otlp([root])
+        ospan = req["resourceSpans"][0]["scopeSpans"][0]["spans"][0]
+        assert ospan["links"][0]["traceId"].endswith(f"{arm_tid:x}")
+
+
+async def test_untraced_timer_roots_carry_no_links():
+    """Timers armed OUTSIDE a sampled turn (tracing off at arm time)
+    must not invent links."""
+
+    class ArmGrain2(Grain):
+        async def arm(self) -> int:
+            self.register_timer(self._tick, 0.02, None)
+            return 1
+
+        async def _tick(self):
+            await self.get_grain(EchoGrain, 9).ping(9)
+
+    cluster = (TestClusterBuilder(1).add_grains(EchoGrain, ArmGrain2)
+               .with_tracing(client=False).build())
+    async with cluster:
+        # client untraced -> the arming turn records no server span and
+        # current_trace is unset at register_timer
+        assert await cluster.grain(ArmGrain2, 1).arm() == 1
+        await asyncio.sleep(0.2)
+        silo = cluster.silos[0]
+        roots = [s for s in silo.tracer.snapshot()
+                 if s["parent_id"] is None and s["name"] == "EchoGrain.ping"]
+        assert roots, "timer tick did not root a trace"
+        assert all(not r.get("links") for r in roots)
