@@ -42,6 +42,35 @@ class EchoGrain(Grain):
         return x
 
 
+def call_batch_group(i: int, n_keys: int, batch: int) -> list:
+    """One deliberate ``call_batch`` group for the attribution/A-B
+    harnesses — the ONE key-striding + payload scheme every batched
+    sender loop shares (ingest/loop attribution and the sender A/B must
+    drive identical traffic or their cross-bench comparisons stop
+    meaning anything)."""
+    import numpy as np
+    return [((i + j) % n_keys, {"x": np.int32((i + j) & 0x7FFF)})
+            for j in range(batch)]
+
+
+def batched_vec_sender(client, vec_cls, n_keys: int, batch: int,
+                       stop_at: float, counter: list):
+    """The ONE deliberate batched vector-sender loop every harness
+    drives (ingest/loop attribution and the sender A/B share it so
+    their traffic stays byte-identical): one ``call_batch`` group per
+    await, gather the round, stride on. ``counter`` is a one-element
+    list accumulating sent calls (the harnesses fold it into their own
+    totals)."""
+    async def worker(wid: int) -> None:
+        i = wid * 1000
+        while time.perf_counter() < stop_at:
+            await asyncio.gather(*client.call_batch(
+                vec_cls, "ping", call_batch_group(i, n_keys, batch)))
+            i += batch
+            counter[0] += batch
+    return worker
+
+
 def _make_vector_grain():
     import jax.numpy as jnp
 
@@ -63,11 +92,16 @@ def _make_vector_grain():
 
 async def run(seconds: float = 2.0, concurrency: int = 32,
               n_grains: int = 64, n_keys: int = 64,
-              batched: bool = True) -> dict:
+              batched: bool = True, offloop: bool = True,
+              call_batch: bool = False,
+              call_batch_size: int = 16) -> dict:
     """One silo over real TCP, metrics on, mixed host + device traffic;
     returns the stage breakdown in the BENCH extra. ``batched=False``
-    flips the silo to the per-frame ingest path (the A/B lever) so the
-    stage shares can be compared at the same concurrency."""
+    flips the silo to the per-frame ingest path, ``offloop=False`` to
+    the loop-inline device tick (the two A/B levers).
+    ``call_batch=True`` switches the vector workers from per-message
+    awaited pings to deliberate ``client.call_batch`` groups of
+    ``call_batch_size`` — the sender-side half of the pump share."""
     import numpy as np
 
     from orleans_tpu.dispatch import add_vector_grains
@@ -78,7 +112,7 @@ async def run(seconds: float = 2.0, concurrency: int = 32,
     b = (SiloBuilder().with_name("ingest-silo").with_fabric(fabric)
          .add_grains(EchoGrain)
          .with_config(metrics_enabled=True, metrics_sample_period=0.25,
-                      batched_ingress=batched))
+                      batched_ingress=batched, offloop_tick=offloop))
     add_vector_grains(b, EchoVec, mesh=make_mesh(1),
                       dense={EchoVec: n_keys})
     silo = b.build()
@@ -110,12 +144,23 @@ async def run(seconds: float = 2.0, concurrency: int = 32,
                 i += 1
                 calls += 1
 
+        # deliberate client-side batching: one call_batch per round fills
+        # a wire batch at the sender instead of relying on the greedy
+        # drain, and lands silo-side as ONE routing hop (loop shared
+        # with loop_attribution and the sender A/B — identical traffic
+        # is the cross-bench contract)
+        cb_count = [0]
+        vw = (batched_vec_sender(client, EchoVec, n_keys, call_batch_size,
+                                 stop_at, cb_count)
+              if call_batch else vec_worker)
+
         t0 = time.perf_counter()
         half = max(1, concurrency // 2)
         await asyncio.gather(
             *(host_worker(w) for w in range(half)),
-            *(vec_worker(w) for w in range(half)))
+            *(vw(w) for w in range(half)))
         elapsed = time.perf_counter() - t0
+        calls += cb_count[0]
 
         snap = silo.stats.snapshot()
         hists = snap["histograms"]
@@ -140,7 +185,8 @@ async def run(seconds: float = 2.0, concurrency: int = 32,
         "vs_baseline": None,
         "extra": {
             "seconds": seconds, "concurrency": concurrency,
-            "batched": batched,
+            "batched": batched, "offloop": offloop,
+            "call_batch": call_batch,
             "calls": calls,
             "stage_seconds": {k: round(v, 4)
                               for k, v in stage_seconds.items()},
@@ -160,11 +206,11 @@ async def run(seconds: float = 2.0, concurrency: int = 32,
 
 
 async def _drain(silo) -> None:
-    """Let one injection round fully retire: vector ticks flush, host
-    turn tasks complete."""
+    """Let one injection round fully retire: vector ticks flush (incl.
+    off-loop worker in-flight batches), host turn tasks complete."""
     rt = silo.vector
     while True:
-        if rt is not None and rt.pending:
+        if rt is not None and (rt.pending or rt._inflight):
             await rt.flush()
         if not any(not t.done() for t in silo.dispatcher._turn_tasks):
             return
@@ -286,21 +332,107 @@ async def run_ab(n_msgs: int = 512, seconds: float = 1.5,
     }
 
 
+async def run_call_batch_ab(seconds: float = 1.5, workers: int = 16,
+                            n_keys: int = 64, batch: int = 16) -> dict:
+    """Deliberate client-side batching vs per-message sends, vector-only
+    (the sender-side half of the pump story, isolated from the mixed
+    harness's host/vec mix shift): the same worker count drives the same
+    device-tier keys over real TCP, once awaiting one ``ref.ping`` per
+    round trip, once filling a ``call_batch`` group per round trip.
+
+    The measured win is predominantly CLIENT-side — per-call
+    send_request/GrainRef machinery collapses to one pass per group and
+    the wire batch is filled deliberately rather than by greedy-drain
+    luck — while per-message pump cost stays ~flat (the receive side has
+    been batch-routed since the PR-7 ingress pipeline). Ratio-based, so
+    interpreter/container speed cancels."""
+    import numpy as np
+
+    from orleans_tpu.dispatch import add_vector_grains
+    from orleans_tpu.parallel import make_mesh
+
+    EchoVec = _make_vector_grain()
+    fabric = SocketFabric()
+    b = (SiloBuilder().with_name("cb-ab").with_fabric(fabric)
+         .add_grains(EchoGrain))
+    add_vector_grains(b, EchoVec, mesh=make_mesh(1), dense={EchoVec: n_keys})
+    silo = b.build()
+    await silo.start()
+    client = await GatewayClient([silo.silo_address.endpoint]).connect()
+    try:
+        refs = [client.get_grain(EchoVec, k) for k in range(n_keys)]
+        await asyncio.gather(*(v.ping(x=np.int32(0)) for v in refs[:8]))
+
+        async def measure(use_batch: bool) -> float:
+            stop_at = time.perf_counter() + seconds
+            calls = 0
+            cb_count = [0]
+
+            async def w_pm(wid: int) -> None:
+                nonlocal calls
+                i = wid
+                while time.perf_counter() < stop_at:
+                    await refs[i % n_keys].ping(x=np.int32(i & 0x7FFF))
+                    i += 1
+                    calls += 1
+
+            # the shared sender loop (batched_vec_sender): the A/B's
+            # batched side drives the same traffic the attribution
+            # harnesses measure
+            w_cb = batched_vec_sender(client, EchoVec, n_keys, batch,
+                                      stop_at, cb_count)
+
+            t0 = time.perf_counter()
+            await asyncio.gather(*((w_cb if use_batch else w_pm)(w)
+                                   for w in range(workers)))
+            return (calls + cb_count[0]) / (time.perf_counter() - t0)
+
+        per_msg = await measure(False)
+        batched = await measure(True)
+    finally:
+        await client.close_async()
+        await silo.stop()
+    ratio = batched / per_msg if per_msg else 0.0
+    return {
+        "metric": "call_batch_speedup",
+        "value": round(ratio, 2),
+        "unit": "x (vector-only, call_batch vs per-message senders)",
+        "vs_baseline": None,
+        "extra": {
+            "per_message_msgs_per_sec": round(per_msg, 1),
+            "call_batch_msgs_per_sec": round(batched, 1),
+            "workers": workers, "batch": batch, "seconds": seconds,
+        },
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seconds", type=float, default=3.0)
     ap.add_argument("--concurrency", type=int, default=32)
     ap.add_argument("--ab", action="store_true",
                     help="run the batched-vs-per-frame hand-off A/B")
+    ap.add_argument("--call-batch-ab", action="store_true",
+                    help="run the call_batch-vs-per-message sender A/B")
     ap.add_argument("--per-frame", action="store_true",
                     help="attribution with batched ingress OFF (the "
                          "share-comparison baseline)")
+    ap.add_argument("--inline-tick", action="store_true",
+                    help="attribution with the off-loop tick OFF (the "
+                         "loop-inline A/B baseline)")
+    ap.add_argument("--call-batch", action="store_true",
+                    help="vector senders use deliberate client-side "
+                         "call_batch groups instead of per-message pings")
     a = ap.parse_args()
     if a.ab:
         print(json.dumps(asyncio.run(run_ab(seconds=a.seconds))))
+    elif a.call_batch_ab:
+        print(json.dumps(asyncio.run(run_call_batch_ab(seconds=a.seconds))))
     else:
         print(json.dumps(asyncio.run(run(a.seconds, a.concurrency,
-                                         batched=not a.per_frame))))
+                                         batched=not a.per_frame,
+                                         offloop=not a.inline_tick,
+                                         call_batch=a.call_batch))))
 
 
 if __name__ == "__main__":

@@ -11,11 +11,15 @@ reads the per-category loop shares back out:
 
     turns                    host grain turns
     tick_schedule/staging/
-    tick_transfer/tick_sync  the device tick, segmented — tick_sync is
-                             the host materialize where async device
-                             dispatch is actually PAID on the loop (the
-                             off-loop-tick-sync lever's reclaimable slice)
+    tick_transfer/tick_sync  the device tick, segmented — with the
+                             off-loop tick pipeline (PR 9, the default)
+                             only tick_schedule remains on the loop;
+                             ``offloop=False`` restores the inline path
+                             where staging/transfer/sync book here
     pump                     socket reads + wire decode + batched routing
+    client                   client-side gateway machinery (pumps,
+                             senders, reconnector) — first-class since
+                             PR 9 so harness cost leaves "other"
     storage/observability    provider IO / our own telemetry machinery
     other / idle             unattributed callbacks / select() wait
 
@@ -41,13 +45,19 @@ from orleans_tpu.runtime.socket_fabric import GatewayClient, SocketFabric
 # same saturated mixed workload as the ingest harness this is modeled on
 # (one definition: the two benches must measure identical traffic, or
 # cross-bench share comparisons in the ROADMAP stop meaning anything)
-from benchmarks.ingest_attribution import EchoGrain, _make_vector_grain
+from benchmarks.ingest_attribution import (EchoGrain, _make_vector_grain,
+                                           batched_vec_sender)
 
 
 async def run(seconds: float = 2.0, concurrency: int = 32,
-              n_grains: int = 64, n_keys: int = 64) -> dict:
+              n_grains: int = 64, n_keys: int = 64,
+              offloop: bool = True, call_batch: bool = False,
+              call_batch_size: int = 16) -> dict:
     """One silo over real TCP, profiling on, mixed host + device traffic
-    at closed-loop saturation; returns the loop-occupancy breakdown."""
+    at closed-loop saturation; returns the loop-occupancy breakdown.
+    ``offloop=False`` restores the loop-inline device tick (the A/B
+    lever this harness exists to measure); ``call_batch=True`` switches
+    the vector senders to deliberate client-side wire batches."""
     import numpy as np
 
     from orleans_tpu.dispatch import add_vector_grains
@@ -57,7 +67,8 @@ async def run(seconds: float = 2.0, concurrency: int = 32,
     fabric = SocketFabric()
     b = (SiloBuilder().with_name("loop-silo").with_fabric(fabric)
          .add_grains(EchoGrain)
-         .with_config(profiling_enabled=True, profiling_window=0.25))
+         .with_config(profiling_enabled=True, profiling_window=0.25,
+                      offloop_tick=offloop))
     add_vector_grains(b, EchoVec, mesh=make_mesh(1),
                       dense={EchoVec: n_keys})
     silo = b.build()
@@ -97,12 +108,22 @@ async def run(seconds: float = 2.0, concurrency: int = 32,
                 i += 1
                 calls += 1
 
+        # deliberate client-side batching (call_batch): one group per
+        # await fills a wire batch at the sender and lands silo-side as
+        # one routing hop — the sender loop is SHARED with the ingest
+        # harness (identical traffic is the cross-bench contract)
+        cb_count = [0]
+        vw = (batched_vec_sender(client, EchoVec, n_keys, call_batch_size,
+                                 stop_at, cb_count)
+              if call_batch else vec_worker)
+
         t0 = time.perf_counter()
         half = max(1, concurrency // 2)
         await asyncio.gather(
             *(host_worker(w) for w in range(half)),
-            *(vec_worker(w) for w in range(half)))
+            *(vw(w) for w in range(half)))
         elapsed = time.perf_counter() - t0
+        calls += cb_count[0]
 
         # read the profile BEFORE stop (stop uninstalls the profiler)
         # and diff against the post-warmup snapshot: interval-only split
@@ -128,6 +149,7 @@ async def run(seconds: float = 2.0, concurrency: int = 32,
         "vs_baseline": None,
         "extra": {
             "seconds": seconds, "concurrency": concurrency,
+            "offloop": offloop, "call_batch": call_batch,
             "calls": calls,
             "calls_per_sec": round(calls / elapsed, 1),
             "shares": shares,
@@ -137,8 +159,64 @@ async def run(seconds: float = 2.0, concurrency: int = 32,
             "device_sync_share": shares.get("tick_sync", 0.0),
             "turns_share": shares.get("turns", 0.0),
             "pump_share": shares.get("pump", 0.0),
+            "client_share": shares.get("client", 0.0),
             "observability_share": shares.get("observability", 0.0),
             "top_callbacks_last_window": top,
+        },
+    }
+
+
+async def run_ab(seconds: float = 2.0, concurrency: int = 32) -> dict:
+    """Off-loop tick + call_batch A/B on identical mixed TCP traffic
+    (the ISSUE 9 acceptance point, all ratios):
+
+      inline       offloop_tick=False, per-message senders (the PR-8
+                   baseline split)
+      offloop      offloop_tick=True, per-message senders — the tick
+                   slice (staging/transfer/sync) leaves the loop
+      offloop+cb   offloop + deliberate client-side call_batch — the
+                   per-message routing share of the pump collapses to
+                   per-batch work
+
+    Emits throughput ratios and the loop tick-share drop. Ratio-based on
+    purpose: absolute rates on a shared-core container are noise."""
+    inline = await run(seconds, concurrency, offloop=False)
+    off = await run(seconds, concurrency, offloop=True)
+    off_cb = await run(seconds, concurrency, offloop=True,
+                       call_batch=True)
+
+    def tick(r):
+        return r["extra"]["device_tick_share"]
+
+    def rate(r):
+        return r["extra"]["calls_per_sec"]
+
+    ratio = rate(off) / rate(inline) if rate(inline) else 0.0
+    return {
+        "metric": "offloop_tick_speedup",
+        "value": round(ratio, 3),
+        "unit": "x (offloop vs inline, same traffic)",
+        "vs_baseline": None,
+        "extra": {
+            "seconds": seconds, "concurrency": concurrency,
+            "inline": {"calls_per_sec": rate(inline),
+                       "tick_share": tick(inline),
+                       "shares": inline["extra"]["shares"]},
+            "offloop": {"calls_per_sec": rate(off),
+                        "tick_share": tick(off),
+                        "shares": off["extra"]["shares"]},
+            "offloop_call_batch": {
+                "calls_per_sec": rate(off_cb),
+                "tick_share": tick(off_cb),
+                "pump_share": off_cb["extra"]["pump_share"],
+                "shares": off_cb["extra"]["shares"]},
+            "tick_share_ratio": round(
+                tick(off) / tick(inline), 3) if tick(inline) else 0.0,
+            "call_batch_speedup_vs_inline": round(
+                rate(off_cb) / rate(inline), 3) if rate(inline) else 0.0,
+            "pump_share_ratio_cb_vs_offloop": round(
+                off_cb["extra"]["pump_share"] / off["extra"]["pump_share"],
+                3) if off["extra"]["pump_share"] else 0.0,
         },
     }
 
@@ -147,8 +225,19 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seconds", type=float, default=3.0)
     ap.add_argument("--concurrency", type=int, default=32)
+    ap.add_argument("--inline-tick", action="store_true",
+                    help="loop-inline device tick (the A/B baseline)")
+    ap.add_argument("--call-batch", action="store_true",
+                    help="vector senders use client-side call_batch")
+    ap.add_argument("--ab", action="store_true",
+                    help="run the inline/offloop/call_batch A/B sweep")
     a = ap.parse_args()
-    print(json.dumps(asyncio.run(run(a.seconds, a.concurrency))))
+    if a.ab:
+        print(json.dumps(asyncio.run(run_ab(a.seconds, a.concurrency))))
+    else:
+        print(json.dumps(asyncio.run(run(
+            a.seconds, a.concurrency, offloop=not a.inline_tick,
+            call_batch=a.call_batch))))
 
 
 if __name__ == "__main__":

@@ -84,9 +84,13 @@ async def run(n_mappers: int = 16, n_reducers: int = 4,
     times = []
     for _ in range(repeats):
         t0 = time.perf_counter()
-        await asyncio.gather(*(
-            client.get_grain(MapperGrain, i).map_block(blocks[i], n_reducers)
-            for i in range(n_mappers)))
+        # deliberate batched fan-out (RuntimeClient.call_batch): the N
+        # map_block invocations are built in one pass and ride one
+        # deliver_batch hop instead of N per-call send_request trips
+        await asyncio.gather(*client.call_batch(
+            MapperGrain, "map_block",
+            [(i, {"text": blocks[i], "n_reducers": n_reducers})
+             for i in range(n_mappers)]))
         table = await client.get_grain(CollectorGrain, 0).collect(n_reducers)
         times.append(time.perf_counter() - t0)
         assert table == dict(expected), "word-count mismatch"

@@ -75,6 +75,17 @@ def main() -> None:
     # observability vs idle, shares summing to ~1.0 of loop wall time
     print(json.dumps(asyncio.run(loop_attribution.run(
         seconds=2.0, concurrency=32))))
+    # off-loop tick + call_batch A/B (ISSUE 9): inline vs off-loop vs
+    # off-loop+call_batch on identical mixed TCP traffic — loop tick
+    # share collapses off-loop (measured 0.11 -> <0.01), throughput
+    # ratios floored in test_floor_offloop_tick
+    print(json.dumps(asyncio.run(loop_attribution.run_ab(
+        seconds=2.0, concurrency=32))))
+    # deliberate client-side batching vs per-message senders, vector-only
+    # (isolates the sender-side win from the mixed harness's host/vec
+    # mix shift; measured ~1.5-1.8x, CI floor 1.2x)
+    print(json.dumps(asyncio.run(ingest_attribution.run_call_batch_ab(
+        seconds=1.5))))
     # profiler overhead as a ratio vs a bare silo (per-callback
     # interposition + category accounting; CI floor 0.85)
     print(json.dumps(asyncio.run(ping.bench_profiling_overhead(

@@ -65,6 +65,10 @@ class MessagingOptions:
     max_enqueued_requests: int = 5000
     max_request_processing_time: float = 60.0
     batched_ingress: bool = True
+    # off-loop device-tick pipeline (dispatch.engine tick worker):
+    # ``offloop_tick=False`` restores the loop-inline tick — the A/B
+    # lever paired with ``batched_ingress``
+    offloop_tick: bool = True
 
     def validate(self) -> None:
         # no cross-field rule tying max_request_processing_time to
@@ -324,6 +328,12 @@ class DispatchOptions:
 
     capacity_per_shard: int = 1024
     exchange_capacity: int = 256
+    # off-loop tick worker for STANDALONE VectorRuntime(options=...)
+    # construction (silo-hosted runtimes take the lever from
+    # SiloConfig.offloop_tick / MessagingOptions.offloop_tick instead).
+    # Default False: a bare engine keeps today's synchronous loop-inline
+    # tick, which direct drivers (tests, bulk benchmarks) rely on.
+    offloop_tick: bool = False
 
     def validate(self) -> None:
         _positive(self, "capacity_per_shard", "exchange_capacity")
@@ -338,6 +348,7 @@ _FLAT_MAP = {
     "max_request_processing_time": (MessagingOptions,
                                     "max_request_processing_time"),
     "batched_ingress": (MessagingOptions, "batched_ingress"),
+    "offloop_tick": (MessagingOptions, "offloop_tick"),
     "turn_warning_length": (SchedulingOptions, "turn_warning_length"),
     "detect_deadlocks": (SchedulingOptions, "detect_deadlocks"),
     "collection_age": (GrainCollectionOptions, "collection_age"),

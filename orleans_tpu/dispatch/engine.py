@@ -26,6 +26,8 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import queue as _queue
+import threading
 import time
 import warnings
 from functools import partial
@@ -57,6 +59,19 @@ MIN_BUCKET = 8
 
 def _bucket(n: int) -> int:
     return max(MIN_BUCKET, 1 << max(0, (n - 1).bit_length()))
+
+
+def _emit(sink, st, key: str, value: float) -> None:
+    """One stage observation: direct on the loop (inline tick), deferred
+    into ``sink`` on the worker (StatsRegistry/Histogram are not
+    thread-safe — concurrent += loses updates and a first-tick key
+    insert can break the sampler's snapshot iteration — so worker-side
+    measurements REPLAY loop-side in _complete_job; the timing itself
+    is still stamped off-loop)."""
+    if sink is not None:
+        sink.append((key, value))
+    else:
+        st.observe(key, value)
 
 
 def _validate_args(cls: type, method: str, schema: dict, args: dict) -> None:
@@ -200,6 +215,31 @@ class _Pending:
         self.t_enq = t_enq
 
 
+class _TickJob:
+    """One claimed (class, method) batch bound for the off-loop tick
+    worker. ``ready`` holds the conflict-free claim (turn semantics were
+    decided loop-side); ``trace`` is the device-tick sampling roll (also
+    loop-side — the SpanCollector is not thread-safe, so the worker only
+    stamps timings and the completion callback records the span).
+    ``per_shard``/``span`` are filled by the worker for the loop-side
+    resolve; ``stats`` collects the worker's deferred stage observations
+    — ``(key, value)`` with None = shed-trend note and _MESSAGES =
+    counter increment — replayed loop-side (the registries are
+    loop-confined)."""
+
+    __slots__ = ("cls", "method", "ready", "trace", "per_shard", "span",
+                 "stats")
+
+    def __init__(self, cls, method, ready, trace=False):
+        self.cls = cls
+        self.method = method
+        self.ready = ready
+        self.trace = trace
+        self.per_shard = None
+        self.span = None
+        self.stats: list = []
+
+
 class VectorActorRef:
     """Typed handle to one device-tier activation (GrainReference analog)."""
 
@@ -284,6 +324,37 @@ class VectorRuntime:
         # stateless-worker (mesh-replicated) hosts per class — see
         # dispatch.replicated (StatelessWorkerPlacement.cs:6 on device)
         self._replicated_hosts: dict[type, Any] = {}
+        # off-loop tick pipeline (SiloConfig.offloop_tick /
+        # DispatchOptions.offloop_tick): when enabled, claimed batches run
+        # on a dedicated per-engine worker thread — staging fill, operand
+        # upload, kernel dispatch, and the host materialize sync all leave
+        # the event loop; the loop-side _tick shrinks to claim/conflict-
+        # defer plus a queue hand-off, and futures resolve back on the
+        # loop via call_soon_threadsafe. The _fence is the tick-
+        # serialization lock: the worker holds it for the whole batch
+        # (donated state + donated staging operands are in flight), and
+        # loop-side table mutation/materialization — grow(), shard moves,
+        # bulk call_batch*, checkpoint capture, write-behind gathers —
+        # takes it around the touch so neither side ever sees a donated
+        # buffer mid-dispatch. Worker FIFO order serializes state
+        # donation per table (tick N+1 runs strictly after tick N's sync
+        # proved N's uploads complete, so staging lanes never rotate back
+        # to "filling" under an in-flight transfer).
+        self.offloop_tick = bool(getattr(options, "offloop_tick", False)) \
+            if options is not None else False
+        self._fence = threading.RLock()
+        self._worker: threading.Thread | None = None
+        self._worker_q: "_queue.SimpleQueue | None" = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._quiesced: asyncio.Event | None = None
+        self._complete_ctx = None  # tick_schedule-labeled completion ctx
+        self._inflight = 0        # jobs handed to the worker, unresolved
+        self._inflight_msgs = 0   # messages inside those jobs
+        # class -> {key_hash: count} for in-flight jobs: these keys are
+        # FENCED exactly like pending ones (pending_key_hashes) — a
+        # migration moving one mid-flight would let the worker's scatter
+        # land in the abandoned source row
+        self._inflight_keys: dict[type, dict[int, int]] = {}
         # lax.scan unroll for scanned (call_batch_rounds) kernels: each
         # scan step carries a fixed per-iteration cost (loop bookkeeping,
         # staged-payload dynamic slicing) that dominates small-population
@@ -347,6 +418,11 @@ class VectorRuntime:
                 self.tables[cls] = ShardedActorTable(
                     cls, self.mesh,
                     capacity_per_shard or self.capacity_per_shard)
+                # tick-serialization fence: table-level state mutators/
+                # materializers (grow, move_rows, snapshot/restore,
+                # read_row) serialize against worker-side batch execution
+                # through the engine's lock (uncontended no-op inline)
+                self.tables[cls].fence = self._fence
                 if self.track_load:
                     self.tables[cls].enable_hit_tracking()
 
@@ -475,17 +551,24 @@ class VectorRuntime:
             tbl.enable_hit_tracking()
 
     def queue_depth(self) -> int:
-        """Invocations queued for future ticks (incl. conflict-deferred) —
-        the device tier's inbound-queue-depth load signal."""
-        return sum(len(v) for v in self.pending.values())
+        """Invocations queued for future ticks (incl. conflict-deferred
+        and batches in flight on the off-loop worker) — the device tier's
+        inbound-queue-depth load signal."""
+        return sum(len(v) for v in self.pending.values()) + \
+            self._inflight_msgs
 
     def pending_key_hashes(self, cls: type) -> set[int]:
-        """Keys with queued invocations for ``cls``. Queued ``_Pending``
-        entries cache their (shard, slot), so these keys are FENCED: a
-        migration moving one mid-flight would let the next tick scatter
-        into the abandoned source row."""
-        return {p.key_hash for (c, _m), items in self.pending.items()
+        """Keys with queued invocations for ``cls``, plus keys inside
+        batches currently executing on the off-loop worker. Queued
+        ``_Pending`` entries cache their (shard, slot), so these keys are
+        FENCED: a migration moving one mid-flight would let the next (or
+        in-flight) tick scatter into the abandoned source row."""
+        keys = {p.key_hash for (c, _m), items in self.pending.items()
                 if c is cls for p in items}
+        ctr = self._inflight_keys.get(cls)
+        if ctr:
+            keys.update(ctr)
+        return keys
 
     def shard_loads(self) -> dict[type, np.ndarray]:
         """Per-class per-shard invocation totals since the last reset."""
@@ -497,8 +580,14 @@ class VectorRuntime:
                 np.atleast_1d(np.asarray(keys)))
 
     def drain_dirty(self, cls: type) -> np.ndarray:
-        """Keys written since the last drain (deduplicated)."""
-        batches = self._dirty.pop(cls, None)
+        """Keys written since the last drain (deduplicated). The pop is
+        under the tick fence: ``_mark_dirty`` runs worker-side inside an
+        off-loop batch (which holds the fence for its whole duration),
+        so an unfenced pop could orphan a list the worker is about to
+        append to — keys written by that batch would silently never
+        flush. Uncontended no-op on the inline path."""
+        with self._fence:
+            batches = self._dirty.pop(cls, None)
         if not batches:
             return np.zeros(0, dtype=np.int64)
         return np.unique(np.concatenate(batches))
@@ -533,23 +622,194 @@ class VectorRuntime:
 
     def staging_lanes(self) -> int:
         """Total preallocated staging lanes across every double-buffer
-        set (the staging-buffer footprint gauge)."""
-        total = 0
-        for pool in self._staging.values():
-            for (n, B, _sig), (sets, _idx) in pool.items():
-                total += n * B * len(sets)
-        return total
+        set (the staging-buffer footprint gauge). Read loop-side while
+        the off-loop worker may be growing the pools — retried on a
+        concurrent-mutation error rather than fenced (the sampler must
+        never block the loop behind an in-flight batch)."""
+        for _ in range(4):
+            try:
+                total = 0
+                for pool in list(self._staging.values()):
+                    for (n, B, _sig), (sets, _idx) in list(pool.items()):
+                        total += n * B * len(sets)
+                return total
+            except RuntimeError:  # dict mutated during iteration
+                continue
+        return 0
 
     def _schedule_tick(self, loop) -> None:
         if not self._tick_scheduled:
             self._tick_scheduled = True
             loop.call_soon(self._tick)
 
+    # -- off-loop tick worker ------------------------------------------
+    def tick_fence(self):
+        """The tick-serialization fence (a reentrant lock usable as a
+        context manager): loop-side code that mutates or materializes
+        table state outside the tick path — rebalance shard moves,
+        checkpoint capture, write-behind gathers — takes it around the
+        touch so it can never interleave with a worker-side batch whose
+        donated state/staging upload is still in flight. Uncontended
+        (and effectively free) on the inline path."""
+        return self._fence
+
+    def _ensure_worker(self) -> None:
+        if self._worker is not None:
+            return
+        import contextvars
+
+        from ..observability.profiling import LOOP_CATEGORY
+        self._loop = asyncio.get_running_loop()
+        self._worker_q = _queue.SimpleQueue()
+        self._quiesced = asyncio.Event()
+        self._quiesced.set()
+        # completion callbacks run loop-side in THIS prebuilt context so
+        # the profiler books them to tick_schedule — the same category
+        # the inline path's resolution work carries. Scheduling from the
+        # worker thread would otherwise capture an unset context and the
+        # per-batch resolve/replay would book to "other", biasing the
+        # inline-vs-offloop tick-share A/B exactly where it is read.
+        self._complete_ctx = contextvars.Context()
+        self._complete_ctx.run(LOOP_CATEGORY.set, "tick_schedule")
+        t = threading.Thread(target=self._worker_main,
+                             name="orleans-tick-worker", daemon=True)
+        self._worker = t
+        t.start()
+
+    def shutdown_worker(self, timeout: float = 10.0) -> None:
+        """Stop the off-loop tick worker (silo stop): jobs already queued
+        finish FIFO, then the thread exits. Completion callbacks posted
+        to the loop still run when control next returns to it. Idempotent
+        and a no-op on the inline path; a later tick after shutdown would
+        lazily start a fresh worker (restart-in-process)."""
+        w, self._worker = self._worker, None
+        if w is None:
+            return
+        self._worker_q.put(None)
+        w.join(timeout)
+
+    def _worker_main(self) -> None:
+        q = self._worker_q
+        while True:
+            job = q.get()
+            if job is None:
+                return
+            host = err = None
+            try:
+                # the fence is held for the WHOLE batch: donated tbl.state
+                # and donated staging operands are in flight until the
+                # sync at the end of _execute_batch proves the uploads
+                # completed
+                with self._fence:
+                    job.per_shard, host, job.span = self._execute_batch(
+                        job.cls, job.method, job.ready, None,
+                        trace_roll=job.trace, sink=job.stats)
+            except BaseException as e:  # noqa: BLE001 — futures fail loop-side
+                err = e
+            try:
+                self._loop.call_soon_threadsafe(
+                    self._complete_job, job, host, err,
+                    context=self._complete_ctx)
+            except RuntimeError:
+                # loop closed (ungraceful stop): the runtime client is
+                # breaking outstanding futures; nothing left to resolve
+                return
+
+    def _submit_job(self, job: _TickJob) -> None:
+        self._ensure_worker()
+        self._inflight += 1
+        self._inflight_msgs += len(job.ready)
+        self._quiesced.clear()
+        ctr = self._inflight_keys.setdefault(job.cls, {})
+        for p in job.ready:
+            ctr[p.key_hash] = ctr.get(p.key_hash, 0) + 1
+        self._worker_q.put(job)
+
+    def _record_tick_span(self, span, n: int, error: bool = False) -> None:
+        """Loop-side record of a device-tick span from worker- (or
+        inline-) stamped timings; ``span`` = (name, wall_start,
+        duration) or None. The error form is what tail retention keys
+        on, so failing sampled ticks stay visible in retained traces."""
+        if span is not None and self.tracer is not None:
+            name, start_wall, dur = span
+            if error:
+                self.tracer.record(self.tracer.device_trace_id, None,
+                                   name, "device_tick", start_wall, dur,
+                                   batch=n, error=True)
+            else:
+                self.tracer.record(self.tracer.device_trace_id, None,
+                                   name, "device_tick", start_wall, dur,
+                                   batch=n)
+
+    def _complete_job(self, job: _TickJob, host, err) -> None:
+        """Loop-side completion: resolve futures (or fail them), record
+        the sampled device-tick span (the collector is loop-confined;
+        the worker only stamped timings), and — in a finally, so no
+        resolve/record error can ever wedge it — release the in-flight
+        key fence and re-arm the quiescence event. A loop-side failure
+        here fails the batch's futures like the inline path's tick
+        except does; it never leaves callers hanging."""
+        try:
+            # replay the worker's deferred observations into the loop-
+            # confined registries (timings were stamped off-loop); on an
+            # errored batch the list holds whatever stages completed
+            if job.stats:
+                st = self.stats
+                trend = self.shed_trend
+                for key, val in job.stats:
+                    if key is None:
+                        if trend is not None:
+                            trend.note(val)
+                    elif st is None:
+                        continue
+                    elif key is _MESSAGES:
+                        st.increment(key, val)
+                    else:
+                        st.observe(key, val)
+            if err is not None:
+                log.error("vector tick failed for %s.%s",
+                          job.cls.__name__, job.method, exc_info=err)
+                self._record_tick_span(getattr(err, "_tick_span", None),
+                                       len(job.ready), error=True)
+                for p in job.ready:
+                    if p.future is not None and not p.future.done():
+                        p.future.set_exception(err)
+            else:
+                self._record_tick_span(job.span, len(job.ready))
+                self._resolve_batch(job.ready, job.per_shard, host)
+        except BaseException as e2:  # noqa: BLE001 — fail futures, not loop
+            log.exception("vector tick completion failed for %s.%s",
+                          job.cls.__name__, job.method)
+            for p in job.ready:
+                if p.future is not None and not p.future.done():
+                    p.future.set_exception(e2)
+        finally:
+            self._inflight -= 1
+            self._inflight_msgs -= len(job.ready)
+            ctr = self._inflight_keys.get(job.cls)
+            if ctr is not None:
+                for p in job.ready:
+                    left = ctr.get(p.key_hash, 0) - 1
+                    if left <= 0:
+                        ctr.pop(p.key_hash, None)
+                    else:
+                        ctr[p.key_hash] = left
+            if self._inflight == 0:
+                self._quiesced.set()
+
     async def flush(self) -> None:
-        """Run ticks until all pending work (incl. conflict-deferred) drains."""
-        while self.pending:
-            self._tick()
-            await asyncio.sleep(0)
+        """Run ticks until all pending work (incl. conflict-deferred and
+        worker-side in-flight batches) drains. Identical to the
+        historical tick-and-yield spin on the inline path; with the
+        off-loop worker it awaits the worker's quiescence event between
+        rounds instead of busy-spinning the loop."""
+        while self.pending or self._inflight:
+            if self.pending:
+                self._tick()
+            if self._inflight:
+                await self._quiesced.wait()
+            else:
+                await asyncio.sleep(0)
 
     # ------------------------------------------------------------------
     def _tick(self) -> None:
@@ -559,31 +819,90 @@ class VectorRuntime:
         lp = self.loop_prof
         if lp is not None:
             # this call_soon callback IS the device tick: everything not
-            # re-segmented below (claiming, conflict defer, rescheduling)
-            # is tick scheduling work on the loop
+            # re-segmented below (claiming, conflict defer, rescheduling,
+            # worker hand-off) is tick scheduling work on the loop
             lp.set_category("tick_schedule")
         work, self.pending = self.pending, {}
+        offloop = self.offloop_tick
+        tracer = self.tracer
         for (cls, method), items in work.items():
+            ready = self._claim(cls, method, items)
+            if not ready:
+                continue
+            # device-tick sampling rolls HERE (loop-side) on both paths:
+            # the worker must not touch the collector
+            roll = tracer is not None and tracer.sample()
+            if offloop:
+                self._submit_job(_TickJob(cls, method, ready, roll))
+                continue
             try:
-                self._run_batch(cls, method, items)
+                self._run_batch(cls, method, ready, trace_roll=roll)
             except Exception as e:  # noqa: BLE001 — fail the futures, not the loop
                 log.exception("vector tick failed for %s.%s",
                               cls.__name__, method)
-                for p in items:
+                self._record_tick_span(getattr(e, "_tick_span", None),
+                                       len(ready), error=True)
+                for p in ready:
                     if p.future is not None and not p.future.done():
                         p.future.set_exception(e)
         self.ticks += 1
         if self.pending:  # conflict-deferred work → next tick
             self._schedule_tick(asyncio.get_running_loop())
 
-    def _run_batch(self, cls: type, method: str, items: list[_Pending]) -> None:
+    def _claim(self, cls: type, method: str,
+               items: list[_Pending]) -> list[_Pending]:
+        """Turn-semantics claim, always loop-side (it mutates
+        ``self.pending``): one message per slot per tick; same-slot
+        conflicts defer to the next tick."""
+        claimed: set[tuple[int, int]] = set()
+        ready: list[_Pending] = []
+        for p in items:
+            loc = (p.shard, p.slot)
+            if loc in claimed:
+                self.pending.setdefault((cls, method), []).append(p)
+                self.conflicts_deferred += 1
+                continue
+            claimed.add(loc)
+            ready.append(p)
+        return ready
+
+    def _run_batch(self, cls: type, method: str, ready: list[_Pending],
+                   trace_roll: bool = False) -> None:
+        """Inline (on-loop) batch execution — the ``offloop_tick=False``
+        path, semantically today's tick."""
+        per_shard, host, span = self._execute_batch(
+            cls, method, ready, self.loop_prof, trace_roll=trace_roll)
+        self._record_tick_span(span, len(ready))
+        self._resolve_batch(ready, per_shard, host)
+
+    def _resolve_batch(self, ready: list[_Pending], per_shard,
+                       host) -> None:
+        for s, ps in enumerate(per_shard):
+            for i, p in enumerate(ps):
+                if p.future is not None and not p.future.done():
+                    p.future.set_result(jax.tree_util.tree_map(
+                        lambda a: a[s, i], host))
+        self.messages_processed += len(ready)
+
+    def _execute_batch(self, cls: type, method: str, ready: list[_Pending],
+                       lp, trace_roll: bool = False, sink: list | None = None):
+        """Staging fill → operand upload → kernel dispatch → host
+        materialize sync for one claimed, conflict-free batch. Runs on
+        the loop (inline path; ``lp`` is the loop profiler, ``sink``
+        None — observations go straight to the registry) or on the
+        off-loop tick worker (``lp`` None — worker wall time is not loop
+        time and the profiler's attribution state is loop-confined;
+        ``sink`` = the job's deferred-stats list — timings are STAMPED
+        here off-loop but recorded loop-side in _complete_job, because
+        StatsRegistry/Histogram/QueueWaitTrend are not thread-safe).
+        Returns ``(per_shard, host_results, span_timing)`` where
+        ``span_timing`` is ``(name, wall_start, duration)`` for a sampled
+        tick (recorded by the caller on the loop) or None."""
         st = self.stats
-        lp = self.loop_prof
         if lp is not None:
-            # loop occupancy: claim/defer + staging-fill from here; the
-            # label tuple names this batch in the flight recorder's
-            # top-K and is only string-joined on admission — every tick
-            # pays no format on this path
+            # loop occupancy: staging-fill from here; the label tuple
+            # names this batch in the flight recorder's top-K and is only
+            # string-joined on admission — every tick pays no format
             lp.set_category("tick_staging", ("tick", cls.__name__, method))
         t_stage = now_mono = 0.0
         if st is not None:
@@ -600,20 +919,7 @@ class VectorRuntime:
         inferred = schema is None
         if inferred:
             schema = {k: (np.asarray(v).dtype, np.asarray(v).shape)
-                      for k, v in items[0].args.items()}
-        # one message per slot per tick: conflicts defer (turn semantics)
-        claimed: set[tuple[int, int]] = set()
-        ready: list[_Pending] = []
-        for p in items:
-            loc = (p.shard, p.slot)
-            if loc in claimed:
-                self.pending.setdefault((cls, method), []).append(p)
-                self.conflicts_deferred += 1
-                continue
-            claimed.add(loc)
-            ready.append(p)
-        if not ready:
-            return
+                      for k, v in ready[0].args.items()}
         n, cap = tbl.n_shards, tbl.capacity
         per_shard: list[list[_Pending]] = [[] for _ in range(n)]
         for p in ready:
@@ -646,21 +952,27 @@ class VectorRuntime:
         t_xfer = t_tick = 0.0
         if st is not None:
             t_xfer = time.perf_counter()
-            st.observe(_STAGING, t_xfer - t_stage)
+            _emit(sink, st, _STAGING, t_xfer - t_stage)
             # per-item queue wait: enqueue (rt.call) -> this batch start —
             # tick scheduling plus any conflict-deferred full ticks; items
             # enqueued by non-call paths carry no stamp and are skipped
             for p in ready:
                 if p.t_enq:
-                    st.observe(_QUEUE_WAIT, max(0.0, now_mono - p.t_enq))
+                    _emit(sink, st, _QUEUE_WAIT,
+                          max(0.0, now_mono - p.t_enq))
         if self.shed_trend is not None:
             # feed the load-shed trend with this batch's mean queue wait
+            # (deferred to the loop-side completion on the worker path:
+            # QueueWaitTrend is not thread-safe, and the dispatcher feeds
+            # it from the loop)
             stamped = [now_mono - p.t_enq for p in ready if p.t_enq]
             if stamped:
-                self.shed_trend.note(
-                    max(0.0, sum(stamped) / len(stamped)))
-        tracer = self.tracer
-        tick_span = None
+                mean = max(0.0, sum(stamped) / len(stamped))
+                if sink is not None:
+                    sink.append((None, mean))
+                else:
+                    self.shed_trend.note(mean)
+        span_name = span_start = t_span0 = None
         try:
             # operand buffers are donated: these device arrays are fresh
             # per tick (never the cached _DensePlan operands), so XLA may
@@ -673,25 +985,35 @@ class VectorRuntime:
                 {k: jnp.asarray(v) for k, v in args_stacked.items()})
             if st is not None:
                 t_tick = time.perf_counter()
-                st.observe(_TRANSFER, t_tick - t_xfer)
-            if tracer is not None and tracer.sample():
-                tick_span = tracer.open(
-                    f"tick {cls.__name__}.{method}", "device_tick",
-                    tracer.device_trace_id, None)
+                _emit(sink, st, _TRANSFER, t_tick - t_xfer)
+            if trace_roll:
+                span_name = f"tick {cls.__name__}.{method}"
+                span_start = time.time()
+                t_span0 = time.perf_counter()
                 # the TraceAnnotation bridges host tracing to the XLA
                 # timeline: on a jax.profiler capture, this tick's
                 # kernels nest under a span named like the logical tick
-                # span. Gated on the SAMPLED tick so unsampled/untraced
-                # silos pay nothing extra per batch flush.
-                with jax.profiler.TraceAnnotation(tick_span.name):
+                # span. Gated on the SAMPLED tick (rolled loop-side) so
+                # unsampled/untraced silos pay nothing per batch flush.
+                with jax.profiler.TraceAnnotation(span_name):
                     new_state, results = kernel(*kernel_args)
             else:
                 new_state, results = kernel(*kernel_args)
-        except BaseException:
+        except BaseException as e:
             if inferred:
                 m.args_schema = None  # do not poison the class schema
-            if tick_span is not None:
-                tracer.close(tick_span, batch=len(ready), error=True)
+            if span_start is not None:
+                # a sampled tick whose kernel raised still records an
+                # errored device span (tail retention keys on the error
+                # attr) — the collector is loop-confined, so the timing
+                # rides the exception to the loop-side completion/except
+                # (best-effort: an exception type rejecting attributes
+                # just loses the span, never the error)
+                try:
+                    e._tick_span = (span_name, span_start,
+                                    time.perf_counter() - t_span0)
+                except AttributeError:
+                    pass
             raise
         if not m.read_only:
             tbl.state = new_state
@@ -703,12 +1025,11 @@ class VectorRuntime:
                 count=len(ready)))
         if self.track_load:
             tbl.record_hits(slots, valid)
-        # resolve futures from the result batch
         if lp is not None:
-            # THE distinct device-sync occupancy: jax dispatch is async,
-            # so the host materialize below is where device execution is
-            # actually paid on the loop — the slice the off-loop-tick-sync
-            # ROADMAP lever would reclaim
+            # THE distinct device-sync occupancy (inline path only): jax
+            # dispatch is async, so the host materialize below is where
+            # device execution is actually paid on the loop — the slice
+            # the off-loop worker removes from the loop entirely
             lp.set_category("tick_sync")
         host = jax.tree_util.tree_map(np.asarray, results)
         if not jax.tree_util.tree_leaves(host):
@@ -717,29 +1038,30 @@ class VectorRuntime:
             # buffers can rotate back to "filling" — on async-transfer
             # backends (TPU) the operands' host→device upload must have
             # provably completed before the numpy buffers are reused
-            # (free on CPU, where the transfer copies synchronously)
+            # (free on CPU, where the transfer copies synchronously).
+            # This sync is ALSO the off-loop staging pin: the worker runs
+            # batches FIFO, so by the time a staging set rotates back its
+            # tick has provably synced here.
             jax.block_until_ready(new_state)
         if st is not None:
             # tick closes AFTER the host transfer for the same reason the
-            # span below does: jax dispatch is async, and the np.asarray
+            # span timing does: jax dispatch is async, and the np.asarray
             # sync is where device execution is actually paid
-            st.observe(_TICK, time.perf_counter() - t_tick)
-            st.increment(_MESSAGES, len(ready))
-        if tick_span is not None:
-            # close AFTER the host transfer: jax dispatch is async, so
-            # the np.asarray sync above is where device execution is
-            # actually paid — closing at kernel return would record ~0
-            # for exactly the hot ticks tracing exists to attribute
-            tracer.close(tick_span, batch=len(ready))
+            _emit(sink, st, _TICK, time.perf_counter() - t_tick)
+            if sink is not None:
+                sink.append((_MESSAGES, len(ready)))
+            else:
+                st.increment(_MESSAGES, len(ready))
+        span = None
+        if trace_roll and span_name is not None:
+            # duration closes AFTER the host transfer: closing at kernel
+            # return would record ~0 for exactly the hot ticks tracing
+            # exists to attribute. Recorded by the caller (loop-side).
+            span = (span_name, span_start, time.perf_counter() - t_span0)
         if lp is not None:
             # sync paid: future resolution is scheduling work again
             lp.set_category("tick_schedule")
-        for s, ps in enumerate(per_shard):
-            for i, p in enumerate(ps):
-                if p.future is not None and not p.future.done():
-                    p.future.set_result(jax.tree_util.tree_map(
-                        lambda a: a[s, i], host))
-        self.messages_processed += len(ready)
+        return per_shard, host, span
 
     # ------------------------------------------------------------------
     # Bulk path (dense keys; the ≥1M msgs/sec route)
@@ -820,11 +1142,15 @@ class VectorRuntime:
                 jnp.asarray(plan.pack(np.asarray(args[fname]), dtype, shape)))
         kern = self._kernel(grain_class, method, plan.B,
                             contiguous=self._plan_contiguous(tbl, plan))
-        new_state, results = kern(
-            tbl.state, d_slots, d_khash, d_fresh, d_valid, args_b)
-        if not m.read_only:
-            tbl.state = new_state
-            self._mark_dirty(grain_class, plan.keys)
+        # tick fence: the bulk path is its own tick on the CALLER's
+        # thread — it must not read (or commit over) tbl.state while an
+        # off-loop worker batch has it donated mid-dispatch
+        with self._fence:
+            new_state, results = kern(
+                tbl.state, d_slots, d_khash, d_fresh, d_valid, args_b)
+            if not m.read_only:
+                tbl.state = new_state
+                self._mark_dirty(grain_class, plan.keys)
         if self.track_load:
             tbl.record_hits(d_slots, d_valid)
         self.ticks += 1
@@ -907,11 +1233,13 @@ class VectorRuntime:
             # an unmasked write there could corrupt a hashed activation's
             # slot beyond the dense range
             all_valid=bool(plan.valid_b.all()))
-        new_state, results = kern(
-            tbl.state, d_slots, d_khash, d_fresh, d_valid, args_b)
-        if not m.read_only:
-            tbl.state = new_state
-            self._mark_dirty(grain_class, plan.keys)
+        with self._fence:  # see call_batch: bulk ticks serialize with
+            # the off-loop worker's donated in-flight batches
+            new_state, results = kern(
+                tbl.state, d_slots, d_khash, d_fresh, d_valid, args_b)
+            if not m.read_only:
+                tbl.state = new_state
+                self._mark_dirty(grain_class, plan.keys)
         if self.track_load:
             tbl.record_hits(d_slots, d_valid, scale=K)
         self.ticks += K
@@ -950,10 +1278,11 @@ class VectorRuntime:
         tbl = self.table(grain_class)
         m = self.method_of(grain_class, method)
         B = slots_b.shape[1]
-        new_state, results = self._kernel(grain_class, method, B)(
-            tbl.state, slots_b, khash_b, fresh_b, valid_b, args_b)
-        if not m.read_only:
-            tbl.state = new_state
+        with self._fence:  # see call_batch: serialize with off-loop ticks
+            new_state, results = self._kernel(grain_class, method, B)(
+                tbl.state, slots_b, khash_b, fresh_b, valid_b, args_b)
+            if not m.read_only:
+                tbl.state = new_state
         if self.track_load:
             # device-resident masks fold without a host sync — the
             # telemetry stays all-device exactly like the exchange flow

@@ -23,6 +23,7 @@ Two key regimes:
 
 from __future__ import annotations
 
+import threading
 from functools import partial
 
 import jax
@@ -80,6 +81,16 @@ class ShardedActorTable:
         # dispatch/layout penalty through the axon tunnel for zero benefit;
         # plain uncommitted arrays behave identically there.
         self.sharding = shard_spec(self.mesh) if self.n_shards > 1 else None
+        # tick-serialization fence: a reentrant lock the off-loop tick
+        # worker holds for every batch. State mutators/materializers
+        # below (grow, move_rows, snapshot/restore, read_row) take it so
+        # they never observe — or clobber — tbl.state while a worker-side
+        # kernel has it donated mid-flight. Always present (uncontended
+        # acquire is ~100ns on these cold paths, so standalone tables
+        # just pay a no-op); VectorRuntime.register replaces it with the
+        # owning engine's lock so every table in one engine shares the
+        # worker's fence.
+        self.fence = threading.RLock()
 
         # host bookkeeping
         self.key_to_slot: dict[int, tuple[int, int]] = {}  # key_hash → (shard, slot)
@@ -138,42 +149,59 @@ class ShardedActorTable:
         return len(self.key_to_slot) + int(self.dense_active.sum())
 
     # -- hot-spot telemetry (consumed by orleans_tpu.rebalance) -----------
+    # All four accessors are under the tick fence: record_hits DONATES
+    # the counter buffer (_accumulate_hits, donate_argnums=0) and runs
+    # inside off-loop worker batches — an unfenced loop-side read could
+    # materialize the donated (deleted) array, and an unfenced reset
+    # could be overwritten by a worker accumulate over pre-reset
+    # counters (double-counted load, defeating the int32-overflow
+    # protection the reset exists for).
     def enable_hit_tracking(self) -> None:
-        if self.hits is None:
-            self.hits = self._put(
-                jnp.zeros((self.n_shards, self.capacity + 1), jnp.int32))
+        with self.fence:
+            if self.hits is None:
+                self.hits = self._put(
+                    jnp.zeros((self.n_shards, self.capacity + 1),
+                              jnp.int32))
 
     def record_hits(self, slots_b, valid_b, scale: int = 1) -> None:
         """Fold one tick's [n_shards, B] batch into the per-slot counters
         (no-op until enable_hit_tracking). ``scale``: messages per lane —
-        K for a scanned K-round kernel."""
-        if self.hits is None:
-            return
-        self.hits = _accumulate_hits(
-            self.hits, jnp.asarray(slots_b, jnp.int32),
-            jnp.asarray(valid_b), jnp.int32(scale))
+        K for a scanned K-round kernel. Reentrant under the engine fence
+        the tick paths already hold."""
+        with self.fence:
+            if self.hits is None:
+                return
+            self.hits = _accumulate_hits(
+                self.hits, jnp.asarray(slots_b, jnp.int32),
+                jnp.asarray(valid_b), jnp.int32(scale))
 
     def shard_hits(self) -> np.ndarray:
         """[n_shards] invocation totals since the last reset (sink row
         excluded) — the load view a rebalance planner reads."""
-        if self.hits is None:
-            return np.zeros(self.n_shards, dtype=np.int64)
-        return np.asarray(
-            jnp.sum(self.hits[:, :self.capacity], axis=1)).astype(np.int64)
+        with self.fence:
+            if self.hits is None:
+                return np.zeros(self.n_shards, dtype=np.int64)
+            return np.asarray(
+                jnp.sum(self.hits[:, :self.capacity],
+                        axis=1)).astype(np.int64)
 
     def slot_hits(self) -> np.ndarray:
         """Host copy of the per-slot counters [n_shards, capacity+1]
         (planner-rate readout, not tick-rate)."""
-        if self.hits is None:
-            return np.zeros((self.n_shards, self.capacity + 1), np.int32)
-        return np.asarray(self.hits)
+        with self.fence:
+            if self.hits is None:
+                return np.zeros((self.n_shards, self.capacity + 1),
+                                np.int32)
+            return np.asarray(self.hits)
 
     def reset_hits(self) -> None:
         """Zero the counters (each rebalance round plans against the load
         observed since the previous round)."""
-        if self.hits is not None:
-            self.hits = self._put(
-                jnp.zeros((self.n_shards, self.capacity + 1), jnp.int32))
+        with self.fence:
+            if self.hits is not None:
+                self.hits = self._put(
+                    jnp.zeros((self.n_shards, self.capacity + 1),
+                              jnp.int32))
 
     # -- dense regime -----------------------------------------------------
     def ensure_dense(self, n: int) -> None:
@@ -267,6 +295,15 @@ class ShardedActorTable:
         return True
 
     def move_rows(self, keys, dest_shards) -> int:
+        """Tick-fenced wrapper (see ``fence``): a shard move gathers and
+        scatters ``state``, which must never interleave with an off-loop
+        tick whose donated state is mid-dispatch. The key-level fencing
+        contract (no pending/in-flight invocation for a moving key) stays
+        the caller's job via ``VectorRuntime.pending_key_hashes``."""
+        with self.fence:
+            return self._move_rows(keys, dest_shards)
+
+    def _move_rows(self, keys, dest_shards) -> int:
         """Live-migrate hashed-regime rows to new shards: extract the state
         rows, insert them at freshly-allocated slots on the destination
         shards, and atomically re-point the host directory maps + the
@@ -347,7 +384,16 @@ class ShardedActorTable:
     # -- growth -----------------------------------------------------------
     def grow(self, new_capacity: int) -> None:
         """Grow every shard's slot pool (doubling amortizes recompiles —
-        kernels specialize on capacity)."""
+        kernels specialize on capacity). Under the tick fence when the
+        owning engine runs off-loop: growth swaps ``state`` wholesale and
+        re-points the staging sink, so it must never interleave with a
+        worker-side batch that read the old state (the worker would
+        commit a pre-growth tree over the grown one and truncate every
+        row above the old capacity)."""
+        with self.fence:
+            return self._grow(new_capacity)
+
+    def _grow(self, new_capacity: int) -> None:
         new_capacity = max(new_capacity, self.capacity * 2)
         # round to power of two to bound the number of distinct kernel shapes
         new_capacity = 1 << (new_capacity - 1).bit_length()
@@ -370,6 +416,10 @@ class ShardedActorTable:
 
     # -- host access (tests, persistence flush) ---------------------------
     def read_row(self, key_hash: int) -> dict[str, np.ndarray] | None:
+        with self.fence:  # never materialize a donated-in-flight array
+            return self._read_row(key_hash)
+
+    def _read_row(self, key_hash: int) -> dict[str, np.ndarray] | None:
         loc = self.key_to_slot.get(key_hash)
         if loc is None:
             if 0 <= key_hash < self.dense_n:
@@ -382,9 +432,12 @@ class ShardedActorTable:
 
     def snapshot(self) -> dict[str, np.ndarray]:
         """Full host copy of the state arrays (checkpoint path; orbax-style
-        async checkpointing can hook here)."""
-        return {k: np.asarray(v) for k, v in self.state.items()}
+        async checkpointing can hook here). Fenced against off-loop ticks
+        — a donated in-flight state array cannot be materialized."""
+        with self.fence:
+            return {k: np.asarray(v) for k, v in self.state.items()}
 
     def restore(self, snap: dict[str, np.ndarray]) -> None:
-        for k, arr in snap.items():
-            self.state[k] = self._put(jnp.asarray(arr))
+        with self.fence:  # a worker batch mid-flight would commit over it
+            for k, arr in snap.items():
+                self.state[k] = self._put(jnp.asarray(arr))

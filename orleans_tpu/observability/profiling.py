@@ -102,6 +102,10 @@ LOOP_CATEGORIES = (
     "tick_transfer",  # host arrays -> device operands + kernel dispatch
     "tick_sync",      # host materialize: where device execution is paid
     "pump",           # socket pump + wire decode + batched routing
+    "client",         # client-side gateway machinery sharing the loop
+                      # (GatewayClient pumps/senders/reconnector) — split
+                      # out of "other" so harness cost is separately
+                      # attributable from silo cost in loop_attribution
     "storage",        # storage & journal provider IO awaited on-loop
     "observability",  # sampler/tracer/exporter internals
     "other",          # unattributed callbacks

@@ -137,6 +137,17 @@ class InProcFabric:
             raise SiloUnavailableError(f"gateway {gateway} unavailable")
         silo.message_center.deliver(msg)
 
+    def deliver_via_gateway_batch(self, gateway: SiloAddress,
+                                  msgs: list) -> None:
+        """Batched client ingress (``ClusterClient.transmit_batch``): one
+        group → one ``deliver_batch`` routing hop on the gateway silo —
+        the in-proc twin of a gateway socket read decoding a whole wire
+        batch."""
+        silo = self.silos.get(gateway)
+        if silo is None:
+            raise SiloUnavailableError(f"gateway {gateway} unavailable")
+        silo.message_center.deliver_batch(msgs)
+
 
 class ClusterClient(RuntimeClient):
     """External client (OutsideRuntimeClient.cs:22): N gateway connections →
@@ -212,20 +223,47 @@ class ClusterClient(RuntimeClient):
             self.hot_hits += 1
         return coro
 
+    def _pick_gateway(self, msg: Message, gateways: list) -> SiloAddress:
+        """The ONE affinity rule for both transmit paths: route by
+        target-grain hash so one grain's requests keep order through one
+        gateway (ClientMessageCenter affinity routing), round-robin for
+        untargeted traffic."""
+        if msg.target_grain is not None:
+            return gateways[msg.target_grain.uniform_hash % len(gateways)]
+        self._gateway_rr = (self._gateway_rr + 1) % len(gateways)
+        return gateways[self._gateway_rr]
+
     def transmit(self, msg: Message) -> None:
         msg.sending_silo = self._address
         self._mark_remote_trace(msg)  # client sends always leave the client
         gateways = self.fabric.alive_silos()
         if not gateways:
             raise SiloUnavailableError("no gateways available")
-        # affinity: route by target-grain hash so one grain's requests keep
-        # order through one gateway (ClientMessageCenter affinity routing)
-        if msg.target_grain is not None:
-            gw = gateways[msg.target_grain.uniform_hash % len(gateways)]
-        else:
-            self._gateway_rr = (self._gateway_rr + 1) % len(gateways)
-            gw = gateways[self._gateway_rr]
-        self.fabric.deliver_via_gateway(gw, msg)
+        self.fabric.deliver_via_gateway(self._pick_gateway(msg, gateways),
+                                        msg)
+
+    def transmit_batch(self, msgs: list) -> None:
+        """Batched transmit (RuntimeClient.call_batch): the group is
+        split per gateway by the same affinity rule as ``transmit``
+        (shared ``_pick_gateway``) and each gateway's slice rides ONE
+        ``deliver_batch`` hop."""
+        gateways = self.fabric.alive_silos()
+        if not gateways:
+            raise SiloUnavailableError("no gateways available")
+        groups: dict[SiloAddress, list] = {}
+        for msg in msgs:
+            msg.sending_silo = self._address
+            self._mark_remote_trace(msg)
+            groups.setdefault(self._pick_gateway(msg, gateways),
+                              []).append(msg)
+        for gw, batch in groups.items():
+            try:
+                self.fabric.deliver_via_gateway_batch(gw, batch)
+            except Exception as e:  # noqa: BLE001 — one gateway's slice:
+                # earlier slices were already delivered and will execute,
+                # so this must NOT raise (the caller would unregister
+                # their callbacks too) — fail exactly this slice
+                self._fail_transmit(batch, e)
 
     def deliver(self, msg: Message) -> None:
         """Inbound from the fabric (the client message pump,

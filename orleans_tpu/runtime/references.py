@@ -116,6 +116,15 @@ class GrainFactory:
         gid = GrainId.for_grain(grain_type_of(grain_class), key, key_ext)
         return GrainRef(grain_class, gid, self._client)
 
+    def call_batch(self, grain_class: type, method_name: str, calls, *,
+                   timeout: float | None = None) -> list:
+        """Deliberate batched fan-out over one (class, method): N
+        ``(key, kwargs)`` calls built and transmitted as one wire batch
+        (see ``RuntimeClient.call_batch``). Returns awaitables aligned
+        with ``calls`` (None per item for ``@one_way`` methods)."""
+        return self._client.call_batch(grain_class, method_name, calls,
+                                       timeout=timeout)
+
     def get_system_target(self, grain_class: type, grain_id: GrainId) -> GrainRef:
         ref = GrainRef(grain_class, grain_id, self._client)
         return ref

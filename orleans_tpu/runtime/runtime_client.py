@@ -240,6 +240,126 @@ class RuntimeClient:
         """Hand the message to the transport/dispatch layer."""
         raise NotImplementedError
 
+    def transmit_batch(self, msgs: list) -> None:
+        """Hand a pre-built request group to the transport as ONE unit.
+        Default: per-message transmit; clients with a batched fabric
+        hand-off override this so the group rides one wire batch and one
+        receive-side routing hop (``MessageCenter.deliver_batch``).
+
+        Contract for overrides: a failure AFTER any message reached the
+        transport must be isolated to the failed slice via
+        :meth:`_fail_transmit` (never re-raised) — raising then would
+        make the caller unregister callbacks for messages that were
+        already delivered and will execute. Raising is only allowed
+        while provably nothing has been handed off (e.g. no gateways at
+        all)."""
+        for m in msgs:
+            try:
+                self.transmit(m)
+            except Exception as e:  # noqa: BLE001 — scoped to this item
+                self._fail_transmit([m], e)
+
+    def _fail_transmit(self, msgs: list, exc: Exception) -> None:
+        """Per-item transport-failure isolation for batched sends: fail
+        (and unregister) exactly the messages that did NOT reach the
+        transport, so already-delivered members of the same call_batch
+        group complete normally. One-way messages carry no callback —
+        dropped with a log, the per-message one-way contract."""
+        for m in msgs:
+            cb = self.callbacks.pop(m.id, None)
+            if cb is not None:
+                _resolve_future(cb.future, None, exc)
+                # terminal before any response can correlate: the shell
+                # returns to the freelist; the request message does NOT
+                # (nothing proves no transport frame still holds it)
+                _recycle_callback(cb)
+            else:
+                log.warning("batched one-way %s.%s dropped: %s",
+                            m.interface_name, m.method_name, exc)
+
+    # -- deliberate client-side batching ---------------------------------
+    def call_batch(self, grain_class: type, method_name: str,
+                   calls, *, timeout: float | None = None) -> list:
+        """Send N ``(key, kwargs)`` invocations of ONE (class, method) as
+        a deliberately-filled batch: the messages are built in one pass
+        (one clock read, one call-chain/context export) and handed to the
+        transport as a unit, so they ride one wire batch
+        (``encode_message_batch``) and land receive-side as one routing
+        hop — device-tier calls coalesce straight into a grouped
+        ``VectorRuntime.call_group`` enqueue instead of relying on the
+        sender's greedy drain to happen to group them.
+
+        Returns a list of awaitables index-aligned with ``calls`` (None
+        per item when the method is ``@one_way``). Per-item errors
+        resolve that item's awaitable only.
+
+        Scope: plain data-parallel payloads. When outgoing filters, a
+        tracer, or ambient transaction baggage are active the batch falls
+        back to N ordinary ``send_request`` calls — identical semantics,
+        no batched hand-off — so interception and trace/txn propagation
+        are never bypassed. Cancellation-token arguments are not
+        registered on the batched path."""
+        from .grain import grain_type_of, remote_methods
+        fn = remote_methods(grain_class).get(method_name)
+        if fn is None:
+            raise AttributeError(
+                f"{grain_class.__name__} has no remote method "
+                f"{method_name!r}")
+        read_only = getattr(fn, "__orleans_read_only__", False)
+        one_way = getattr(fn, "__orleans_one_way__", False)
+        interleave = getattr(fn, "__orleans_always_interleave__", False)
+        gtype = grain_type_of(grain_class)
+        iface = grain_class.__name__
+        if (self.outgoing_call_filters or self.tracer is not None
+                or RequestContext.get(TXN_KEY) is not None):
+            return [self.send_request(
+                target_grain=GrainId.for_grain(gtype, key),
+                grain_class=grain_class, interface_name=iface,
+                method_name=method_name, args=(), kwargs=kwargs,
+                is_read_only=read_only, is_always_interleave=interleave,
+                is_one_way=one_way, timeout=timeout)
+                for key, kwargs in calls]
+        timeout = self.response_timeout if timeout is None else timeout
+        deadline = (time.monotonic() + timeout) if timeout else None
+        sender = current_activation.get()
+        chain = build_call_chain(sender)
+        req_ctx = RequestContext.export()
+        version = getattr(grain_class, "__orleans_version__", 0)
+        send_silo = self.silo_address
+        s_grain = sender.grain_id if sender else None
+        s_act = sender.activation_id if sender else None
+        direction = Direction.ONE_WAY if one_way else Direction.REQUEST
+        loop = None if one_way else asyncio.get_running_loop()
+        msgs: list[Message] = []
+        out: list = []
+        for key, kwargs in calls:
+            msg = make_request_fast(
+                Category.APPLICATION, direction, send_silo,
+                s_grain, s_act, None, GrainId.for_grain(gtype, key),
+                iface, method_name, copy_call_body((), kwargs),
+                deadline, chain, read_only, interleave, req_ctx, version)
+            msgs.append(msg)
+            if one_way:
+                out.append(None)
+            else:
+                fut = loop.create_future()
+                self.callbacks[msg.id] = _fresh_callback(
+                    msg, fut, deadline, None)
+                out.append(fut)
+        if not one_way:
+            self._ensure_sweeper()
+        try:
+            self.transmit_batch(msgs)
+        except BaseException:
+            # transmit_batch's contract: it only raises while provably
+            # NOTHING was handed off (partial failures are isolated
+            # per-slice via _fail_transmit and not re-raised), so
+            # unregistering every callback here is safe
+            for m in msgs:
+                self.callbacks.pop(m.id, None)
+            raise
+        return out
+
     # -- request path (SendRequest) --------------------------------------
     def send_request(self, *, target_grain: GrainId, grain_class: type,
                      interface_name: str, method_name: str,

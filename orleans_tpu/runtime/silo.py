@@ -118,6 +118,14 @@ class SiloConfig:
     # per-frame decode + per-message hand-off (the A/B lever; bytes on
     # the wire are identical either way)
     batched_ingress: bool = True
+    # off-loop device-tick pipeline (dispatch.engine): the staging fill,
+    # operand upload, kernel dispatch, and host materialize sync of every
+    # vector tick run on a dedicated worker thread behind a tick-
+    # serialization fence, so host turns and the socket pump interleave
+    # with device hand-off instead of queueing behind it. Off = today's
+    # loop-inline tick (the A/B lever; results and turn semantics are
+    # identical either way)
+    offloop_tick: bool = True
     collection_age: float = 2 * 3600.0
     collection_quantum: float = 60.0
     max_enqueued_requests: int = 5000
@@ -532,6 +540,41 @@ class InsideRuntimeClient(RuntimeClient):
     def transmit(self, msg: Message) -> None:
         self.silo.dispatcher.send_message(msg)
 
+    def transmit_batch(self, msgs: list) -> None:
+        """Batched in-silo hand-off (RuntimeClient.call_batch):
+        vector-interface calls peel into per-class groups and ride ONE
+        ``Dispatcher.receive_vector_batch`` → grouped ``call_group``
+        enqueue, exactly like batched socket ingress; everything else
+        takes the ordinary per-message ``send_message`` route. This
+        deliberately does NOT go through MessageCenter.deliver_batch:
+        that is the GATEWAY ingress surface — in-silo application calls
+        must never be load-shed as client ingress (the per-message
+        ``transmit`` → dispatcher path sheds nothing), and must not be
+        dropped by a message center that has not started."""
+        silo = self.silo
+        vifaces = silo.vector_interfaces
+        vgroups: dict[type, list] = {}
+        for m in msgs:
+            vcls = (vifaces.get(m.interface_name)
+                    if vifaces and m.direction != Direction.RESPONSE
+                    else None)
+            if vcls is not None:
+                # the ring-owner check inside receive_vector_batch IS
+                # the addressing authority for vector keys (same
+                # rationale as MessageCenter._route_batch)
+                vgroups.setdefault(vcls, []).append(m)
+            else:
+                try:
+                    silo.dispatcher.send_message(m)
+                except Exception as e:  # noqa: BLE001 — earlier group
+                    # members already dispatched: isolate, never raise
+                    self._fail_transmit([m], e)
+        for vcls, group in vgroups.items():
+            try:
+                silo.dispatcher.receive_vector_batch(vcls, group)
+            except Exception as e:  # noqa: BLE001 — same isolation
+                self._fail_transmit(group, e)
+
     def try_hot_invoke(self, grain_id, grain_class: type,
                        interface_name: str, method_name: str,
                        args: tuple, kwargs: dict,
@@ -862,6 +905,12 @@ class Silo:
         if self.metrics_server is not None:
             await self.metrics_server.aclose()
             self.metrics_server = None
+        if self.vector is not None:
+            # off-loop tick worker: queued batches finish FIFO, then the
+            # thread exits (their loop-side completion callbacks run as
+            # control returns to the loop below). Before the client
+            # close so resolved ticks still reach their callers.
+            self.vector.shutdown_worker()
         if self.loop_prof is not None:
             from ..observability.profiling import uninstall_loop_profiler
             if self._flight_hook is not None:

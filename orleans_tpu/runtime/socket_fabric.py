@@ -628,6 +628,12 @@ class _GatewayConnection:
         """Client message pump (OutsideRuntimeClient.RunClientMessagePump:235).
         Batched like the silo side: one ``decode_frames`` pass per socket
         read (header-undecodable frames are dropped with a log inside)."""
+        # loop-occupancy attribution: this task's steps — response decode
+        # + receive_response correlation — are CLIENT gateway machinery,
+        # a first-class category so co-hosted harness cost never hides in
+        # "other" (one contextvar set; free without a profiler installed)
+        from ..observability.profiling import mark_loop_category
+        mark_loop_category("client")
         try:
             async for msgs, bounces in _read_frame_batches(
                     reader, strict_tail=False):
@@ -660,6 +666,8 @@ class _GatewayConnection:
                     f"{m.interface_name}.{m.method_name}: {e}")))
 
     async def _send_loop(self) -> None:
+        from ..observability.profiling import mark_loop_category
+        mark_loop_category("client")  # see _pump: client-side machinery
         while True:
             msg = await self.queue.get()
             batch = _drain_batch(self.queue, msg)
@@ -722,18 +730,39 @@ class GatewayClient(RuntimeClient):
     def _live(self) -> list[_GatewayConnection]:
         return [c for c in self.conns if c.live]
 
+    def _pick_conn(self, msg: Message, live: list) -> _GatewayConnection:
+        """The ONE affinity rule for both transmit paths: per-grain hash
+        keeps one grain's requests ordered through one connection,
+        round-robin for untargeted traffic."""
+        if msg.target_grain is not None:
+            return live[msg.target_grain.uniform_hash % len(live)]
+        self._rr = (self._rr + 1) % len(live)
+        return live[self._rr]
+
     def transmit(self, msg: Message) -> None:
         self._mark_remote_trace(msg)  # client sends always leave the client
         live = self._live()
         if not live:
             raise SiloUnavailableError("no live gateway connections")
-        if msg.target_grain is not None:
-            conn = live[msg.target_grain.uniform_hash % len(live)]
-        else:
-            self._rr = (self._rr + 1) % len(live)
-            conn = live[self._rr]
+        conn = self._pick_conn(msg, live)
         msg.sending_silo = conn.pseudo_address
         conn.queue.put_nowait(msg)
+
+    def transmit_batch(self, msgs: list) -> None:
+        """Batched transmit (RuntimeClient.call_batch): the group is
+        split per live connection by the same affinity rule as
+        ``transmit`` (shared ``_pick_conn``) and each slice is queued in
+        one synchronous pass — the sender task wakes once and the whole
+        slice rides a single ``encode_message_batch`` write (deliberate
+        wire-batch fill, not greedy-drain luck)."""
+        live = self._live()
+        if not live:
+            raise SiloUnavailableError("no live gateway connections")
+        for msg in msgs:
+            self._mark_remote_trace(msg)
+            conn = self._pick_conn(msg, live)
+            msg.sending_silo = conn.pseudo_address
+            conn.queue.put_nowait(msg)
 
     def deliver(self, msg: Message) -> None:
         if msg.direction == Direction.RESPONSE:
@@ -770,6 +799,8 @@ class GatewayClient(RuntimeClient):
     async def _reconnect_loop(self) -> None:
         """Revive dropped gateway connections (GatewayManager keeps retrying
         dead gateways and returns them to rotation when reachable)."""
+        from ..observability.profiling import mark_loop_category
+        mark_loop_category("client")  # see _pump: client-side machinery
         while True:
             await asyncio.sleep(self._reconnect_period)
             for c in self.conns:

@@ -109,12 +109,15 @@ class VectorCheckpointer:
 
     def capture(self) -> tuple[dict, dict]:
         """Donation-safe snapshot (synchronous D2H copy + bookkeeping).
-        Must run on the tick thread/loop so no kernel donates the buffers
-        mid-copy; the returned tree is plain numpy — write it from any
-        thread."""
-        state = self._state_tree()
-        meta = {cls.__name__: _table_meta(tbl)
-                for cls, tbl in self.runtime.tables.items()}
+        Taken under the engine's tick fence: with the off-loop tick
+        worker, "runs on the loop" is no longer enough — a worker-side
+        batch may have the state donated mid-dispatch, so the copy
+        serializes against it. The returned tree is plain numpy — write
+        it from any thread."""
+        with self.runtime.tick_fence():
+            state = self._state_tree()
+            meta = {cls.__name__: _table_meta(tbl)
+                    for cls, tbl in self.runtime.tables.items()}
         return state, meta
 
     def write(self, step: int, captured: tuple[dict, dict]) -> None:
@@ -235,11 +238,14 @@ class VectorStorageBridge:
         if not keys:
             return 0
         tbl = self.runtime.table(self.grain_class)
-        kept, shards, slots = self._locate(keys, drop_missing=True)
-        if not kept:
-            return 0
-        host = {f: np.asarray(a[shards, slots])
-                for f, a in tbl.state.items()}
+        # under the tick fence: the gather materializes state rows, which
+        # must not race an off-loop tick that has the state donated
+        with self.runtime.tick_fence():
+            kept, shards, slots = self._locate(keys, drop_missing=True)
+            if not kept:
+                return 0
+            host = {f: np.asarray(a[shards, slots])
+                    for f, a in tbl.state.items()}
 
         async def write_one(i: int, key: int) -> None:
             state = {f: host[f][i] for f in host}
@@ -335,8 +341,13 @@ class VectorStorageBridge:
             if dense:
                 tbl.dense_active[np.asarray(dense, int)] = True
         _, shards, slots = self._locate(fkeys)
-        for f, arr in tbl.state.items():
-            vals = np.stack([np.asarray(s[f]) for _, s, _ in found])
-            tbl.state[f] = tbl._put(arr.at[shards, slots].set(
-                jax.numpy.asarray(vals)))
+        # under the tick fence: the per-field scatter reads and replaces
+        # state arrays, which must not interleave with an off-loop tick
+        # (the tick would commit a tree that predates — and erases — the
+        # rehydrated rows)
+        with self.runtime.tick_fence():
+            for f, arr in tbl.state.items():
+                vals = np.stack([np.asarray(s[f]) for _, s, _ in found])
+                tbl.state[f] = tbl._put(arr.at[shards, slots].set(
+                    jax.numpy.asarray(vals)))
         return fkeys

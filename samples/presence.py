@@ -92,8 +92,13 @@ async def main(n_players: int = 100, n_games: int = 8,
 
     rng = random.Random(0)
     for r in range(rounds):
-        await asyncio.gather(*(
-            p.heartbeat((rng.random(), rng.random()), r) for p in players))
+        # deliberate batched heartbeat round (call_batch): one pass builds
+        # the whole round's messages and they ride one deliver_batch hop
+        # per gateway instead of n_players send_request trips
+        await asyncio.gather(*client.call_batch(
+            PlayerGrain, "heartbeat",
+            [(k, {"position": (rng.random(), rng.random()), "score": r})
+             for k in range(n_players)]))
     status = await client.get_grain(GameGrain, 0).game_status()
     print(f"game 0: {len(status)} players reporting, "
           f"sample: {sorted(status)[:5]}")

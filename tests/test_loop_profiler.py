@@ -154,12 +154,16 @@ def test_install_refcount_and_uninstall():
 async def test_occupancy_under_concurrent_turns_and_ticks():
     """Concurrent host turns + device ticks attribute into their own
     categories, shares sum to ~1.0 of loop wall (incl. idle), and the
-    tick segments include the distinct device-sync bucket."""
+    tick segments include the distinct device-sync bucket. Pinned to the
+    INLINE tick path (offloop_tick=False): the off-loop worker removes
+    exactly these loop slices — test_offloop_removes_tick_slices asserts
+    that side."""
     from orleans_tpu.dispatch import add_vector_grains
     from orleans_tpu.parallel import make_mesh
 
     EchoVec = _make_vector_grain()
     b = (SiloBuilder().with_name("prof-silo").add_grains(EchoGrain)
+         .with_config(offloop_tick=False)
          .with_options(ProfilingOptions(enabled=True, window=0.05)))
     add_vector_grains(b, EchoVec, mesh=make_mesh(1), dense={EchoVec: 32})
     silo = b.build()

@@ -1,0 +1,377 @@
+"""Off-loop device-tick pipeline: the tick worker, the tick-serialization
+fence for donated state/staging, and the deliberate client-side
+``call_batch`` path.
+
+The hard invariants under test (ISSUE 9 tentpole):
+
+* worker-side ticks produce results identical to the inline path, with
+  turn semantics (one message per activation per tick) preserved under
+  concurrent enqueue-during-tick;
+* ``grow()`` (loop-side, triggered by hashed allocation) can never
+  interleave with a worker-side batch whose donated state/staging upload
+  is in flight — the table fence serializes them;
+* the migration fence sees worker-in-flight keys
+  (``pending_key_hashes``), so a rebalance shard move can never race an
+  executing batch;
+* ``flush()`` drains worker-side in-flight batches (and stays the
+  historical tick-and-yield spin on the inline path);
+* the batched client path honors ``ORLEANS_TPU_DEBUG_POOL=1`` pool
+  discipline end to end.
+"""
+
+import asyncio
+
+import jax.numpy as jnp
+import numpy as np
+
+from orleans_tpu.core.message import set_debug_pool
+from orleans_tpu.dispatch import (VectorGrain, VectorRuntime,
+                                  actor_method, add_vector_grains)
+from orleans_tpu.parallel import make_mesh
+from orleans_tpu.runtime import ClusterClient, Grain, SiloBuilder
+
+
+class CounterVec(VectorGrain):
+    STATE = {"total": (jnp.float32, ()), "ticks": (jnp.int32, ())}
+
+    @staticmethod
+    def initial_state(key_hash):
+        return {"total": jnp.float32(0.0), "ticks": jnp.int32(0)}
+
+    @actor_method(args={"x": (jnp.float32, ())})
+    def add(state, args):
+        return ({"total": state["total"] + args["x"],
+                 "ticks": state["ticks"] + 1}, state["total"] + args["x"])
+
+    @actor_method(read_only=True)
+    def read(state, args):
+        return state, state["total"]
+
+
+class EchoGrain(Grain):
+    async def ping(self, x: int) -> int:
+        return x
+
+
+def _build(offloop: bool, *, dense: int | None = 64,
+           capacity: int = 64, n_shards: int = 1):
+    b = (SiloBuilder().with_name(f"ot-{offloop}")
+         .add_grains(EchoGrain)
+         .with_config(offloop_tick=offloop))
+    add_vector_grains(b, CounterVec, mesh=make_mesh(n_shards),
+                      capacity_per_shard=capacity,
+                      dense={CounterVec: dense} if dense else None)
+    return b.build()
+
+
+async def test_offloop_results_match_inline():
+    """Same traffic through both levers → identical per-key state."""
+    totals = {}
+    for offloop in (False, True):
+        silo = _build(offloop)
+        await silo.start()
+        client = await ClusterClient(silo.fabric).connect()
+        try:
+            refs = [client.get_grain(CounterVec, k) for k in range(16)]
+            for rnd in range(5):
+                await asyncio.gather(*(r.add(x=float(rnd + k))
+                                       for k, r in enumerate(refs)))
+            out = await asyncio.gather(*(r.read() for r in refs))
+            totals[offloop] = [float(v) for v in out]
+            if offloop:
+                # the worker actually engaged (lazily started on traffic)
+                assert silo.vector._worker is not None
+            else:
+                assert silo.vector._worker is None
+        finally:
+            await client.close_async()
+            await silo.stop()
+    assert totals[True] == totals[False]
+
+
+async def test_concurrent_enqueue_during_tick_preserves_turns():
+    """Calls racing in WHILE worker ticks are in flight: every call lands
+    in some tick, one-per-activation-per-tick, and per-key sums come out
+    exact (the donation/rotation discipline never loses or doubles a
+    write)."""
+    silo = _build(True)
+    await silo.start()
+    client = await ClusterClient(silo.fabric).connect()
+    try:
+        n_keys, rounds = 8, 40
+        refs = [client.get_grain(CounterVec, k) for k in range(n_keys)]
+
+        async def hammer(k: int):
+            # no awaits between sends inside a round: same-key calls
+            # pile into the same pending batch and conflict-defer
+            for _ in range(rounds):
+                await refs[k].add(x=1.0)
+
+        await asyncio.gather(*(hammer(k) for k in range(n_keys)))
+        out = await asyncio.gather(*(r.read() for r in refs))
+        assert [float(v) for v in out] == [float(rounds)] * n_keys
+        rt = silo.vector
+        assert rt.messages_processed >= n_keys * rounds
+        assert not rt.pending and rt._inflight == 0
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_grow_racing_worker_upload():
+    """Hashed-regime allocation grows the table (state swap + staging
+    sink re-point) while worker batches are continuously in flight: the
+    table fence serializes the swap against donated uploads, and no
+    write is lost across the growth."""
+    silo = _build(True, dense=None, capacity=8)
+    await silo.start()
+    client = await ClusterClient(silo.fabric).connect()
+    try:
+        tbl = silo.vector.table(CounterVec)
+        cap0 = tbl.capacity
+        # wave after wave of NEW keys (never awaited between sends within
+        # a wave) so lookup_or_allocate exhausts the free lists and
+        # grows mid-traffic, repeatedly
+        key = 1 << 40  # far outside any dense range
+        keys = []
+        for wave in range(6):
+            wave_keys = [key + wave * 64 + i for i in range(48)]
+            keys.extend(wave_keys)
+            await asyncio.gather(*(
+                client.get_grain(CounterVec, k).add(x=1.0)
+                for k in wave_keys))
+        assert tbl.capacity > cap0, "growth never triggered"
+        out = await asyncio.gather(*(
+            client.get_grain(CounterVec, k).read() for k in keys))
+        assert all(float(v) == 1.0 for v in out)
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_migration_fence_sees_inflight_keys():
+    """A batch handed to the worker (but not yet completed) keeps its
+    keys in ``pending_key_hashes`` — the set the rebalance executor
+    fences shard moves on — until the loop-side completion runs. Made
+    deterministic by holding the tick fence from the test: the worker
+    blocks on it, so the batch is provably in flight."""
+    silo = _build(True)
+    await silo.start()
+    client = await ClusterClient(silo.fabric).connect()
+    try:
+        rt = silo.vector
+        # prime: compile the kernel and start the worker
+        await client.get_grain(CounterVec, 0).add(x=1.0)
+        fence = rt.tick_fence()
+        fence.acquire()
+        try:
+            futs = [client.get_grain(CounterVec, k).add(x=2.0)
+                    for k in (3, 4)]
+            # let the loop run the tick hand-off; the worker then blocks
+            # on the fence we hold
+            for _ in range(20):
+                await asyncio.sleep(0)
+                if rt._inflight:
+                    break
+            assert rt._inflight >= 1
+            fenced = rt.pending_key_hashes(CounterVec)
+            assert {3, 4} <= fenced
+        finally:
+            fence.release()
+        await asyncio.gather(*futs)
+        # completed: the in-flight fence released the keys
+        assert not (rt.pending_key_hashes(CounterVec) & {3, 4})
+        assert rt._inflight == 0
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_flush_drains_worker_inflight():
+    """``flush()`` returns only after pending AND worker-in-flight work
+    retired, on both levers (one-way calls leave no futures to await, so
+    flush is the only drain)."""
+    for offloop in (False, True):
+        silo = _build(offloop)
+        await silo.start()
+        try:
+            rt = silo.vector
+            for k in range(12):
+                rt.call(CounterVec, k, "add", x=float(k))
+            await rt.flush()
+            assert not rt.pending and rt._inflight == 0
+            assert rt.messages_processed >= 12
+        finally:
+            await silo.stop()
+
+
+async def test_standalone_runtime_stays_inline():
+    """A bare VectorRuntime (no silo, no DispatchOptions opt-in) keeps
+    today's synchronous loop-inline tick: no worker thread appears."""
+    rt = VectorRuntime(mesh=make_mesh(1), capacity_per_shard=16)
+    assert rt.offloop_tick is False
+    fut = rt.call(CounterVec, 5, "add", x=3.0)
+    await rt.flush()
+    assert float(await fut) == 3.0
+    assert rt._worker is None
+
+
+async def test_dispatch_options_offloop_lever():
+    from orleans_tpu.config import DispatchOptions
+    rt = VectorRuntime(mesh=make_mesh(1),
+                       options=DispatchOptions(capacity_per_shard=16,
+                                               offloop_tick=True))
+    assert rt.offloop_tick is True
+    fut = rt.call(CounterVec, 5, "add", x=3.0)
+    await rt.flush()
+    assert float(await fut) == 3.0
+    assert rt._worker is not None
+    rt.shutdown_worker()
+
+
+async def test_call_batch_debug_pool_discipline():
+    """ORLEANS_TPU_DEBUG_POOL=1 over the batched client path: envelope
+    recycling stays disciplined through call_batch → deliver_batch →
+    call_group → off-loop tick → response correlation."""
+    prev = set_debug_pool(True)
+    try:
+        silo = _build(True)
+        await silo.start()
+        client = await ClusterClient(silo.fabric).connect()
+        try:
+            for rnd in range(3):
+                futs = client.call_batch(
+                    CounterVec, "add",
+                    [(k, {"x": float(rnd + 1)}) for k in range(8)])
+                await asyncio.gather(*futs)
+            futs = client.call_batch(EchoGrain, "ping",
+                                     [(k, {"x": k}) for k in range(8)])
+            assert await asyncio.gather(*futs) == list(range(8))
+        finally:
+            await client.close_async()
+            await silo.stop()
+    finally:
+        set_debug_pool(prev)
+
+
+async def test_call_batch_per_item_error_isolation():
+    """A schema-violating item resolves ITS awaitable with the error;
+    the rest of the batch proceeds."""
+    silo = _build(True)
+    await silo.start()
+    client = await ClusterClient(silo.fabric).connect()
+    try:
+        futs = client.call_batch(
+            CounterVec, "add",
+            [(0, {"x": 1.0}), (1, {"bogus": 1.0}), (2, {"x": 2.0})])
+        r0, r1, r2 = await asyncio.gather(*futs, return_exceptions=True)
+        assert float(r0) == 1.0
+        assert isinstance(r1, Exception)
+        assert float(r2) == 2.0
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_offloop_removes_tick_slices():
+    """With profiling on, the off-loop path leaves only ``tick_schedule``
+    on the loop: staging/transfer/sync run on the worker and never
+    appear as loop occupancy (the counterpart of
+    test_occupancy_under_concurrent_turns_and_ticks)."""
+    from orleans_tpu.config import ProfilingOptions
+
+    b = (SiloBuilder().with_name("ot-prof").add_grains(EchoGrain)
+         .with_config(offloop_tick=True)
+         .with_options(ProfilingOptions(enabled=True, window=0.05)))
+    add_vector_grains(b, CounterVec, mesh=make_mesh(1),
+                      dense={CounterVec: 32})
+    silo = b.build()
+    await silo.start()
+    client = await ClusterClient(silo.fabric).connect()
+    try:
+        refs = [client.get_grain(CounterVec, k) for k in range(16)]
+        for rnd in range(10):
+            await asyncio.gather(*(r.add(x=1.0) for r in refs))
+        prof = silo.loop_prof.profile()
+        sec = prof["seconds"]
+        assert sec.get("tick_schedule", 0.0) > 0.0
+        for cat in ("tick_staging", "tick_transfer", "tick_sync"):
+            assert sec.get(cat, 0.0) == 0.0, (cat, sec)
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_checkpoint_capture_fenced_under_traffic():
+    """Donation-safe capture while worker ticks are continuously in
+    flight: the fence means the D2H copy never materializes a donated
+    array (a race here raises 'Array has been deleted')."""
+    silo = _build(True)
+    await silo.start()
+    client = await ClusterClient(silo.fabric).connect()
+    try:
+        rt = silo.vector
+        refs = [client.get_grain(CounterVec, k) for k in range(32)]
+        stop = asyncio.Event()
+
+        async def traffic():
+            i = 0
+            while not stop.is_set():
+                await asyncio.gather(*(r.add(x=1.0) for r in refs))
+                i += 1
+
+        t = asyncio.ensure_future(traffic())
+        tbl = rt.table(CounterVec)
+        for _ in range(25):
+            snap = tbl.snapshot()  # fenced D2H of the whole table
+            assert set(snap) == {"total", "ticks"}
+            await asyncio.sleep(0)
+        stop.set()
+        await t
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_call_batch_partial_gateway_failure_isolated():
+    """transmit_batch contract: a gateway slice that fails transport
+    fails ONLY its own items' awaitables; slices already delivered to
+    healthy gateways complete normally (no unregistered-callback drops,
+    no hangs)."""
+    from orleans_tpu.core.errors import SiloUnavailableError
+    from orleans_tpu.runtime.cluster import InProcFabric
+
+    fabric = InProcFabric()
+    silos = []
+    for i in range(2):
+        s = (SiloBuilder().with_name(f"gw{i}").with_fabric(fabric)
+             .add_grains(EchoGrain).build())
+        await s.start()
+        silos.append(s)
+    client = await ClusterClient(fabric).connect()
+    client.hot_lane_enabled = False  # force the transmit_batch path
+    try:
+        down = silos[1].silo_address
+        orig = fabric.deliver_via_gateway_batch
+
+        def flaky(gw, msgs, _orig=orig, _down=down):
+            if gw == _down:
+                raise SiloUnavailableError("gateway down mid-batch")
+            _orig(gw, msgs)
+
+        fabric.deliver_via_gateway_batch = flaky
+        futs = client.call_batch(EchoGrain, "ping",
+                                 [(k, {"x": k}) for k in range(16)])
+        results = await asyncio.wait_for(
+            asyncio.gather(*futs, return_exceptions=True), 10.0)
+        ok = [r for r in results if isinstance(r, int)]
+        bad = [r for r in results if isinstance(r, SiloUnavailableError)]
+        assert len(ok) + len(bad) == 16
+        assert ok, "healthy gateway's slice should have completed"
+        assert bad, "failed gateway's slice should carry the error"
+        assert not client.callbacks, "no orphaned callbacks"
+    finally:
+        fabric.deliver_via_gateway_batch = orig
+        await client.close_async()
+        for s in silos:
+            await s.stop()

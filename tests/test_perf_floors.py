@@ -242,3 +242,77 @@ async def test_floor_batched_ingest():
         f"batched ingest hand-off only {ratio:.2f}x over per-frame " \
         f"(floor {BATCHED_INGEST_MARGIN}x) — the batched pipeline is " \
         f"not engaging"
+
+
+# Off-loop device-tick pipeline (ISSUE 9): A/B ratios on identical mixed
+# TCP traffic, never absolute rates (shared-core noise). The loop-side
+# tick share collapsing is the structural signal — inline books the
+# whole staging/transfer/sync slice on the loop (~0.11-0.21 at c=32),
+# off-loop leaves only the claim/hand-off/completion sliver (~0.011-
+# 0.014 measured, with completion honestly booked to tick_schedule) —
+# so the 0.5x ratio ceiling and the 0.05 absolute ceiling both trip
+# only when the worker stops engaging. End-to-end throughput on this
+# single-shared-core container is noise-dominated (0.91-1.23x across
+# runs: the freed loop time partly shows as idle because the c=32
+# closed-loop harness is client-limited; on real TPU the reclaimed
+# ~1.8ms sync tail is far larger), so its floor is only a
+# catastrophic-regression guard — a worker-serialization bug that
+# REMOVES the overlap lands far below 0.8x.
+OFFLOOP_SPEEDUP_FLOOR = 0.8
+OFFLOOP_TICK_SHARE_CEIL = 0.05
+OFFLOOP_TICK_SHARE_RATIO = 0.5
+
+
+async def test_floor_offloop_tick():
+    from benchmarks import loop_attribution
+
+    async def once():
+        inline = await loop_attribution.run(seconds=1.5, offloop=False)
+        off = await loop_attribution.run(seconds=1.5, offloop=True)
+        speed = (off["extra"]["calls_per_sec"]
+                 / max(inline["extra"]["calls_per_sec"], 1e-9))
+        return (speed, inline["extra"]["device_tick_share"],
+                off["extra"]["device_tick_share"])
+
+    speed, t_in, t_off = await once()
+    if (speed < OFFLOOP_SPEEDUP_FLOOR * 1.25
+            or t_off > t_in * OFFLOOP_TICK_SHARE_RATIO * 0.8
+            or t_off > OFFLOOP_TICK_SHARE_CEIL * 0.8):
+        s2, t_in2, t_off2 = await once()  # noise guard: best of two
+        speed = max(speed, s2)
+        t_in = max(t_in, t_in2)
+        t_off = min(t_off, t_off2)
+    assert t_off <= OFFLOOP_TICK_SHARE_CEIL, \
+        f"off-loop tick still occupies {t_off:.3f} of the loop " \
+        f"(ceiling {OFFLOOP_TICK_SHARE_CEIL}) — the worker is not engaging"
+    assert t_off <= t_in * OFFLOOP_TICK_SHARE_RATIO, \
+        f"off-loop tick share {t_off:.3f} vs inline {t_in:.3f}: " \
+        f"ratio above {OFFLOOP_TICK_SHARE_RATIO}"
+    assert speed >= OFFLOOP_SPEEDUP_FLOOR, \
+        f"off-loop tick only {speed:.2f}x the inline path " \
+        f"(floor {OFFLOOP_SPEEDUP_FLOOR}x)"
+
+
+# Deliberate client-side call batching vs per-message senders, vector-
+# only traffic (isolated from the mixed bench's host/vec mix shift):
+# measured 1.5-1.8x on this container — the per-call client machinery
+# collapses to one pass per group and wire batches fill deliberately.
+# 1.2x trips only when call_batch stops batching (e.g. silently falling
+# back to per-message send_request).
+CALL_BATCH_MARGIN = 1.2
+
+
+async def test_floor_call_batch():
+    from benchmarks import ingest_attribution
+
+    async def once():
+        r = await ingest_attribution.run_call_batch_ab(seconds=1.0)
+        return r["value"]
+
+    ratio = await once()
+    if ratio < CALL_BATCH_MARGIN * 1.25:
+        ratio = max(ratio, await once())
+    assert ratio >= CALL_BATCH_MARGIN, \
+        f"call_batch only {ratio:.2f}x over per-message senders " \
+        f"(floor {CALL_BATCH_MARGIN}x) — deliberate batching is not " \
+        f"engaging"

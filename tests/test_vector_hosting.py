@@ -123,7 +123,13 @@ async def test_write_behind_persistence_and_resume():
     try:
         for k in (3, 4):
             await client.get_grain(CounterVec, k).add(x=float(k))
-        await asyncio.sleep(0.2)  # at least one flush period
+        # poll instead of one fixed flush period: the first flush pays a
+        # one-time gather compile, and with the off-loop tick worker the
+        # adds resolve sooner so that compile no longer overlaps them
+        deadline = asyncio.get_running_loop().time() + 5.0
+        while silo.stats.get("vector.storage.flushed") < 2 and \
+                asyncio.get_running_loop().time() < deadline:
+            await asyncio.sleep(0.05)
         assert silo.stats.get("vector.storage.flushed") >= 2
     finally:
         await client.close_async()
