@@ -32,7 +32,8 @@ if __package__ in (None, ""):
     import sys
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-from orleans_tpu.observability.stats import INGEST_STAGES, INGEST_STATS
+from orleans_tpu.observability.stats import (EGRESS_STAGES, EGRESS_STATS,
+                                             INGEST_STAGES, INGEST_STATS)
 from orleans_tpu.runtime import Grain, SiloBuilder
 from orleans_tpu.runtime.socket_fabric import GatewayClient, SocketFabric
 
@@ -94,11 +95,13 @@ async def run(seconds: float = 2.0, concurrency: int = 32,
               n_grains: int = 64, n_keys: int = 64,
               batched: bool = True, offloop: bool = True,
               call_batch: bool = False,
-              call_batch_size: int = 16) -> dict:
+              call_batch_size: int = 16,
+              egress: bool = True) -> dict:
     """One silo over real TCP, metrics on, mixed host + device traffic;
     returns the stage breakdown in the BENCH extra. ``batched=False``
     flips the silo to the per-frame ingest path, ``offloop=False`` to
-    the loop-inline device tick (the two A/B levers).
+    the loop-inline device tick, ``egress=False`` to the per-message
+    response path (the three A/B levers).
     ``call_batch=True`` switches the vector workers from per-message
     awaited pings to deliberate ``client.call_batch`` groups of
     ``call_batch_size`` — the sender-side half of the pump share."""
@@ -112,12 +115,14 @@ async def run(seconds: float = 2.0, concurrency: int = 32,
     b = (SiloBuilder().with_name("ingest-silo").with_fabric(fabric)
          .add_grains(EchoGrain)
          .with_config(metrics_enabled=True, metrics_sample_period=0.25,
-                      batched_ingress=batched, offloop_tick=offloop))
+                      batched_ingress=batched, offloop_tick=offloop,
+                      batched_egress=egress))
     add_vector_grains(b, EchoVec, mesh=make_mesh(1),
                       dense={EchoVec: n_keys})
     silo = b.build()
     await silo.start()
     client = await GatewayClient([silo.silo_address.endpoint]).connect()
+    client.batched_egress = egress  # client-correlation half of the lever
     try:
         host_refs = [client.get_grain(EchoGrain, k) for k in range(n_grains)]
         vec_refs = [client.get_grain(EchoVec, k) for k in range(n_keys)]
@@ -175,6 +180,17 @@ async def run(seconds: float = 2.0, concurrency: int = 32,
                   for k, v in stage_seconds.items()}
         frames = snap["counters"].get(INGEST_STATS["frames"], 0)
         batch_h = hists.get(INGEST_STATS["frame_batch"], {})
+        # response-path decomposition (EGRESS_STATS, the egress twin):
+        # summed stage seconds + the share of total instrumented wall the
+        # response leg takes — the number the batched-egress work lands
+        # against, like queue_wait was for ingress
+        egress_seconds = {}
+        for stage in EGRESS_STAGES:
+            h = hists.get(EGRESS_STATS[stage], {})
+            egress_seconds[stage] = float(h.get("sum", 0.0))
+        egress_total = sum(egress_seconds.values())
+        group_h = hists.get(EGRESS_STATS["group"], {})
+        responses = snap["counters"].get(EGRESS_STATS["responses"], 0)
     finally:
         await client.close_async()
         await silo.stop()
@@ -186,7 +202,7 @@ async def run(seconds: float = 2.0, concurrency: int = 32,
         "extra": {
             "seconds": seconds, "concurrency": concurrency,
             "batched": batched, "offloop": offloop,
-            "call_batch": call_batch,
+            "call_batch": call_batch, "egress": egress,
             "calls": calls,
             "stage_seconds": {k: round(v, 4)
                               for k, v in stage_seconds.items()},
@@ -201,6 +217,17 @@ async def run(seconds: float = 2.0, concurrency: int = 32,
             "frames_decoded": frames,
             "mean_frames_per_read": round(
                 batch_h.get("mean", 0.0), 2) if batch_h else None,
+            "egress_seconds": {k: round(v, 4)
+                               for k, v in egress_seconds.items()},
+            "egress_responses": responses,
+            "mean_flush_group": round(
+                group_h.get("mean", 0.0), 2) if group_h else None,
+            # response-path share of ALL instrumented stage seconds
+            # (ingest + egress): how much of the measured wall the
+            # return leg costs under this configuration
+            "response_path_share": round(
+                egress_total / (total + egress_total), 4)
+                if (total + egress_total) else 0.0,
         },
     }
 
@@ -406,6 +433,68 @@ async def run_call_batch_ab(seconds: float = 1.5, workers: int = 16,
     }
 
 
+async def run_egress_ab(seconds: float = 1.5, workers: int = 16,
+                        n_keys: int = 64, batch: int = 16) -> dict:
+    """Batched vs per-message RESPONSE path, vector-only closed loop over
+    real TCP (the ISSUE-10 lever, isolated the same way the call_batch
+    A/B isolated the sender side): identical ``call_batch`` senders drive
+    identical device-tier traffic against two silos that differ ONLY in
+    ``batched_egress`` — per-message, every resolved future fans out its
+    own send_response → transmit → encode → client-route write; batched,
+    one inbound batch's responses group per origin and ride ONE
+    encode_message_batch write (header-prefix template) plus one
+    client-side receive_response_batch correlation pass. Ratio-based, so
+    interpreter/container speed cancels."""
+    import numpy as np
+
+    from orleans_tpu.dispatch import add_vector_grains
+    from orleans_tpu.parallel import make_mesh
+
+    async def measure(egress: bool) -> float:
+        EchoVec = _make_vector_grain()
+        fabric = SocketFabric()
+        b = (SiloBuilder().with_name("eg-ab").with_fabric(fabric)
+             .add_grains(EchoGrain)
+             .with_config(batched_egress=egress))
+        add_vector_grains(b, EchoVec, mesh=make_mesh(1),
+                          dense={EchoVec: n_keys})
+        silo = b.build()
+        await silo.start()
+        client = await GatewayClient([silo.silo_address.endpoint]).connect()
+        client.batched_egress = egress  # correlation half of the lever
+        try:
+            refs = [client.get_grain(EchoVec, k) for k in range(n_keys)]
+            await asyncio.gather(*(v.ping(x=np.int32(0))
+                                   for v in refs[:8]))
+            stop_at = time.perf_counter() + seconds
+            cb_count = [0]
+            w = batched_vec_sender(client, EchoVec, n_keys, batch,
+                                   stop_at, cb_count)
+            t0 = time.perf_counter()
+            await asyncio.gather(*(w(i) for i in range(workers)))
+            return cb_count[0] / (time.perf_counter() - t0)
+        finally:
+            await client.close_async()
+            await silo.stop()
+
+    per_msg = await measure(False)
+    batched = await measure(True)
+    ratio = batched / per_msg if per_msg else 0.0
+    return {
+        "metric": "batched_egress_speedup",
+        "value": round(ratio, 2),
+        "unit": "x (vector-only closed loop, batched vs per-message "
+                "responses)",
+        "vs_baseline": None,
+        "extra": {
+            "per_message_msgs_per_sec": round(per_msg, 1),
+            "batched_msgs_per_sec": round(batched, 1),
+            "workers": workers, "batch": batch, "n_keys": n_keys,
+            "seconds": seconds,
+        },
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seconds", type=float, default=3.0)
@@ -414,6 +503,11 @@ def main() -> None:
                     help="run the batched-vs-per-frame hand-off A/B")
     ap.add_argument("--call-batch-ab", action="store_true",
                     help="run the call_batch-vs-per-message sender A/B")
+    ap.add_argument("--egress-ab", action="store_true",
+                    help="run the batched-vs-per-message response-path A/B")
+    ap.add_argument("--per-message-egress", action="store_true",
+                    help="attribution with batched egress OFF (the "
+                         "response-path share baseline)")
     ap.add_argument("--per-frame", action="store_true",
                     help="attribution with batched ingress OFF (the "
                          "share-comparison baseline)")
@@ -428,11 +522,15 @@ def main() -> None:
         print(json.dumps(asyncio.run(run_ab(seconds=a.seconds))))
     elif a.call_batch_ab:
         print(json.dumps(asyncio.run(run_call_batch_ab(seconds=a.seconds))))
+    elif a.egress_ab:
+        print(json.dumps(asyncio.run(run_egress_ab(seconds=a.seconds))))
     else:
-        print(json.dumps(asyncio.run(run(a.seconds, a.concurrency,
-                                         batched=not a.per_frame,
-                                         offloop=not a.inline_tick,
-                                         call_batch=a.call_batch))))
+        print(json.dumps(asyncio.run(run(
+            a.seconds, a.concurrency,
+            batched=not a.per_frame,
+            offloop=not a.inline_tick,
+            call_batch=a.call_batch,
+            egress=not a.per_message_egress))))
 
 
 if __name__ == "__main__":

@@ -86,6 +86,11 @@ def main() -> None:
     # mix shift; measured ~1.5-1.8x, CI floor 1.2x)
     print(json.dumps(asyncio.run(ingest_attribution.run_call_batch_ab(
         seconds=1.5))))
+    # batched egress vs per-message responses, vector-only closed loop
+    # (ISSUE 10: response groups per origin + header-prefix template +
+    # batched client correlation; measured ~1.25-1.8x, CI floor 1.2x)
+    print(json.dumps(asyncio.run(ingest_attribution.run_egress_ab(
+        seconds=1.5))))
     # profiler overhead as a ratio vs a bare silo (per-callback
     # interposition + category accounting; CI floor 0.85)
     print(json.dumps(asyncio.run(ping.bench_profiling_overhead(

@@ -65,6 +65,11 @@ class MessagingOptions:
     max_enqueued_requests: int = 5000
     max_request_processing_time: float = 60.0
     batched_ingress: bool = True
+    # batched response egress (runtime.egress flush accumulator +
+    # header-prefix wire template): ``batched_egress=False`` restores
+    # the per-message send_response → transmit path — the A/B lever
+    # symmetric with ``batched_ingress``
+    batched_egress: bool = True
     # off-loop device-tick pipeline (dispatch.engine tick worker):
     # ``offloop_tick=False`` restores the loop-inline tick — the A/B
     # lever paired with ``batched_ingress``
@@ -348,6 +353,7 @@ _FLAT_MAP = {
     "max_request_processing_time": (MessagingOptions,
                                     "max_request_processing_time"),
     "batched_ingress": (MessagingOptions, "batched_ingress"),
+    "batched_egress": (MessagingOptions, "batched_egress"),
     "offloop_tick": (MessagingOptions, "offloop_tick"),
     "turn_warning_length": (SchedulingOptions, "turn_warning_length"),
     "detect_deadlocks": (SchedulingOptions, "detect_deadlocks"),

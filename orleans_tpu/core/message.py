@@ -28,7 +28,7 @@ from .ids import ActivationId, GrainId, SiloAddress
 __all__ = [
     "Category", "Direction", "ResponseKind", "RejectionType",
     "Message", "make_request", "make_response", "make_error_response",
-    "make_rejection", "recycle_message",
+    "make_rejection", "recycle_message", "recycle_messages",
     "PoolDisciplineError", "set_debug_pool", "debug_pool_enabled",
     "pool_generation", "assert_live", "assert_generation",
 ]
@@ -265,6 +265,35 @@ def recycle_message(m: Message) -> None:
     m.call_chain = ()
     if not pool_full:
         _MSG_POOL.append(m)
+
+
+def recycle_messages(msgs) -> None:
+    """Batch twin of :func:`recycle_message` — ONE release sweep for the
+    envelopes a batched response correlation retires together
+    (``RuntimeClient.receive_response_batch``: two envelopes per RPC at
+    batch rate, where the per-call function overhead was the point of
+    batching). Semantics are identical per envelope: idempotent via
+    ``_pool_free``, reference-carrying fields cleared, debug-pool
+    generation stamped even when the full pool drops the shell."""
+    pool = _MSG_POOL
+    debug = _DEBUG_POOL
+    room = _MSG_POOL_CAP - len(pool)
+    for m in msgs:
+        if getattr(m, "_pool_free", False):
+            continue
+        if room <= 0 and not debug:
+            continue
+        if debug:
+            m._pool_gen = pool_generation(m) + 1
+        m._pool_free = True
+        m.body = None
+        m.request_context = None
+        m.transaction_info = None
+        m.cache_invalidation = None
+        m.call_chain = ()
+        if room > 0:
+            pool.append(m)
+            room -= 1
 
 
 def make_request(

@@ -705,11 +705,26 @@ static PyObject *hw_configure(PyObject *self, PyObject *args) {
     Py_RETURN_NONE;
 }
 
+/* Encode one already-fetched header-field value: top-level int
+ * subclasses (IntEnums) are coerced to plain ints — the message-header
+ * fast path; the decoder side restores them positionally.  Shared by
+ * enc_attr_tuple and the template writer. */
+static int enc_attr_value(W *w, PyObject *v) {
+    if (PyLong_Check(v) && !PyLong_CheckExact(v) && !PyBool_Check(v)) {
+        /* IntEnum header field -> wire int */
+        int overflow = 0;
+        long long ll = PyLong_AsLongLongAndOverflow(v, &overflow);
+        if (overflow || (ll == -1 && PyErr_Occurred()))
+            return -1;
+        return (w_byte(w, T_INT) < 0 ||
+                w_varint(w, zigzag(ll)) < 0) ? -1 : 0;
+    }
+    return enc_value(w, v, 1);
+}
+
 /* Shared core of pack_attrs/pack_frame: magic+version+T_TUPLE, then
  * tuple(getattr(obj, n) for n in names) + (extra,) without materializing
- * the intermediate tuple.  Top-level int subclasses (IntEnums) are
- * coerced to plain ints — the message-header fast path; the decoder side
- * restores them positionally. */
+ * the intermediate tuple. */
 static int enc_attr_tuple(W *w, PyObject *obj, PyObject *names,
                           PyObject *extra) {
     Py_ssize_t n = PyTuple_GET_SIZE(names);
@@ -721,20 +736,7 @@ static int enc_attr_tuple(W *w, PyObject *obj, PyObject *names,
     for (Py_ssize_t i = 0; i < n; i++) {
         PyObject *v = PyObject_GetAttr(obj, PyTuple_GET_ITEM(names, i));
         if (!v) return -1;
-        int rc;
-        if (PyLong_Check(v) && !PyLong_CheckExact(v) && !PyBool_Check(v)) {
-            /* IntEnum header field -> wire int */
-            int overflow = 0;
-            long long ll = PyLong_AsLongLongAndOverflow(v, &overflow);
-            if (overflow || (ll == -1 && PyErr_Occurred())) {
-                Py_DECREF(v);
-                return -1;
-            }
-            rc = (w_byte(w, T_INT) < 0 ||
-                  w_varint(w, zigzag(ll)) < 0) ? -1 : 0;
-        } else {
-            rc = enc_value(w, v, 1);
-        }
+        int rc = enc_attr_value(w, v);
         Py_DECREF(v);
         if (rc < 0) return -1;
     }
@@ -804,17 +806,19 @@ static PyObject *hw_configure_headers(PyObject *self, PyObject *args) {
  * at the current write position.  Shared by pack_frame (one frame per
  * call) and pack_batch (a whole send batch into one buffer) — the batch
  * output is bit-for-bit the concatenation of the per-frame outputs. */
-static int write_frame(W *w, PyObject *msg, PyObject *ttl, Py_buffer *body) {
+static int frame_begin(W *w, Py_ssize_t *start, Py_buffer *body) {
     if (body->len > (Py_ssize_t)HW_MAX_SEGMENT) {
         PyErr_SetString(PyExc_ValueError, "hotwire: body exceeds frame cap");
         return -1;
     }
-    Py_ssize_t start = w->len;
+    *start = w->len;
     if (w->cap - w->len < 8 && w_grow(w, 8) < 0) return -1;
-    memset(w->buf + start, 0, 8);  /* length prefix backfilled below */
-    w->len = start + 8;
-    if (enc_attr_tuple(w, msg, g_state.hdr_names, ttl) < 0)
-        return -1;
+    memset(w->buf + *start, 0, 8);  /* length prefix backfilled at finish */
+    w->len = *start + 8;
+    return 0;
+}
+
+static int frame_finish(W *w, Py_ssize_t start, Py_buffer *body) {
     if (w->len - start - 8 > (Py_ssize_t)HW_MAX_SEGMENT) {
         PyErr_SetString(PyExc_ValueError,
                         "hotwire: headers exceed frame cap");
@@ -835,6 +839,14 @@ static int write_frame(W *w, PyObject *msg, PyObject *ttl, Py_buffer *body) {
         p[7] = (char)((blen >> 24) & 0xFF);
     }
     return w_raw(w, (const char *)body->buf, body->len);
+}
+
+static int write_frame(W *w, PyObject *msg, PyObject *ttl, Py_buffer *body) {
+    Py_ssize_t start;
+    if (frame_begin(w, &start, body) < 0) return -1;
+    if (enc_attr_tuple(w, msg, g_state.hdr_names, ttl) < 0)
+        return -1;
+    return frame_finish(w, start, body);
 }
 
 /* pack_frame(msg, ttl, body) -> bytes
@@ -905,6 +917,195 @@ static PyObject *hw_pack_batch(PyObject *self, PyObject *arg) {
             goto fail;
         int rc = write_frame(&w, PyTuple_GET_ITEM(item, 0),
                              PyTuple_GET_ITEM(item, 1), &body);
+        PyBuffer_Release(&body);
+        if (rc < 0) goto fail;
+    }
+    {
+        PyObject *out = PyBytes_FromStringAndSize(w.buf, w.len);
+        w_free(&w);
+        Py_DECREF(seq);
+        return out;
+    }
+fail:
+    w_free(&w);
+    Py_DECREF(seq);
+    return NULL;
+}
+
+/* Validate a varying-field index tuple against the configured header
+ * spec: ints, strictly ascending, in [0, n_fields). Returns the count,
+ * or -1 with an exception set. */
+static Py_ssize_t check_var_indices(PyObject *vars) {
+    Py_ssize_t n = PyTuple_GET_SIZE(g_state.hdr_names);
+    Py_ssize_t k = PyTuple_GET_SIZE(vars);
+    Py_ssize_t prev = -1;
+    for (Py_ssize_t j = 0; j < k; j++) {
+        PyObject *o = PyTuple_GET_ITEM(vars, j);
+        if (!PyLong_Check(o)) {
+            PyErr_SetString(PyExc_TypeError,
+                            "var_indices: want a tuple of ints");
+            return -1;
+        }
+        Py_ssize_t i = PyLong_AsSsize_t(o);
+        if (i == -1 && PyErr_Occurred()) return -1;
+        if (i <= prev || i >= n) {
+            PyErr_SetString(PyExc_ValueError,
+                            "var_indices: must be strictly ascending "
+                            "and within the header field count");
+            return -1;
+        }
+        prev = i;
+    }
+    return k;
+}
+
+/* make_header_template(msg, var_indices) -> tuple of bytes
+ *
+ * Pre-encode the INVARIANT portion of a message-header frame: the
+ * returned tuple holds k+1 byte chunks — the header preamble
+ * (magic/version/T_TUPLE/count) plus the encoded runs of invariant
+ * fields between (and around) the k varying fields named by
+ * ``var_indices``.  pack_batch_tmpl below memcpys the chunks and
+ * encodes only the varying fields per message, producing bytes
+ * identical to pack_frame whenever the invariant field VALUES match the
+ * message the template was built from (the caller keys its template
+ * cache on exactly those values). */
+static PyObject *hw_make_header_template(PyObject *self, PyObject *args) {
+    PyObject *msg, *vars;
+    if (!PyArg_ParseTuple(args, "OO!", &msg, &PyTuple_Type, &vars))
+        return NULL;
+    if (!g_state.hdr_configured) {
+        PyErr_SetString(PyExc_RuntimeError,
+                        "hotwire: headers not configured");
+        return NULL;
+    }
+    Py_ssize_t k = check_var_indices(vars);
+    if (k < 0) return NULL;
+    Py_ssize_t n = PyTuple_GET_SIZE(g_state.hdr_names);
+    PyObject *chunks = PyTuple_New(k + 1);
+    if (!chunks) return NULL;
+    W w;
+    if (w_init(&w, 256) < 0) { Py_DECREF(chunks); return NULL; }
+    /* preamble: identical to enc_attr_tuple's opening bytes */
+    if (w_byte(&w, HW_MAGIC) < 0 || w_byte(&w, HW_VERSION) < 0 ||
+        w_byte(&w, T_TUPLE) < 0 ||
+        w_varint(&w, (uint64_t)(n + 1)) < 0)
+        goto fail;
+    {
+        Py_ssize_t vi = 0;
+        for (Py_ssize_t i = 0; i < n; i++) {
+            if (vi < k &&
+                i == PyLong_AsSsize_t(PyTuple_GET_ITEM(vars, vi))) {
+                /* varying field: close the current invariant chunk */
+                PyObject *c = PyBytes_FromStringAndSize(w.buf, w.len);
+                if (!c) goto fail;
+                PyTuple_SET_ITEM(chunks, vi, c);
+                w.len = 0;
+                vi++;
+                continue;
+            }
+            PyObject *v = PyObject_GetAttr(
+                msg, PyTuple_GET_ITEM(g_state.hdr_names, i));
+            if (!v) goto fail;
+            int rc = enc_attr_value(&w, v);
+            Py_DECREF(v);
+            if (rc < 0) goto fail;
+        }
+        PyObject *tail = PyBytes_FromStringAndSize(w.buf, w.len);
+        if (!tail) goto fail;
+        PyTuple_SET_ITEM(chunks, k, tail);
+    }
+    w_free(&w);
+    return chunks;
+fail:
+    w_free(&w);
+    Py_DECREF(chunks);
+    return NULL;
+}
+
+/* pack_batch_tmpl(chunks, var_indices, items) -> bytes
+ *
+ * Template-mode batch encode (the pre-encoded header-prefix cache):
+ * each (msg, ttl, body) frame is written as
+ *
+ *   [len prefix][chunk0][enc var0][chunk1][enc var1]...[chunkK][ttl][body]
+ *
+ * — the invariant header runs are memcpy'd from the cached template and
+ * only the varying fields (correlation id, per-message stamps, body
+ * splice) are encoded per message.  Byte-identical to pack_batch /
+ * N pack_frame calls when the template matches (property-tested).  Any
+ * per-item failure fails the whole call; the caller falls back to the
+ * per-message encode, which scopes the error to one frame. */
+static PyObject *hw_pack_batch_tmpl(PyObject *self, PyObject *args) {
+    PyObject *chunks, *vars, *arg;
+    if (!PyArg_ParseTuple(args, "O!O!O", &PyTuple_Type, &chunks,
+                          &PyTuple_Type, &vars, &arg))
+        return NULL;
+    if (!g_state.hdr_configured) {
+        PyErr_SetString(PyExc_RuntimeError,
+                        "hotwire: headers not configured");
+        return NULL;
+    }
+    Py_ssize_t k = check_var_indices(vars);
+    if (k < 0) return NULL;
+    if (PyTuple_GET_SIZE(chunks) != k + 1) {
+        PyErr_SetString(PyExc_ValueError,
+                        "pack_batch_tmpl: want len(var_indices)+1 chunks");
+        return NULL;
+    }
+    for (Py_ssize_t j = 0; j <= k; j++) {
+        if (!PyBytes_Check(PyTuple_GET_ITEM(chunks, j))) {
+            PyErr_SetString(PyExc_TypeError,
+                            "pack_batch_tmpl: chunks must be bytes");
+            return NULL;
+        }
+    }
+    PyObject *seq = PySequence_Fast(arg, "pack_batch_tmpl: want a sequence "
+                                         "of (msg, ttl, body) triples");
+    if (!seq) return NULL;
+    Py_ssize_t count = PySequence_Fast_GET_SIZE(seq);
+    W w;
+    if (w_init(&w, count > 0 ? 256 * count : 64) < 0) {
+        Py_DECREF(seq);
+        return NULL;
+    }
+    for (Py_ssize_t i = 0; i < count; i++) {
+        PyObject *item = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyTuple_Check(item) || PyTuple_GET_SIZE(item) != 3) {
+            PyErr_SetString(PyExc_TypeError,
+                            "pack_batch_tmpl: items must be "
+                            "(msg, ttl, body)");
+            goto fail;
+        }
+        PyObject *msg = PyTuple_GET_ITEM(item, 0);
+        Py_buffer body;
+        if (PyObject_GetBuffer(PyTuple_GET_ITEM(item, 2), &body,
+                               PyBUF_SIMPLE) < 0)
+            goto fail;
+        Py_ssize_t start;
+        int rc = frame_begin(&w, &start, &body);
+        for (Py_ssize_t j = 0; rc == 0 && j < k; j++) {
+            PyObject *c = PyTuple_GET_ITEM(chunks, j);
+            rc = w_raw(&w, PyBytes_AS_STRING(c), PyBytes_GET_SIZE(c));
+            if (rc == 0) {
+                PyObject *name = PyTuple_GET_ITEM(
+                    g_state.hdr_names,
+                    PyLong_AsSsize_t(PyTuple_GET_ITEM(vars, j)));
+                PyObject *v = PyObject_GetAttr(msg, name);
+                if (!v) { rc = -1; break; }
+                rc = enc_attr_value(&w, v);
+                Py_DECREF(v);
+            }
+        }
+        if (rc == 0) {
+            PyObject *tail = PyTuple_GET_ITEM(chunks, k);
+            rc = w_raw(&w, PyBytes_AS_STRING(tail),
+                       PyBytes_GET_SIZE(tail));
+        }
+        if (rc == 0)
+            rc = enc_value(&w, PyTuple_GET_ITEM(item, 1), 1);  /* ttl */
+        if (rc == 0)
+            rc = frame_finish(&w, start, &body);
         PyBuffer_Release(&body);
         if (rc < 0) goto fail;
     }
@@ -1185,6 +1386,13 @@ static PyMethodDef hw_methods[] = {
     {"pack_batch", hw_pack_batch, METH_O,
      "pack_batch(items) -> bytes: encode (msg, ttl, body) triples into "
      "one contiguous frame-batch buffer."},
+    {"make_header_template", hw_make_header_template, METH_VARARGS,
+     "make_header_template(msg, var_indices) -> chunk tuple: pre-encode "
+     "the invariant header runs around the varying fields."},
+    {"pack_batch_tmpl", hw_pack_batch_tmpl, METH_VARARGS,
+     "pack_batch_tmpl(chunks, var_indices, items) -> bytes: template-"
+     "mode frame-batch encode (memcpy invariant runs, encode varying "
+     "fields per message)."},
     {"unpack_header", hw_unpack_header, METH_VARARGS,
      "unpack_header(data, msg) -> ttl: decode + setattr via cached spec."},
     {"unpack_batch", hw_unpack_batch, METH_VARARGS,

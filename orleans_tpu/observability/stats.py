@@ -14,8 +14,8 @@ from collections import deque
 from typing import Callable
 
 __all__ = ["StatsRegistry", "Histogram", "QueueWaitTrend", "DISPATCH_STATS",
-           "REBALANCE_STATS", "INGEST_STATS", "INGEST_STAGES", "SIZE_BOUNDS",
-           "COUNT_BOUNDS"]
+           "REBALANCE_STATS", "INGEST_STATS", "INGEST_STAGES",
+           "EGRESS_STATS", "EGRESS_STAGES", "SIZE_BOUNDS", "COUNT_BOUNDS"]
 
 # Hot-lane dispatch counter pair (runtime.hotlane): hits = calls that ran
 # as frame-collapsed inline turns (including the always-interleave direct
@@ -89,6 +89,39 @@ INGEST_STATS = {
     "transfer": "ingest.transfer.seconds",
     "tick": "ingest.tick.seconds",
     "messages": "ingest.messages",               # counter: device msgs ticked
+}
+
+
+# Canonical egress-pipeline stage metrics — the response-path twin of
+# INGEST_STATS (the batched-egress pipeline: Dispatcher.send_response →
+# EgressBatcher → MessageCenter.send_batch → one encode_message_batch
+# write per destination). Stage latency histograms decompose the
+# response leg the same way the ingest stages decompose the request leg:
+#
+#   build    per-flush grouping/hand-off work in EgressBatcher.flush
+#            (the response-batch resolution cost itself)
+#   dwell    send-queue dwell: a response entering the per-destination
+#            flush accumulator -> leaving it at the batch-completion
+#            flush (never spans a loop turn by construction — a growing
+#            dwell means flush groups are forming across big completion
+#            bursts, the batching-degree signal's latency face)
+#   encode   wire encode of one outbound batch (header-prefix template +
+#            pack_batch on the native build), observed per
+#            encode_message_batch call by metrics-enabled egress writers
+#
+#   group    flush-group size (COUNT_BOUNDS histogram — the egress twin
+#            of ingest frame_batch: responses per hand-off unit)
+#
+# Everything is gated on SiloConfig.metrics_enabled exactly like the
+# ingest stages — one attr check per site when off.
+EGRESS_STAGES = ("build", "dwell", "encode")
+
+EGRESS_STATS = {
+    "build": "egress.build.seconds",
+    "dwell": "egress.dwell.seconds",
+    "encode": "egress.encode.seconds",
+    "group": "egress.flush_group.size",       # COUNT_BOUNDS histogram
+    "responses": "egress.responses",          # counter: responses batched
 }
 
 

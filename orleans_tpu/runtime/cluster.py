@@ -137,6 +137,29 @@ class InProcFabric:
             raise SiloUnavailableError(f"gateway {gateway} unavailable")
         silo.message_center.deliver(msg)
 
+    def deliver_group(self, target: SiloAddress, msgs: list) -> None:
+        """Batched outbound hand-off for ONE destination
+        (``MessageCenter.send_batch`` — the batched-egress response
+        path): a client gets one ``deliver_batch`` correlation pass, a
+        silo one ``deliver_batch`` routing hop."""
+        if target is None:
+            log.warning("dropping %d unaddressed batched messages",
+                        len(msgs))
+            return
+        first = msgs[0]
+        if first.sending_silo is not None and \
+                (first.sending_silo.endpoint,
+                 target.endpoint) in self.partitions:
+            return  # one sender, one target: the whole group is cut
+        client = self.clients.get(target)
+        if client is not None:
+            client.deliver_batch(msgs)
+            return
+        silo = self.silos.get(target)
+        if silo is None or target in self.dead:
+            return  # dead silo: dropped, like deliver()
+        silo.message_center.deliver_batch(msgs)
+
     def deliver_via_gateway_batch(self, gateway: SiloAddress,
                                   msgs: list) -> None:
         """Batched client ingress (``ClusterClient.transmit_batch``): one

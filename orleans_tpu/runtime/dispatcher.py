@@ -75,6 +75,10 @@ class Dispatcher:
         # Silo._install_loop_profiler when profiling_enabled, else None —
         # the per-turn guard is one attribute load
         self._loop_prof = None
+        # batched response egress (runtime.egress.EgressBatcher): set by
+        # the Silo ctor when batched_egress is on, else None —
+        # send_response pays one attribute check on the per-message path
+        self._egress = None
         # in-flight device-tier state recoveries: (class, key_hash) →
         # future; concurrent calls for one recovering key share the load
         self._vector_recoveries: dict = {}
@@ -439,9 +443,12 @@ class Dispatcher:
                                      [(kh, kw, w) for _, kh, kw, w, _ in
                                       items])
             except Exception as e:  # noqa: BLE001 — unknown method etc.
-                for m, _, _, _, _ in items:
-                    if m.direction != Direction.ONE_WAY:
-                        self.send_response(m, make_error_response(m, e))
+                # the whole group failed together: one egress flush per
+                # destination instead of N per-message response hops
+                self.send_response_batch(
+                    (m, make_error_response(m, e))
+                    for m, _, _, _, _ in items
+                    if m.direction != Direction.ONE_WAY)
                 continue
             for (m, _, _, _, hdr), fut in zip(items, futs):
                 if fut is not None:
@@ -885,11 +892,48 @@ class Dispatcher:
             self.silo.message_center.send_message(msg)
 
     def send_response(self, request: Message, response: Message) -> None:
-        """SendResponse:769."""
+        """SendResponse:769 — batched egress joins remote-bound responses
+        to the per-destination flush accumulator (runtime.egress), so the
+        N responses of one inbound batch ride one fabric hand-off per
+        origin; local responses keep the synchronous loopback
+        (``transmit`` short-circuits into receive_message) and the
+        ``batched_egress=False`` A/B lever restores the per-message path
+        bit for bit."""
         if request.direction == Direction.ONE_WAY:
             return
         response.target_silo = request.sending_silo
+        eg = self._egress
+        if eg is not None and response.category == Category.APPLICATION \
+                and response.target_silo is not None and \
+                response.target_silo != self.silo.silo_address:
+            # APPLICATION responses only: PING/SYSTEM responses
+            # (membership probes, directory and management RPCs) are
+            # latency-critical and low-volume — the accumulator's
+            # end-of-ready-run flush can sit behind a saturated loop's
+            # whole callback run, and a probe response delayed past the
+            # probe timeout gets a healthy silo voted dead (the same
+            # QoS split the reference's category queues exist for)
+            eg.add(response.target_silo, response)
+            return
         self.transmit(response)
+
+    def send_response_batch(self, items) -> None:
+        """Batched SendResponse for one completed batch: ``items`` is an
+        iterable of ``(request, response)`` pairs resolved together (a
+        ``call_group`` error bounce, a vector-batch schema failure).
+        Groups ride the egress accumulator and flush at this
+        batch-completion boundary — one ``MessageCenter.send_batch`` per
+        destination — instead of waiting for the armed end-of-burst
+        flush; without the batcher it degrades to per-message
+        ``send_response`` exactly."""
+        eg = self._egress
+        if eg is None:
+            for request, response in items:
+                self.send_response(request, response)
+            return
+        for request, response in items:
+            self.send_response(request, response)
+        eg.flush()
 
     # ==================================================================
     # Rejection / forwarding (TryForwardRequest:526)

@@ -28,6 +28,7 @@ from ..core.message import (
     ResponseKind,
     make_request_fast,
     recycle_message,
+    recycle_messages,
 )
 from ..core.serialization import copy_call_body, deep_copy
 from ..observability.tracing import (
@@ -161,6 +162,10 @@ class RuntimeClient:
         # the last event-loop yield — bounds the forced-yield cadence when
         # the loop has nothing else ready
         self.hot_calls_since_yield = 0
+        # batched response correlation (receive_response_batch): the
+        # client-side half of the batched-egress A/B lever — off restores
+        # per-message receive_response for every delivered batch
+        self.batched_egress = True
 
     def enable_tracing(self, sample_rate: float = 1.0,
                        buffer_size: int = 4096, name: str = "client", *,
@@ -733,6 +738,99 @@ class RuntimeClient:
                             RejectionError(msg.rejection_info or "rejected"))
             _recycle_callback(cb)       # terminal: see system-target note
             recycle_message(msg)
+
+    def deliver_batch(self, msgs: list) -> None:
+        """Batched inbound delivery for clients (the gateway pump and the
+        in-proc fabric hand one decoded/delivered group here): contiguous
+        RESPONSE runs correlate via :meth:`receive_response_batch`, and
+        anything else (observer notifications) takes the subclass's
+        per-message ``deliver`` in arrival order. Only meaningful on
+        client subclasses that define ``deliver``."""
+        if not self.batched_egress:
+            for m in msgs:
+                self.deliver(m)  # type: ignore[attr-defined]
+            return
+        run: list | None = None
+        for m in msgs:
+            if m.direction == Direction.RESPONSE:
+                if run is None:
+                    run = []
+                run.append(m)
+                continue
+            if run:
+                self.receive_response_batch(run)
+                run = None
+            self.deliver(m)  # type: ignore[attr-defined]
+        if run:
+            self.receive_response_batch(run)
+
+    def receive_response_batch(self, msgs: list) -> None:
+        """Batched response correlation — the client-side leg of batched
+        egress: N ``CallbackData`` lookups resolve in one pass and the
+        common SUCCESS/ERROR terminals defer their freelist releases into
+        ONE sweep per batch (request shell + response envelope each
+        released exactly once, after every future has resolved), instead
+        of per-message dict/recycle churn. Rejections (resend backoff,
+        terminal-rejection bookkeeping) delegate to
+        :meth:`receive_response`, which preserves their exact
+        per-message semantics."""
+        callbacks = self.callbacks
+        tracer = self.tracer
+        dead: list[Message] = []          # envelopes settled for good
+        shells: list[CallbackData] = []   # callback shells to release
+        for msg in msgs:
+            kind = msg.response_kind
+            if kind is not ResponseKind.SUCCESS and \
+                    kind is not ResponseKind.ERROR:
+                self.receive_response(msg)  # rejection machinery: rare
+                continue
+            cb = callbacks.pop(msg.id, None)
+            if cb is None:
+                log.debug("dropping late/unknown response %s", msg.id)
+                # dead on arrival (see receive_response: the request
+                # shell stays out — its turn may still be running)
+                dead.append(msg)
+                continue
+            if cb.future.done():
+                # timed out / broken while in flight: the envelope is
+                # dead on arrival, the request shell stays out
+                shells.append(cb)
+                dead.append(msg)
+                continue
+            if _msg_mod._DEBUG_POOL and cb.gen is not None:
+                _msg_mod.assert_generation(
+                    cb.message, cb.gen,
+                    "RuntimeClient.receive_response_batch")
+            if tracer is not None and msg.request_context is not None:
+                # response-leg network span: identical to the
+                # per-message path — the server's send-side wall stamp
+                # (_stamp_response) rides the batched wire unchanged
+                hdr = context_from_headers(msg.request_context)
+                if hdr is not None:
+                    tracer.record(hdr[0], hdr[1], "network", "network",
+                                  hdr[2], time.time() - hdr[2],
+                                  leg="response")
+            if cb.txn_info is not None and msg.transaction_info is not None:
+                tid, participants = msg.transaction_info
+                if tid == cb.txn_info.id:
+                    cb.txn_info.merge(participants)
+            if kind is ResponseKind.SUCCESS:
+                _resolve_future(cb.future, msg.body, None)
+            else:
+                exc = msg.body if isinstance(msg.body, BaseException) else \
+                    RejectionError(str(msg.body))
+                _resolve_future(cb.future, None, exc)
+            # settled for good: same safety argument as receive_response
+            # (waiter wakeups are call_soon-deferred, so only synchronous
+            # callers up-stack still hold these and they finish their
+            # reads before any pool re-acquire runs on this loop)
+            dead.append(cb.message)
+            dead.append(msg)
+            shells.append(cb)
+        if dead:
+            recycle_messages(dead)
+        for cb in shells:
+            _recycle_callback(cb)
 
     def break_outstanding_to_dead_silo(self, silo: SiloAddress) -> None:
         """``BreakOutstandingMessagesToDeadSilo:726``."""

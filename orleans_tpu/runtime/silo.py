@@ -118,6 +118,15 @@ class SiloConfig:
     # per-frame decode + per-message hand-off (the A/B lever; bytes on
     # the wire are identical either way)
     batched_ingress: bool = True
+    # batched egress (the response-path twin of batched_ingress):
+    # responses resolved from one inbound batch group per origin in a
+    # per-destination flush accumulator (runtime.egress.EgressBatcher)
+    # and ride ONE MessageCenter.send_batch → encode_message_batch write
+    # per destination (header-prefix template on the native build),
+    # instead of N per-message send_response → transmit hops. Off = the
+    # per-message response path bit for bit (the A/B lever; wire bytes
+    # are identical either way)
+    batched_egress: bool = True
     # off-loop device-tick pipeline (dispatch.engine): the staging fill,
     # operand upload, kernel dispatch, and host materialize sync of every
     # vector tick run on a dedicated worker thread behind a tick-
@@ -268,6 +277,10 @@ class MessageCenter:
         # ingest stage metrics (INGEST_STATS): cached so _route pays one
         # attribute load when metrics are off
         self._istats = silo.ingest_stats
+        # batched response egress (runtime.egress.EgressBatcher): set by
+        # the Silo ctor when batched_egress is on, else None — the
+        # per-message send path pays one attribute check
+        self.egress = None
 
     def start(self) -> None:
         self.running = True
@@ -277,6 +290,11 @@ class MessageCenter:
             self._pumps.append(loop.create_task(self._pump(cat)))
 
     def stop(self) -> None:
+        if self.egress is not None:
+            # hand any accumulated response groups to the fabric before
+            # the center stops accepting work (the armed flush callback
+            # may never run once the loop moves on to teardown)
+            self.egress.flush()
         self.running = False
         for t in self._pumps:
             t.cancel()
@@ -393,6 +411,10 @@ class MessageCenter:
         my_addr = silo.silo_address
         vifaces = silo.vector_interfaces
         cat_counts: dict = {}
+        # silo-to-silo responses arriving in one wire batch correlate in
+        # one pass (receive_response_batch: one freelist-release sweep)
+        # when the batched response path is on; per-message otherwise
+        responses: list | None = [] if silo.config.batched_egress else None
         for m in msgs:
             if ist is not None and m.received_at is not None:
                 # ingest enqueue stage (~0 inline) — one clock read for
@@ -401,6 +423,12 @@ class MessageCenter:
                 ist.observe(_INGEST_ENQUEUE, now - m.received_at)
                 m.received_at = now
             cat_counts[m.category] = cat_counts.get(m.category, 0) + 1
+            if responses is not None and m.direction == Direction.RESPONSE:
+                # grouped correlation: futures resolve via call_soon
+                # either way, so deferring these past the batch's
+                # requests reorders nothing observable
+                responses.append(m)
+                continue
             if m.direction != Direction.RESPONSE and vifaces:
                 vcls = vifaces.get(m.interface_name)
                 if vcls is not None:
@@ -427,6 +455,11 @@ class MessageCenter:
         for cat, c in cat_counts.items():
             # one counter add per category per batch, not per message
             stats.increment(self._RECEIVED_STAT[cat], c)
+        if responses:
+            try:
+                silo.runtime_client.receive_response_batch(responses)
+            except Exception:  # noqa: BLE001 — same contract as the pump
+                log.exception("batched response correlation failed")
         for vcls, group in vgroups.items():
             try:
                 silo.dispatcher.receive_vector_batch(vcls, group)
@@ -480,6 +513,14 @@ class MessageCenter:
     def send_message(self, msg: Message) -> None:
         """Outbound to another silo/client via the fabric
         (MessageCenter.SendMessage:177-191)."""
+        eg = self.egress
+        if eg is not None and eg.groups:
+            # per-destination FIFO guard: a response group still pending
+            # for this destination must reach the fabric BEFORE this
+            # per-message send, or the send overtakes responses that
+            # were handed off first (per-sender FIFO per target is the
+            # wire's one ordering guarantee)
+            eg.flush_dest(msg.target_silo)
         self.silo.stats.increment("messaging.sent")
         # "went remote" hint: any traced leg leaving this process means
         # retention must pull peers before export; traces that never pass
@@ -502,6 +543,31 @@ class MessageCenter:
                 self.silo.fabric.deliver(rej)
             return
         self.silo.fabric.deliver(msg)
+
+    def send_batch(self, dest, msgs: list) -> None:
+        """Batched outbound: one response group for ONE destination rides
+        a single fabric hand-off (``deliver_group`` — local silos get one
+        ``deliver_batch``, gateway client routes one
+        ``encode_message_batch`` write, remote silos one sender-queue
+        fill). Per-message ``send_message`` semantics are mirrored: the
+        sent counter, the went-remote trace hint, and the dead-target
+        check (responses to a dead silo drop exactly like
+        ``send_message``'s non-request case — there is no caller left to
+        bounce to)."""
+        self.silo.stats.increment("messaging.sent", len(msgs))
+        tracer = self.silo.tracer
+        if tracer is not None:
+            for m in msgs:
+                mark_remote_if_traced(tracer, m)
+        fabric = self.silo.fabric
+        if dest is not None and fabric.is_dead(dest):
+            return
+        deliver_group = getattr(fabric, "deliver_group", None)
+        if deliver_group is not None:
+            deliver_group(dest, msgs)
+        else:
+            for m in msgs:
+                fabric.deliver(m)
 
 
 # direct-call marker ids come from hotlane.marker_ids: ONE negative-id
@@ -734,6 +800,14 @@ class Silo:
         self.runtime_client.tracer = self.tracer
         self.message_center = MessageCenter(self)
         self.dispatcher = Dispatcher(self)
+        if config.batched_egress:
+            # batched response egress (runtime.egress): responses
+            # resolved from one inbound batch group per destination and
+            # ride one fabric hand-off — send_response feeds it, the
+            # armed flush drains it at batch-completion boundaries
+            from .egress import EgressBatcher
+            self.message_center.egress = EgressBatcher(self.message_center)
+            self.dispatcher._egress = self.message_center.egress
         self.catalog = Catalog(self)
         # per-(grain_class, method) invoker table (runtime.invoker): built
         # once per class, consumed by the dispatcher's invoke engine and

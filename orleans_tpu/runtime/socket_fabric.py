@@ -202,10 +202,21 @@ class _Sender:
             try:
                 if self.writer is None or self.writer.is_closing():
                     self.writer = await self._connect()
-                # encode AFTER the (re)connect: peer_native is per-link
+                # encode AFTER the (re)connect: peer_native is per-link.
+                # egress.encode is the RESPONSE-path stage: only batches
+                # carrying responses observe it (a pure request drain
+                # booking into it would inflate the response-path share
+                # the attribution harness reports; responses co-batched
+                # with requests share one write, so the whole encode is
+                # honestly theirs-or-shared)
+                est = self.fabric.egress_stats
+                if est is not None and not any(
+                        m.direction == Direction.RESPONSE for m in batch):
+                    est = None
                 chunks = encode_message_batch(
                     batch, self.fabric.bounce_unencodable,
-                    native=self.peer_native)
+                    native=self.peer_native, stats=est,
+                    templates=self.fabric.response_templates)
                 if not chunks:
                     continue
                 self.writer.write(b"".join(chunks))
@@ -246,6 +257,17 @@ class SocketFabric:
         self._conn_tasks: set[asyncio.Task] = set()
         self.partitions: set[tuple[str, str]] = set()
         self._names = itertools.count(1)
+        # egress stage metrics (EGRESS_STATS): the registry of the first
+        # metrics-enabled local silo, else None — the sender/client-route
+        # encode paths pay one attribute load (senders are shared per
+        # endpoint, so per-silo attribution is not available here)
+        self.egress_stats = None
+        # header-prefix wire templates for response batches
+        # (wire.encode_message_batch templates= switch): cleared when any
+        # local silo runs batched_egress=False so the A/B lever also
+        # restores the per-frame header encode (bytes are identical
+        # either way — this only flips WHICH encoder produced them)
+        self.response_templates = True
 
     # -- address allocation ---------------------------------------------
     def allocate_address(self, name: str) -> SiloAddress:
@@ -272,6 +294,10 @@ class SocketFabric:
         addr = silo.silo_address
         self.silos[addr] = silo
         self.dead.discard(addr)
+        if self.egress_stats is None and silo.ingest_stats is not None:
+            self.egress_stats = silo.stats
+        if not silo.config.batched_egress:
+            self.response_templates = False
         sock = self._listen_socks.get(addr.endpoint)
         if sock is None:
             raise SiloUnavailableError(
@@ -379,36 +405,104 @@ class SocketFabric:
                 self, target.endpoint)
         sender.queue.put_nowait(msg)
 
+    def _client_encode_error(self, addr: SiloAddress,
+                             writer: asyncio.StreamWriter, msg: Message,
+                             e: Exception, native: bool) -> None:
+        """A message to a gateway client failed to *encode*: the route is
+        healthy, only this payload is bad. Fail the call promptly with a
+        portable error response instead of letting the client time out.
+        Shared by the per-message and batched client write paths."""
+        log.warning("unencodable message to client %s: %s", addr, e)
+        if msg.direction == Direction.RESPONSE:
+            from ..core.message import ResponseKind
+            fallback = Message.__new__(Message)
+            for s in Message.__slots__:
+                setattr(fallback, s, getattr(msg, s))
+            fallback.response_kind = ResponseKind.ERROR
+            fallback.body = SiloUnavailableError(
+                f"response to {msg.interface_name}.{msg.method_name} "
+                f"could not cross the wire: {e}")
+            try:
+                writer.write(encode_message(fallback, native=native))
+            except Exception:  # noqa: BLE001
+                log.exception("error-response fallback failed")
+
+    def _drop_client_route(self, addr: SiloAddress) -> None:
+        self.client_routes.pop(addr, None)
+        self._route_owner.pop(addr, None)
+        self._client_native.pop(addr, None)
+
     def _write_to_client(self, addr: SiloAddress,
                          writer: asyncio.StreamWriter, msg: Message) -> None:
         native = self._client_native.get(addr, False)
         try:
             data = encode_message(msg, native=native)
-        except Exception as e:  # noqa: BLE001 — encode failure: the route is
-            # healthy, only this payload is bad. Fail the call promptly
-            # instead of letting the client time out.
-            log.warning("unencodable message to client %s: %s", addr, e)
-            if msg.direction == Direction.RESPONSE:
-                from ..core.message import ResponseKind
-                fallback = Message.__new__(Message)
-                for s in Message.__slots__:
-                    setattr(fallback, s, getattr(msg, s))
-                fallback.response_kind = ResponseKind.ERROR
-                fallback.body = SiloUnavailableError(
-                    f"response to {msg.interface_name}.{msg.method_name} "
-                    f"could not cross the wire: {e}")
-                try:
-                    writer.write(encode_message(fallback, native=native))
-                except Exception:  # noqa: BLE001
-                    log.exception("error-response fallback failed")
+        except Exception as e:  # noqa: BLE001 — per-payload, not the route
+            self._client_encode_error(addr, writer, msg, e, native)
             return
         try:
             writer.write(data)
         except Exception:  # noqa: BLE001 — client gone mid-write
             log.info("dropping message to disconnected client %s", addr)
-            self.client_routes.pop(addr, None)
-            self._route_owner.pop(addr, None)
-            self._client_native.pop(addr, None)
+            self._drop_client_route(addr)
+
+    def _write_client_batch(self, addr: SiloAddress,
+                            writer: asyncio.StreamWriter,
+                            msgs: list) -> None:
+        """Batched gateway→client write: ONE ``encode_message_batch``
+        (header-prefix template on the native path) + one transport write
+        for a whole response group — the per-message path encoded and
+        wrote each response alone, the exact N-hops-per-inbound-batch
+        residue batched egress removes. Encode failures scope to one
+        message via the shared error-response fallback."""
+        native = self._client_native.get(addr, False)
+        chunks = encode_message_batch(
+            msgs,
+            lambda m, e: self._client_encode_error(addr, writer, m, e,
+                                                   native),
+            native=native, stats=self.egress_stats,
+            templates=self.response_templates)
+        if not chunks:
+            return
+        try:
+            writer.write(b"".join(chunks))
+        except Exception:  # noqa: BLE001 — client gone mid-write
+            log.info("dropping batch to disconnected client %s", addr)
+            self._drop_client_route(addr)
+
+    def deliver_group(self, target: SiloAddress, msgs: list) -> None:
+        """Batched outbound hand-off for ONE destination
+        (``MessageCenter.send_batch``): a local silo gets one
+        ``deliver_batch``, a gateway client route one batched encode +
+        write, and a remote silo one sender-queue fill (the writer task
+        wakes once and drains the whole group as a single wire batch —
+        deliberate fill, not greedy-drain luck)."""
+        if target is None:
+            log.warning("dropping %d unaddressed batched messages",
+                        len(msgs))
+            return
+        first = msgs[0]
+        if first.sending_silo is not None and \
+                (first.sending_silo.endpoint,
+                 target.endpoint) in self.partitions:
+            return  # one sender, one target: the whole group is cut
+        local = self.silos.get(target)
+        if local is not None:
+            local.message_center.deliver_batch(msgs)
+            return
+        client_writer = self.client_routes.get(target)
+        if client_writer is not None:
+            self._write_client_batch(target, client_writer, msgs)
+            return
+        if target in self.dead:
+            return
+        sender = self._senders.get(target.endpoint)
+        if sender is None:
+            sender = self._senders[target.endpoint] = _Sender(
+                self, target.endpoint)
+        q = sender.queue
+        for m in msgs:
+            q.put_nowait(m)
 
     # -- inbound connections ----------------------------------------------
     async def _handle_conn(self, silo: "Silo", reader: asyncio.StreamReader,
@@ -646,8 +740,11 @@ class _GatewayConnection:
                         msg.body = SiloUnavailableError(
                             f"undecodable response: {e}")
                         self.client.deliver(msg)
-                for msg in msgs:
-                    self.client.deliver(msg)
+                if msgs:
+                    # batched correlation: contiguous response runs out of
+                    # one socket read resolve in a single
+                    # receive_response_batch pass (one freelist sweep)
+                    self.client.deliver_batch(msgs)
         except (ConnectionResetError, OSError):
             pass
         except FrameError as e:

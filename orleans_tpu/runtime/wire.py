@@ -36,9 +36,11 @@ from ..core.ids import SiloAddress
 from ..core.message import Message
 from ..core.serialization import deserialize, serialize, serialize_portable
 from ..observability.stats import COUNT_BOUNDS as _COUNT_BOUNDS
+from ..observability.stats import EGRESS_STATS as _EGRESS
 from ..observability.stats import INGEST_STATS as _INGEST
 from ..observability.stats import SIZE_BOUNDS as _SIZE_BOUNDS
 
+_EGRESS_ENCODE = _EGRESS["encode"]
 _DECODE_SECONDS = _INGEST["decode"]
 _DECODE_BYTES = _INGEST["decode_bytes"]
 _FRAMES = _INGEST["frames"]
@@ -181,6 +183,63 @@ if _HW_FRAMES:
 # concatenated pack_frame frames; unpack_batch parses either), so every
 # mix of batched/per-frame/pickle peers interoperates.
 _HW_BATCH = _HW_FRAMES and hasattr(_ser._hotwire, "pack_batch")
+# Header-prefix template mode (hotwire.c make_header_template/
+# pack_batch_tmpl): responses within one egress group share an invariant
+# header prefix per (sending-silo, target-silo, kind); the template
+# memcpys the pre-encoded invariant runs and patches only the varying
+# fields — byte-identical to pack_frame (property-tested).
+_HW_TMPL = _HW_BATCH and hasattr(_ser._hotwire, "pack_batch_tmpl")
+
+# The per-message (varying) header fields of a batched response frame:
+# correlation id, the grain/activation endpoints the response swaps back,
+# the per-class method identity, the result discriminator, and the
+# per-message stamps (trace-context wall stamp from _stamp_response, txn
+# joins from _attach_txn_joins) — everything else is invariant across a
+# response group for one (sending_silo, target_silo, category) key and
+# rides the memcpy'd template. Sampled responses therefore batch
+# IDENTICALLY (their request_context is a varying field); only headers
+# the template cannot carry — rejections, forwarded/resent or
+# chain-carrying envelopes — peel to the per-frame encoder below.
+_RESPONSE_VAR_SLOTS = frozenset((
+    "id", "sending_grain", "sending_activation", "target_grain",
+    "target_activation", "interface_name", "method_name", "response_kind",
+    "is_read_only", "request_context", "transaction_info",
+    "interface_version"))
+_RESPONSE_VAR_IDX = tuple(i for i, s in enumerate(_HEADER_SLOTS)
+                          if s in _RESPONSE_VAR_SLOTS)
+
+# (sending_silo, target_silo, category) -> pre-encoded chunk tuple.
+# Bounded: a cluster only ever sees O(silos + clients) keys, but a
+# pathological key churn (client generations) must not grow it forever.
+_TMPL_CACHE: dict = {}
+_TMPL_CACHE_CAP = 512
+
+
+def _response_template(m: Message):
+    """The cached header-prefix template for ``m``, or None when the
+    message must take the per-frame encoder (not a response, or carrying
+    headers the template's invariant runs can't represent)."""
+    if m.direction != Direction.RESPONSE:
+        return None
+    if (m.rejection_type is not None or m.rejection_info is not None
+            or m.forward_count or m.resend_count or m.call_chain
+            or m.is_always_interleave or m.is_unordered or not m.immutable
+            or m.cache_invalidation is not None or m.is_new_placement):
+        return None  # peel: headers outside the invariant constants
+    key = (m.sending_silo, m.target_silo, m.category)
+    t = _TMPL_CACHE.get(key)
+    if t is None:
+        if len(_TMPL_CACHE) >= _TMPL_CACHE_CAP:
+            _TMPL_CACHE.clear()
+        try:
+            t = _TMPL_CACHE[key] = _ser._hotwire.make_header_template(
+                m, _RESPONSE_VAR_IDX)
+        except Exception:  # noqa: BLE001 — unencodable invariant field:
+            return None    # the per-frame path owns the error semantics
+    return t
+
+
+_NO_RUN = object()  # run-splitting sentinel (a template is never this)
 
 
 def encode_message(msg: Message, native: bool = True) -> bytes:
@@ -293,19 +352,33 @@ class _BodyDecodeError(WireDecodeError):
 # Frame batches (the batched-ingress wire unit)
 # ---------------------------------------------------------------------------
 
-def encode_message_batch(msgs: list, bounce, native: bool = True) -> list:
-    """Encode a send batch into wire chunks: one contiguous frame-batch
-    buffer (a single ``pack_batch`` C call) on the native path, else one
-    chunk per message. Per-message encode failures route to ``bounce``
-    (scoped to the message, never the connection), matching
+def encode_message_batch(msgs: list, bounce, native: bool = True,
+                         stats=None, templates: bool = True) -> list:
+    """Encode a send batch into wire chunks: contiguous frame-batch
+    buffers (``pack_batch`` C calls) on the native path, else one chunk
+    per message. Per-message encode failures route to ``bounce`` (scoped
+    to the message, never the connection), matching
     :func:`encode_message`; a batch-level native failure falls back to the
     per-message path so the failing message is identified and bounced
-    alone. Output bytes are identical either way."""
+    alone. Output bytes are identical either way.
+
+    ``templates`` (native path only): contiguous runs of responses whose
+    headers a cached prefix template can carry encode via
+    ``pack_batch_tmpl`` — the invariant header runs are memcpy'd and only
+    correlation id / stamps / body splice encode per message (the PR-3
+    SocketManager pooled-buffer carry-over). Requests pay ONE direction
+    check for this. ``stats`` (metrics-enabled egress writers): the whole
+    batch encode is timed as one ``egress.encode.seconds`` observation.
+    """
     hw = _ser._hotwire if native else None
     if hw is not None and _HW_BATCH:
         now = time.monotonic()
-        items = []
-        live = []
+        use_tmpl = templates and _HW_TMPL
+        # ordered (template | None, items) runs: FIFO on the wire is
+        # preserved because runs flush in arrival order
+        runs: list = []
+        cur_t = _NO_RUN
+        cur_items: list = []
         for m in msgs:
             try:
                 if _msg_mod._DEBUG_POOL:
@@ -316,17 +389,35 @@ def encode_message_batch(msgs: list, bounce, native: bool = True) -> list:
                 ttl = None
                 if m.expires_at is not None:
                     ttl = max(0.0, m.expires_at - now)
-                items.append((m, ttl, serialize(m.body)))
-                live.append(m)
+                body = serialize(m.body)
+                tmpl = _response_template(m) if use_tmpl else None
             except Exception as e:  # noqa: BLE001 — per-message body failure
                 bounce(m, e)
-        if not items:
-            return []
-        try:
-            return [hw.pack_batch(items)]
-        except Exception:  # noqa: BLE001 — a header refused batch encode:
-            # retry per-message below so the failure scopes to one frame
-            msgs = live
+                continue
+            if tmpl is not cur_t:
+                cur_items = []
+                runs.append((tmpl, cur_items))
+                cur_t = tmpl
+            cur_items.append((m, ttl, body))
+        chunks = []
+        for tmpl, items in runs:
+            try:
+                if tmpl is None:
+                    chunks.append(hw.pack_batch(items))
+                else:
+                    chunks.append(hw.pack_batch_tmpl(
+                        tmpl, _RESPONSE_VAR_IDX, items))
+            except Exception:  # noqa: BLE001 — a header refused batch
+                # encode: retry per-message so the failure scopes to one
+                # frame (bodies re-serialize; this path is rare)
+                for m, _ttl, _body in items:
+                    try:
+                        chunks.append(encode_message(m, native=native))
+                    except Exception as e:  # noqa: BLE001
+                        bounce(m, e)
+        if stats is not None and chunks:
+            stats.observe(_EGRESS_ENCODE, time.monotonic() - now)
+        return chunks
     chunks = []
     for m in msgs:
         try:

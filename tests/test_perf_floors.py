@@ -316,3 +316,30 @@ async def test_floor_call_batch():
         f"call_batch only {ratio:.2f}x over per-message senders " \
         f"(floor {CALL_BATCH_MARGIN}x) — deliberate batching is not " \
         f"engaging"
+
+
+# Batched egress vs per-message responses, vector-only closed loop
+# (ISSUE 10): identical call_batch senders, silos differing only in
+# batched_egress — measured 1.25-1.8x on this container (one grouped
+# encode_message_batch client-route write + one receive_response_batch
+# correlation pass per inbound batch, vs N per-message send_response →
+# encode → write hops). 1.2x trips only when the egress pipeline stops
+# engaging (e.g. the flush accumulator silently degrading to singleton
+# groups). A same-process ratio: interpreter speed cancels out.
+BATCHED_EGRESS_MARGIN = 1.2
+
+
+async def test_floor_batched_egress():
+    from benchmarks import ingest_attribution
+
+    async def once():
+        r = await ingest_attribution.run_egress_ab(seconds=1.0)
+        return r["value"]
+
+    ratio = await once()
+    if ratio < BATCHED_EGRESS_MARGIN * 1.25:
+        ratio = max(ratio, await once())
+    assert ratio >= BATCHED_EGRESS_MARGIN, \
+        f"batched egress only {ratio:.2f}x over per-message responses " \
+        f"(floor {BATCHED_EGRESS_MARGIN}x) — the response-path pipeline " \
+        f"is not engaging"
