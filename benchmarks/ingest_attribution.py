@@ -91,12 +91,21 @@ def _make_vector_grain():
     return EchoVec
 
 
+async def connect_clients(ep: str, n: int) -> list:
+    """N gateway connections to one silo endpoint (multi-loop harness
+    wiring: each connection pins to one ingress shard, so A/B points
+    drive >= 2 on both sides). ONE definition shared with
+    loop_attribution — the two harnesses must not drift."""
+    return [await GatewayClient([ep]).connect() for _ in range(max(1, n))]
+
+
 async def run(seconds: float = 2.0, concurrency: int = 32,
               n_grains: int = 64, n_keys: int = 64,
               batched: bool = True, offloop: bool = True,
               call_batch: bool = False,
               call_batch_size: int = 16,
-              egress: bool = True) -> dict:
+              egress: bool = True, ingress_loops: int = 1,
+              n_clients: int = 1) -> dict:
     """One silo over real TCP, metrics on, mixed host + device traffic;
     returns the stage breakdown in the BENCH extra. ``batched=False``
     flips the silo to the per-frame ingest path, ``offloop=False`` to
@@ -104,7 +113,11 @@ async def run(seconds: float = 2.0, concurrency: int = 32,
     response path (the three A/B levers).
     ``call_batch=True`` switches the vector workers from per-message
     awaited pings to deliberate ``client.call_batch`` groups of
-    ``call_batch_size`` — the sender-side half of the pump share."""
+    ``call_batch_size`` — the sender-side half of the pump share.
+    ``ingress_loops>=2`` runs the multi-loop silo (ISSUE 11) with
+    ``n_clients`` gateway connections feeding its shards — the
+    queue-wait share under multi-loop is this harness's acceptance
+    read."""
     import numpy as np
 
     from orleans_tpu.dispatch import add_vector_grains
@@ -116,16 +129,20 @@ async def run(seconds: float = 2.0, concurrency: int = 32,
          .add_grains(EchoGrain)
          .with_config(metrics_enabled=True, metrics_sample_period=0.25,
                       batched_ingress=batched, offloop_tick=offloop,
-                      batched_egress=egress))
+                      batched_egress=egress, ingress_loops=ingress_loops))
     add_vector_grains(b, EchoVec, mesh=make_mesh(1),
                       dense={EchoVec: n_keys})
     silo = b.build()
     await silo.start()
-    client = await GatewayClient([silo.silo_address.endpoint]).connect()
-    client.batched_egress = egress  # client-correlation half of the lever
+    clients = await connect_clients(silo.silo_address.endpoint, n_clients)
+    client = clients[0]
+    for c in clients:
+        c.batched_egress = egress  # client-correlation half of the lever
     try:
-        host_refs = [client.get_grain(EchoGrain, k) for k in range(n_grains)]
-        vec_refs = [client.get_grain(EchoVec, k) for k in range(n_keys)]
+        host_refs = [clients[k % len(clients)].get_grain(EchoGrain, k)
+                     for k in range(n_grains)]
+        vec_refs = [clients[k % len(clients)].get_grain(EchoVec, k)
+                    for k in range(n_keys)]
         # warmup: activate host grains, compile the vector kernel
         await asyncio.gather(*(g.ping(0) for g in host_refs))
         await asyncio.gather(*(v.ping(x=np.int32(0)) for v in vec_refs[:8]))
@@ -192,7 +209,8 @@ async def run(seconds: float = 2.0, concurrency: int = 32,
         group_h = hists.get(EGRESS_STATS["group"], {})
         responses = snap["counters"].get(EGRESS_STATS["responses"], 0)
     finally:
-        await client.close_async()
+        for c in clients:
+            await c.close_async()
         await silo.stop()
     return {
         "metric": "ingest_attribution_msgs_per_sec",
@@ -203,6 +221,7 @@ async def run(seconds: float = 2.0, concurrency: int = 32,
             "seconds": seconds, "concurrency": concurrency,
             "batched": batched, "offloop": offloop,
             "call_batch": call_batch, "egress": egress,
+            "ingress_loops": ingress_loops, "n_clients": n_clients,
             "calls": calls,
             "stage_seconds": {k: round(v, 4)
                               for k, v in stage_seconds.items()},

@@ -81,6 +81,14 @@ def main() -> None:
     # ratios floored in test_floor_offloop_tick
     print(json.dumps(asyncio.run(loop_attribution.run_ab(
         seconds=2.0, concurrency=32))))
+    # multi-loop silo A/B (ISSUE 11): 1 vs 2 ingress pump loops on
+    # identical mixed TCP traffic over 2 gateway connections — the
+    # main-loop pump share sheds onto the shard threads (structural
+    # signal, measured ~0.55-0.72x); the msgs/sec ratio is only
+    # meaningful on a genuinely multi-core runner (>=1.7x target,
+    # gated in test_floor_multiloop by a parallelism probe)
+    print(json.dumps(asyncio.run(loop_attribution.run_multiloop_ab(
+        seconds=2.0, concurrency=32))))
     # deliberate client-side batching vs per-message senders, vector-only
     # (isolates the sender-side win from the mixed harness's host/vec
     # mix shift; measured ~1.5-1.8x, CI floor 1.2x)

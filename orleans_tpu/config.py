@@ -65,6 +65,11 @@ class MessagingOptions:
     max_enqueued_requests: int = 5000
     max_request_processing_time: float = 60.0
     batched_ingress: bool = True
+    # multi-loop silo ingress (runtime.multiloop): N >= 2 spawns N
+    # dedicated pump threads with their own event loops (sharded
+    # ingress + SPSC hand-off rings, PING/SYSTEM bypassing the rings);
+    # 1 (default) keeps the single-loop in-loop pump bit for bit
+    ingress_loops: int = 1
     # batched response egress (runtime.egress flush accumulator +
     # header-prefix wire template): ``batched_egress=False`` restores
     # the per-message send_response → transmit path — the A/B lever
@@ -81,7 +86,12 @@ class MessagingOptions:
         # is a legitimate fast-abandon configuration (the activation is
         # rebuilt while queued callers still wait within their timeout)
         _positive(self, "response_timeout", "max_enqueued_requests",
-                  "max_request_processing_time")
+                  "max_request_processing_time", "ingress_loops")
+        if not isinstance(self.ingress_loops, int) or \
+                self.ingress_loops > 64:
+            raise ConfigurationError(
+                f"ingress_loops must be an int in [1, 64], got "
+                f"{self.ingress_loops!r}")
 
 
 @dataclass
@@ -353,6 +363,7 @@ _FLAT_MAP = {
     "max_request_processing_time": (MessagingOptions,
                                     "max_request_processing_time"),
     "batched_ingress": (MessagingOptions, "batched_ingress"),
+    "ingress_loops": (MessagingOptions, "ingress_loops"),
     "batched_egress": (MessagingOptions, "batched_egress"),
     "offloop_tick": (MessagingOptions, "offloop_tick"),
     "turn_warning_length": (SchedulingOptions, "turn_warning_length"),

@@ -171,6 +171,14 @@ class SiloControl:
             return {}
         out = lp.profile(windows, snapshots=snapshots)
         out["silo"] = self.silo.config.name
+        pool = self.silo.ingress_pool
+        if pool is not None:
+            # multi-loop silo: the profiler installs PER LOOP, so each
+            # ingress shard carries its own occupancy profile — surfaced
+            # beside the main loop's for per-loop attribution (the
+            # ctl_loop_profile aggregation the tentpole design promised)
+            out["ingress_loops"] = await pool.loop_profiles(
+                windows=min(windows, 8))
         return out
 
     async def ctl_histogram(self, name: str) -> dict | None:

@@ -30,6 +30,11 @@
 #include <Python.h>
 #include <stdint.h>
 #include <string.h>
+#ifndef MS_WINDOWS
+#include <errno.h>
+#include <sys/socket.h>
+#include <sys/uio.h>
+#endif
 
 #define HW_MAGIC 0xA7
 #define HW_VERSION 0x01
@@ -1273,25 +1278,17 @@ static PyObject *hw_unpack_header(PyObject *self, PyObject *args) {
  * still delimits it); an oversized frame announcement raises — the
  * stream is hostile/misaligned and the connection must drop, exactly
  * like the per-frame path. */
-static PyObject *hw_unpack_batch(PyObject *self, PyObject *args) {
-    PyObject *data, *msg_cls;
-    if (!PyArg_ParseTuple(args, "OO", &data, &msg_cls))
-        return NULL;
-    if (!g_state.hdr_configured) {
-        PyErr_SetString(PyExc_RuntimeError,
-                        "hotwire: headers not configured");
-        return NULL;
-    }
-    if (!PyType_Check(msg_cls)) {
-        PyErr_SetString(PyExc_TypeError, "unpack_batch: msg_cls not a type");
-        return NULL;
-    }
-    Py_buffer view;
-    if (PyObject_GetBuffer(data, &view, PyBUF_SIMPLE) < 0) return NULL;
-    const uint8_t *base = (const uint8_t *)view.buf;
-    Py_ssize_t len = view.len, pos = 0;
+/* Shared parse core of unpack_batch and sock_recv_batch: walk every
+ * complete frame in [base, base+len), appending entries (see the
+ * unpack_batch docstring for the entry shapes).  Returns the entry list
+ * and sets *consumed_out; NULL with an exception set on a hostile
+ * leading announcement or allocation failure. */
+static PyObject *unpack_span_batch(const uint8_t *base, Py_ssize_t len,
+                                   PyObject *msg_cls,
+                                   Py_ssize_t *consumed_out) {
+    Py_ssize_t pos = 0;
     PyObject *out = PyList_New(0);
-    if (!out) { PyBuffer_Release(&view); return NULL; }
+    if (!out) return NULL;
     while (len - pos >= 8) {
         uint32_t hlen = (uint32_t)base[pos] | ((uint32_t)base[pos + 1] << 8) |
                         ((uint32_t)base[pos + 2] << 16) |
@@ -1355,7 +1352,33 @@ static PyObject *hw_unpack_batch(PyObject *self, PyObject *args) {
         if (rc < 0) goto fail;
         pos += total;
     }
+    *consumed_out = pos;
+    return out;
+fail:
+    Py_DECREF(out);
+    return NULL;
+}
+
+static PyObject *hw_unpack_batch(PyObject *self, PyObject *args) {
+    PyObject *data, *msg_cls;
+    if (!PyArg_ParseTuple(args, "OO", &data, &msg_cls))
+        return NULL;
+    if (!g_state.hdr_configured) {
+        PyErr_SetString(PyExc_RuntimeError,
+                        "hotwire: headers not configured");
+        return NULL;
+    }
+    if (!PyType_Check(msg_cls)) {
+        PyErr_SetString(PyExc_TypeError, "unpack_batch: msg_cls not a type");
+        return NULL;
+    }
+    Py_buffer view;
+    if (PyObject_GetBuffer(data, &view, PyBUF_SIMPLE) < 0) return NULL;
+    Py_ssize_t pos = 0;
+    PyObject *out = unpack_span_batch((const uint8_t *)view.buf, view.len,
+                                      msg_cls, &pos);
     PyBuffer_Release(&view);
+    if (!out) return NULL;
     {
         PyObject *consumed = PyLong_FromSsize_t(pos);
         if (!consumed) { Py_DECREF(out); return NULL; }
@@ -1364,11 +1387,136 @@ static PyObject *hw_unpack_batch(PyObject *self, PyObject *args) {
         Py_DECREF(out);
         return res;
     }
+}
+
+#ifndef MS_WINDOWS
+/* sock_recv_batch(fd, tail, msg_cls, bufsize=65536)
+ *     -> (entries, tail2, eof, nrecv)  |  None when not readable
+ *
+ * The vectored receive pump: ONE C call per socket-ready event replaces
+ * the Python recv -> buffer-append -> decode_frames chain.  The previous
+ * read's partial-frame ``tail`` and a fresh ``recv`` (GIL released
+ * around the syscall) are parsed in a single pass through the same frame
+ * walk as ``unpack_batch``; ``tail2`` is the new partial remainder and
+ * ``eof`` is True on an orderly shutdown (recv() == 0).  EAGAIN returns
+ * None — the caller waits for readability and calls again.  A hostile
+ * leading announcement raises ValueError exactly like ``unpack_batch``
+ * (frames parsed ahead of one were already returned by the PREVIOUS
+ * call; the caller also screens ``tail2`` with ``leads_hostile_frame``
+ * so a peer that never sends another byte still drops promptly). */
+static PyObject *hw_sock_recv_batch(PyObject *self, PyObject *args) {
+    int fd;
+    Py_buffer tail;
+    PyObject *msg_cls;
+    Py_ssize_t bufsize = 1 << 16;
+    if (!PyArg_ParseTuple(args, "iy*O|n", &fd, &tail, &msg_cls, &bufsize))
+        return NULL;
+    if (!g_state.hdr_configured || !PyType_Check(msg_cls) || bufsize <= 0) {
+        PyBuffer_Release(&tail);
+        PyErr_SetString(PyExc_ValueError,
+                        "sock_recv_batch: headers not configured / bad args");
+        return NULL;
+    }
+    char *buf = PyMem_Malloc(tail.len + bufsize);
+    if (!buf) { PyBuffer_Release(&tail); return PyErr_NoMemory(); }
+    if (tail.len)
+        memcpy(buf, tail.buf, tail.len);
+    Py_ssize_t tlen = tail.len;
+    PyBuffer_Release(&tail);
+    ssize_t n;
+    Py_BEGIN_ALLOW_THREADS
+    do {
+        n = recv(fd, buf + tlen, (size_t)bufsize, 0);
+    } while (n < 0 && errno == EINTR);
+    Py_END_ALLOW_THREADS
+    if (n < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+            PyMem_Free(buf);
+            Py_RETURN_NONE;
+        }
+        PyErr_SetFromErrno(PyExc_OSError);
+        PyMem_Free(buf);
+        return NULL;
+    }
+    {
+        Py_ssize_t total = tlen + (Py_ssize_t)n;
+        Py_ssize_t consumed = 0;
+        PyObject *entries = unpack_span_batch((const uint8_t *)buf, total,
+                                              msg_cls, &consumed);
+        if (!entries) { PyMem_Free(buf); return NULL; }
+        PyObject *tail2 = PyBytes_FromStringAndSize(buf + consumed,
+                                                    total - consumed);
+        PyMem_Free(buf);
+        if (!tail2) { Py_DECREF(entries); return NULL; }
+        PyObject *nrecv = PyLong_FromSsize_t((Py_ssize_t)n);
+        if (!nrecv) { Py_DECREF(entries); Py_DECREF(tail2); return NULL; }
+        PyObject *res = PyTuple_Pack(4, entries, tail2,
+                                     n == 0 ? Py_True : Py_False, nrecv);
+        Py_DECREF(entries);
+        Py_DECREF(tail2);
+        Py_DECREF(nrecv);
+        return res;
+    }
+}
+
+/* sock_writev(fd, chunks) -> bytes written
+ *
+ * The vectored egress half: one ``writev`` syscall (GIL released) sends
+ * a whole encode_message_batch chunk list without the Python-level
+ * b"".join copy.  May write a PARTIAL prefix (kernel buffer full) — the
+ * caller computes the remainder and falls back to its buffered path.
+ * Raises BlockingIOError when nothing could be written (EAGAIN), OSError
+ * on a dead socket.  At most IOV_MAX chunks ride one call; the caller
+ * loops for longer lists. */
+#ifndef IOV_MAX
+#define IOV_MAX 1024
+#endif
+static PyObject *hw_sock_writev(PyObject *self, PyObject *args) {
+    int fd;
+    PyObject *arg;
+    if (!PyArg_ParseTuple(args, "iO", &fd, &arg))
+        return NULL;
+    PyObject *seq = PySequence_Fast(arg, "sock_writev: want a sequence of "
+                                         "bytes chunks");
+    if (!seq) return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    if (n > IOV_MAX)
+        n = IOV_MAX;
+    struct iovec *iov = PyMem_Malloc((n ? n : 1) * sizeof(struct iovec));
+    Py_buffer *views = PyMem_Calloc(n ? n : 1, sizeof(Py_buffer));
+    if (!iov || !views) {
+        PyMem_Free(iov); PyMem_Free(views); Py_DECREF(seq);
+        return PyErr_NoMemory();
+    }
+    Py_ssize_t got = 0;
+    ssize_t sent = 0;
+    for (; got < n; got++) {
+        if (PyObject_GetBuffer(PySequence_Fast_GET_ITEM(seq, got),
+                               &views[got], PyBUF_SIMPLE) < 0)
+            goto fail;
+        iov[got].iov_base = views[got].buf;
+        iov[got].iov_len = (size_t)views[got].len;
+    }
+    Py_BEGIN_ALLOW_THREADS
+    do {
+        sent = writev(fd, iov, (int)n);
+    } while (sent < 0 && errno == EINTR);
+    Py_END_ALLOW_THREADS
+    if (sent < 0) {
+        PyErr_SetFromErrno(PyExc_OSError);  /* EAGAIN -> BlockingIOError */
+        goto fail;
+    }
+    for (Py_ssize_t i = 0; i < got; i++)
+        PyBuffer_Release(&views[i]);
+    PyMem_Free(iov); PyMem_Free(views); Py_DECREF(seq);
+    return PyLong_FromSsize_t((Py_ssize_t)sent);
 fail:
-    Py_DECREF(out);
-    PyBuffer_Release(&view);
+    for (Py_ssize_t i = 0; i < got; i++)
+        PyBuffer_Release(&views[i]);
+    PyMem_Free(iov); PyMem_Free(views); Py_DECREF(seq);
     return NULL;
 }
+#endif /* !MS_WINDOWS */
 
 static PyMethodDef hw_methods[] = {
     {"dumps", hw_dumps, METH_O,
@@ -1398,6 +1546,15 @@ static PyMethodDef hw_methods[] = {
     {"unpack_batch", hw_unpack_batch, METH_VARARGS,
      "unpack_batch(data, msg_cls) -> (consumed, entries): decode every "
      "complete frame out of one receive buffer."},
+#ifndef MS_WINDOWS
+    {"sock_recv_batch", hw_sock_recv_batch, METH_VARARGS,
+     "sock_recv_batch(fd, tail, msg_cls, bufsize=65536) -> "
+     "(entries, tail2, eof, nrecv) | None: one recv + frame-batch "
+     "decode per socket-ready event."},
+    {"sock_writev", hw_sock_writev, METH_VARARGS,
+     "sock_writev(fd, chunks) -> bytes written: vectored send of an "
+     "encoded chunk list (partial writes possible)."},
+#endif
     {"configure", hw_configure, METH_VARARGS,
      "configure(GrainId, cat_members, SiloAddress, ActivationId, "
      "ActivationAddress, pickle_dumps, restricted_loads)"},

@@ -44,6 +44,7 @@ check per site, and the off path is structurally zero-overhead.
 
 from __future__ import annotations
 
+import asyncio
 import contextlib
 import contextvars
 import functools
@@ -119,6 +120,15 @@ LOOP_CATEGORIES = (
 # each callback start.
 LOOP_CATEGORY: contextvars.ContextVar[str] = contextvars.ContextVar(
     "orleans_loop_category", default="other")
+
+# 3.12+ eager task factories: ``asyncio.tasks._eager_tasks`` is the
+# interpreter's registry of tasks CURRENTLY executing their first step
+# eagerly (occupied for exactly that stretch). ``enter()`` consults it
+# to guard the live-slice switch (see its docstring). On interpreters
+# without eager factories (py3.10/3.11) this is None and the guard is a
+# single constant test — the reference environment's behavior is
+# unchanged.
+_EAGER_TASKS = getattr(getattr(asyncio, "tasks", None), "_eager_tasks", None)
 
 
 def mark_loop_category(category: str) -> None:
@@ -310,14 +320,29 @@ class LoopProfiler:
         label). Returns a token for :meth:`exit` — token discipline
         mirrors the dispatcher's contextvar usage across one task.
 
-        Caveat (3.12+ eager task factories): an eagerly-executed first
-        step runs INSIDE the callback that created the task, so the
-        live-slice switch here would bleed into the creator's remaining
-        frame if the step suspends (exit only runs on completion). On
-        the py3.10 reference environment task first-steps are scheduled
-        through ``call_soon`` and the switch is exact; revisit if an
-        eager factory is ever installed alongside profiling."""
+        Eager-aware guarded boundary (3.12+ eager task factories): an
+        eagerly-executed first step runs INSIDE the callback that
+        created the task, so a live-slice switch here would bleed into
+        the creator's remaining frame if the step suspends (exit only
+        runs on completion, in a LATER callback). The guard consults the
+        interpreter's own eager-task registry (``asyncio.tasks``'
+        ``_eager_tasks``, the set a task occupies exactly while its
+        first step executes eagerly): inside an eager step the live
+        switch is DEFERRED — the contextvar alone labels the task's
+        post-suspension steps (read at each callback start), and the
+        inline stretch stays honestly booked to the creator's category,
+        which is where it physically ran. On interpreters without eager
+        factories (the py3.10 reference environment) the registry does
+        not exist, the guard is a single module-constant None test, and
+        the switch is exact as before."""
         token = LOOP_CATEGORY.set(category)
+        if _EAGER_TASKS is not None and self._depth:
+            try:
+                t = asyncio.current_task()
+            except RuntimeError:
+                t = None
+            if t is not None and t in _EAGER_TASKS:
+                return token  # deferred: guarded eager boundary
         self.set_category(category, label)
         return token
 

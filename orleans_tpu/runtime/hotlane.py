@@ -123,6 +123,20 @@ def _gate_admits(act: "ActivationData", inv, is_read_only: bool,
             or grain_id in chain)
 
 
+def _pick_stateless_replica(acts):
+    """Cheap replica choice for a StatelessWorker set (the ROADMAP
+    carry-over): the first VALID replica with nothing running — an idle
+    replica trivially admits, so no gate walk or load compare is needed.
+    None when every replica is busy/transitioning: the call then takes
+    the messaging path, where the catalog's least-loaded pick and
+    auto-scale (maybe_add_stateless_replica) stay authoritative — the
+    lane never grows or queues on a replica set itself."""
+    for a in acts:
+        if a.state is ActivationState.VALID and not a.running:
+            return a
+    return None
+
+
 def try_hot_invoke(client, silo: "Silo", grain_id, grain_class: type,
                    interface_name: str, method_name: str,
                    args: tuple, kwargs: dict, is_read_only: bool):
@@ -131,14 +145,22 @@ def try_hot_invoke(client, silo: "Silo", grain_id, grain_class: type,
     is the RuntimeClient the call originates from (its filters/tracer
     gate the lane; its counters record the outcome)."""
     acts = silo.catalog.by_grain.get(grain_id)
-    if not acts or len(acts) != 1:
+    if not acts:
         return None
     act = acts[0]
-    if act.state is not ActivationState.VALID:
-        return None  # activating/deactivating/migration-fenced/invalid
     entry = silo.invokers.entry(act.grain_class)
     if not entry.hot_ok or client.outgoing_call_filters:
         return None
+    if entry.stateless_cap:
+        # StatelessWorker: serve an idle replica inline, hand busy sets
+        # to the messaging path (catalog replica pick + auto-scale)
+        act = _pick_stateless_replica(acts)
+        if act is None:
+            return None
+    elif len(acts) != 1:
+        return None  # duplicate-activation race on a single-activation grain
+    if act.state is not ActivationState.VALID:
+        return None  # activating/deactivating/migration-fenced/invalid
     inv = entry.methods.get(method_name)
     if inv is None or inv.is_one_way:
         return None

@@ -48,7 +48,7 @@ class ClassInvokers:
     """Invoker set for one grain class under one silo filter-state."""
 
     __slots__ = ("cls", "methods", "silo_chain", "class_filtered",
-                 "hot_ok", "nfilters", "version")
+                 "hot_ok", "stateless_cap", "nfilters", "version")
 
     def __init__(self, cls: type, silo_filters: list):
         self.cls = cls
@@ -62,12 +62,16 @@ class ClassInvokers:
             getattr(cls, "on_incoming_call", None) is not None
         # hot-lane eligibility, the class-level half: ordinary Grain
         # subclasses only (system targets / vector classes take the full
-        # path), no stateless-worker replica sets (their replica pick and
-        # auto-scale live in the catalog), no filters of any kind
+        # path), no filters of any kind. Stateless-worker replica sets
+        # ARE eligible since the lane learned a cheap replica pick
+        # (hotlane._pick_stateless_replica) — ``stateless_cap`` carries
+        # the local replica cap so the lane serves IDLE replicas and
+        # hands busy sets back to the catalog (whose least-loaded pick
+        # and auto-scale semantics stay authoritative).
+        self.stateless_cap = getattr(cls, "__orleans_stateless_worker__", 0)
         self.hot_ok = (not self.silo_chain
                        and not self.class_filtered
-                       and isinstance(cls, type) and issubclass(cls, Grain)
-                       and not getattr(cls, "__orleans_stateless_worker__", 0))
+                       and isinstance(cls, type) and issubclass(cls, Grain))
         # revalidation tokens
         self.nfilters = len(silo_filters)
         self.version = getattr(cls, "__orleans_version__", 0)

@@ -1,0 +1,785 @@
+"""Multi-loop silo ingress: sharded pump threads + SPSC hand-off rings.
+
+The PR 6-10 batching campaign squeezed per-message cost at every
+boundary, and BENCH_r10 still showed ``queue_wait`` at ~0.9 of
+per-message stage time at c=32 saturation with the socket pump at
+0.33-0.57 of loop wall: **one Python event loop per silo multiplexing
+pump + turns + client machinery is the wall**. The reference runtime
+never funnels a silo's messaging through one thread — SocketManager
+runs dedicated send/receive threads and MessageCenter fans work across
+them (SocketManager.cs:1-261, IncomingMessageAcceptor.cs:12).
+
+This module is the asyncio re-design of that split:
+
+* ``IngressLoopPool`` — N ``IngressShard`` threads, each running its
+  OWN event loop with its own socket pump. The silo's listener accepts
+  on the main loop and hands each accepted socket round-robin to a
+  shard (the listener-thread hand-off form of the reference's
+  SO_REUSEPORT/acceptor-thread pattern; one process needs no
+  SO_REUSEPORT since a single listener can feed every loop).
+* Each shard's pump is **vectored**: one ``hotwire.sock_recv_batch`` C
+  call per socket-ready event does the recv syscall (GIL released)
+  AND the frame-batch decode straight into Message shells — replacing
+  the Python recv → buffer-append → decode chain. Without the native
+  build (``ORLEANS_TPU_NATIVE=0``) a byte-identical Python fallback
+  (``sock_recv`` + ``decode_frames``) pumps the same frames.
+* Decoded batches ride a lock-free **SPSC hand-off ring** (single
+  producer: the shard thread; single consumer: the silo's main loop)
+  with a coalesced ``call_soon_threadsafe`` wakeup, landing in ONE
+  ``deliver_batch`` per ring drain entry — so the main loop's share of
+  a message shrinks to routing + the turn itself.
+* **QoS**: PING/SYSTEM messages (membership probes, control RPCs)
+  NEVER enter the ring — each is handed to the main loop immediately
+  and individually, so a probe can never sit behind ring backpressure
+  or a drain of thousands of application frames (the same split that
+  keeps them out of the egress flush accumulator; a probe response
+  delayed past the probe timeout gets healthy silos voted dead).
+* **Ordering**: a connection's frames stay on ONE shard for the
+  connection's lifetime and the ring is FIFO, so per-sender-per-target
+  FIFO — the only ordering the wire ever guaranteed — is preserved
+  end to end; a grain's traffic from one caller rides one connection
+  (senders and gateway clients hash grains to connections), so
+  per-grain FIFO holds across any number of ingress loops.
+* **Egress for shard-owned connections** (gateway client routes): the
+  route's writer is a :class:`ShardWriter` bound to the MAIN loop over
+  a dup'd fd — the shard owns only the READ half, so responses encode
+  AND write where the fabric already runs with ZERO cross-thread
+  hand-offs (this alone was worth ~1.7x on the closed-loop A/B vs
+  marshalling writes to the shard), vectored through
+  ``hotwire.sock_writev`` (one writev per flush group, no ``b"".join``
+  copy) with a buffered Python fallback.
+
+``SiloConfig.ingress_loops = 1`` (the default) constructs NONE of this:
+the silo keeps today's in-loop ``asyncio.start_server`` pump bit for
+bit. ``ingress_loops = N >= 2`` spawns N shard threads. In-process
+fabrics (InProcFabric) have no sockets and ignore the knob.
+
+GIL note (honest scaling bounds): the recv/writev syscalls and the
+select waits release the GIL; header decode and body deserialize hold
+it. 1→2 loops therefore overlaps socket IO and scheduling with turn
+execution rather than doubling decode throughput — the A/B ratio in
+``benchmarks/loop_attribution.run_multiloop_ab`` is the measurement,
+and on free-threaded builds the same structure scales further.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+import time
+from collections import deque
+from typing import TYPE_CHECKING, Any
+
+from ..core import serialization as _ser
+from ..core.message import Category, Message
+from ..observability.stats import COUNT_BOUNDS, INGEST_STATS, SIZE_BOUNDS
+from .wire import (
+    _LEN,
+    MAX_FRAME_SEGMENT,
+    FrameError,
+    decode_frames,
+    decode_handshake,
+    encode_handshake,
+    finish_batch_entries,
+    leads_hostile_frame,
+)
+
+if TYPE_CHECKING:
+    from .silo import Silo
+    from .socket_fabric import SocketFabric
+
+log = logging.getLogger("orleans.multiloop")
+
+__all__ = ["IngressLoopPool", "IngressShard", "SpscRing", "ShardWriter"]
+
+# ring capacity in MESSAGES before the shard pauses its socket reads
+# (kernel buffers then backpressure the peer); drained in one consumer
+# callback, so this bounds main-loop burst size too
+_RING_CAPACITY = 16384
+_READ_SIZE = 1 << 16
+# native vectored entry points (Linux/macOS builds; absent on Windows
+# or under ORLEANS_TPU_NATIVE=0 — the Python pump is the fallback)
+_HW = _ser._hotwire
+_HW_SOCK = _HW is not None and hasattr(_HW, "sock_recv_batch")
+
+
+class SpscRing:
+    """Bounded single-producer/single-consumer hand-off ring with a
+    coalesced wakeup: ONE shard thread pushes, the silo's main loop
+    drains. ``deque`` append/popleft are GIL-atomic; the armed flag
+    coalesces ``call_soon_threadsafe`` wakeups to one per burst (the
+    drain clears the flag BEFORE popping, so a push racing the drain
+    either lands in the current sweep or re-arms — never lost)."""
+
+    __slots__ = ("_items", "_consumer_loop", "_drain_cb", "_armed",
+                 "pushed_msgs", "drained_msgs", "drained_batches")
+
+    def __init__(self, consumer_loop, drain_cb):
+        self._items: deque = deque()
+        self._consumer_loop = consumer_loop
+        self._drain_cb = drain_cb
+        self._armed = False
+        # backlog = pushed - drained: each counter has exactly ONE
+        # writer (producer / consumer), so no read-modify-write ever
+        # races; the other side only reads (torn-free under the GIL)
+        self.pushed_msgs = 0
+        self.drained_msgs = 0
+        self.drained_batches = 0
+
+    def push(self, item, n_msgs: int) -> None:
+        """Producer side (shard thread only)."""
+        self._items.append(item)
+        self.pushed_msgs += n_msgs
+        if not self._armed:
+            self._armed = True
+            self._consumer_loop.call_soon_threadsafe(self._drain)
+
+    def _drain(self) -> None:
+        """Consumer side (main loop only)."""
+        self._armed = False
+        items = self._items
+        while True:
+            try:
+                item = items.popleft()
+            except IndexError:
+                return
+            self.drained_msgs += item[0]
+            self.drained_batches += 1
+            try:
+                self._drain_cb(item)
+            except Exception:  # noqa: BLE001 — same contract as the pump
+                log.exception("ring drain failed")
+
+    def drain_now(self) -> None:
+        """Final consumer-side sweep at shutdown (producers stopped):
+        whatever the armed callback never got to runs inline so no
+        decoded message is dropped — the clean-shutdown drain."""
+        self._drain()
+
+    def backlog(self) -> int:
+        return self.pushed_msgs - self.drained_msgs
+
+
+async def _read_handshake_frame(loop, sock) -> tuple[bytes, bytes]:
+    """Read ONE length-prefixed frame from a raw non-blocking socket
+    (the connection-opening handshake); returns (headers, leftover) —
+    any bytes the peer pipelined behind the handshake seed the pump's
+    tail. Raises FrameError on a hostile announcement, ConnectionError
+    on EOF mid-frame."""
+    buf = bytearray()
+    while True:
+        if len(buf) >= 8:
+            hlen, blen = _LEN.unpack_from(buf, 0)
+            if hlen > MAX_FRAME_SEGMENT or blen > MAX_FRAME_SEGMENT:
+                raise FrameError(f"oversized frame announced: {hlen}+{blen}")
+            total = 8 + hlen + blen
+            if len(buf) >= total:
+                return bytes(buf[8:8 + hlen]), bytes(buf[total:])
+        chunk = await loop.sock_recv(sock, _READ_SIZE)
+        if not chunk:
+            raise ConnectionError("EOF during handshake")
+        buf += chunk
+
+
+class ShardWriter:
+    """Writer for the client route of a shard-owned connection, bound
+    to the silo's MAIN loop over a dup'd fd: the shard thread owns the
+    READ half of the socket; responses are encoded AND written on the
+    main loop (where the fabric's client-route paths already run), so
+    the response path pays ZERO cross-thread hand-offs. The dup keeps
+    the write fd safe against kernel fd-number reuse after the shard
+    closes its half; writes to a peer-closed socket surface as EPIPE
+    and drop the route exactly like the StreamWriter path. Egress is
+    vectored: one ``sock_writev`` per flush group on the native build
+    (no ``b"".join`` copy), buffered ``sock_sendall`` otherwise.
+    Mirrors the StreamWriter surface the fabric uses
+    (``write``/``close``/``is_closing``)."""
+
+    __slots__ = ("_loop", "_sock", "_chunks", "_sending", "_task",
+                 "_closed", "on_error")
+
+    def __init__(self, main_loop, sock):
+        self._loop = main_loop
+        # portable duplicate of the WRITE half: socket.dup() (not
+        # os.dup on the raw fd — fds aren't WinSock handles on Windows)
+        self._sock = sock.dup()
+        self._sock.setblocking(False)
+        self._chunks: list = []
+        self._sending = False
+        self._task = None         # in-flight _send_loop task
+        self._closed = False
+        self.on_error = None      # main-loop thunk: route cleanup
+
+    # -- main-loop surface ----------------------------------------------
+    def write(self, data: bytes) -> None:
+        if self._closed:
+            raise ConnectionResetError("shard connection closed")
+        self._chunks.append(data)
+        if not self._sending:
+            self._sending = True
+            self._task = self._loop.create_task(self._send_loop())
+
+    def write_many(self, chunks: list) -> None:
+        """Batched write (``_write_client_batch``): the chunk list rides
+        to ``sock_writev`` as-is — no ``b"".join`` copy anywhere on the
+        native egress path."""
+        if self._closed:
+            raise ConnectionResetError("shard connection closed")
+        self._chunks.extend(chunks)
+        if not self._sending:
+            self._sending = True
+            self._task = self._loop.create_task(self._send_loop())
+
+    def close(self) -> None:
+        """Thread-safe: callable from the main loop (route teardown) or
+        the shard's connection handler (peer EOF)."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._loop.call_soon_threadsafe(self._do_close)
+        except RuntimeError:
+            self._do_close()  # main loop gone (process teardown)
+
+    def is_closing(self) -> bool:
+        return self._closed
+
+    def _do_close(self) -> None:
+        # cancel a send parked in sock_sendall FIRST: closing the fd
+        # silently removes it from the selector, so the writability
+        # event that future waits on would never fire and the task (plus
+        # its buffered responses) would leak for the silo's lifetime
+        t = self._task
+        if t is not None and not t.done():
+            t.cancel()
+        self._chunks.clear()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    async def _send_loop(self) -> None:
+        loop = self._loop
+        try:
+            while self._chunks and not self._closed:
+                chunks, self._chunks = self._chunks, []
+                if _HW_SOCK:
+                    # vectored egress: one writev per flush group; a
+                    # partial write falls back to buffered sendall for
+                    # the remainder (rare: kernel buffer full)
+                    try:
+                        sent = _HW.sock_writev(self._sock.fileno(), chunks)
+                    except BlockingIOError:
+                        sent = 0
+                    rest = _leftover(chunks, sent)
+                    if rest:
+                        await loop.sock_sendall(self._sock, rest)
+                else:
+                    await loop.sock_sendall(self._sock, b"".join(chunks))
+        except (OSError, ValueError) as e:
+            self._closed = True
+            log.info("shard client route write failed: %s", e)
+            hook = self.on_error
+            if hook is not None:
+                hook()
+        finally:
+            self._sending = False
+
+
+def _leftover(chunks: list, sent: int) -> bytes:
+    """The unsent suffix of a chunk list after a (possibly partial)
+    vectored write."""
+    total = 0
+    for i, c in enumerate(chunks):
+        nxt = total + len(c)
+        if sent < nxt:
+            rest = [c[sent - total:]]
+            rest.extend(chunks[i + 1:])
+            return b"".join(rest)
+        total = nxt
+    return b""
+
+
+class IngressShard(threading.Thread):
+    """ONE ingress loop: a daemon thread running its own event loop,
+    pumping the sockets assigned to it and handing decoded batches to
+    the silo's main loop over its SPSC ring. The MessageCenter ingress
+    shard of the tentpole design: routing stays sharded because a
+    connection pins here for life and grain→connection affinity is
+    hash-based at every sender."""
+
+    def __init__(self, pool: "IngressLoopPool", index: int):
+        super().__init__(name=f"{pool.silo.config.name}-ingress-{index}",
+                         daemon=True)
+        self.pool = pool
+        self.index = index
+        self.main_loop = pool.main_loop
+        self.loop = asyncio.new_event_loop()
+        self.ring = SpscRing(self.main_loop, pool._drain_entry)
+        self.profiler = None
+        self._conn_tasks: set = set()
+        self._ready = threading.Event()
+        # counters read by tests/benchmarks (single-writer: this thread)
+        self.qos_direct = 0       # PING/SYSTEM handed off ring-free
+        self.batches = 0          # ring entries pushed
+        self.frames = 0           # messages decoded on this loop
+
+    # -- thread body -----------------------------------------------------
+    def run(self) -> None:
+        asyncio.set_event_loop(self.loop)
+        cfg = self.pool.silo.config
+        if cfg.profiling_enabled:
+            # per-loop attribution: each ingress loop gets its OWN
+            # profiler (occupancy is a loop property); ctl_loop_profile
+            # aggregates them beside the main loop's. Best-effort: a
+            # failed install must not kill the shard (submit_conn drops
+            # connections of a never-ready shard on the floor)
+            try:
+                from ..observability.profiling import (
+                    install_loop_profiler, mark_loop_category)
+                self.profiler = install_loop_profiler(
+                    self.loop, window=cfg.profiling_window,
+                    ring=cfg.profiling_ring, top_k=cfg.profiling_top_k,
+                    trigger_interval=cfg.profiling_trigger_interval)
+                mark_loop_category("pump")
+            except Exception:  # noqa: BLE001
+                log.exception("ingress-loop profiler install failed; "
+                              "shard runs unprofiled")
+        self._ready.set()
+        try:
+            self.loop.run_forever()
+        finally:
+            # reap connection tasks (their finallys close the sockets
+            # and unregister client routes), then close the loop
+            pending = [t for t in self._conn_tasks if not t.done()]
+            for t in pending:
+                t.cancel()
+            if pending:
+                try:
+                    self.loop.run_until_complete(asyncio.gather(
+                        *pending, return_exceptions=True))
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+            self.loop.close()
+
+    def submit_conn(self, fabric: "SocketFabric", silo: "Silo",
+                    sock) -> None:
+        """Main-loop side: hand one accepted socket to this shard. Never
+        blocks: the pool's start() already waited for readiness — a
+        shard whose thread died before becoming ready just closes the
+        socket (the client redials another connection), it must not
+        stall the acceptor (a frozen main loop delays PING responses
+        past the probe timeout — the failure the QoS split prevents)."""
+        if self.pool.closed or not (self._ready.is_set() and
+                                    self.is_alive()):
+            sock.close()
+            if not self.pool.closed:
+                log.warning("ingress shard %s not serving; connection "
+                            "dropped", self.name)
+            return
+
+        def _start() -> None:
+            t = self.loop.create_task(self._serve_conn(fabric, silo, sock))
+            self._conn_tasks.add(t)
+            t.add_done_callback(self._conn_tasks.discard)
+
+        try:
+            self.loop.call_soon_threadsafe(_start)
+        except RuntimeError:
+            sock.close()  # shard stopped between check and submit
+
+    def stop(self) -> None:
+        try:
+            self.loop.call_soon_threadsafe(self.loop.stop)
+        except RuntimeError:
+            pass
+
+    # -- shard-loop connection handling ---------------------------------
+    async def _serve_conn(self, fabric: "SocketFabric", silo: "Silo",
+                          sock) -> None:
+        """Shard-side twin of ``SocketFabric._handle_conn``: handshake,
+        route registration, then the vectored pump."""
+        from ..observability.profiling import mark_loop_category
+        mark_loop_category("pump")
+        loop = self.loop
+        peer_addr = None
+        is_client = False
+        writer: ShardWriter | None = None
+        try:
+            headers, tail = await _read_handshake_frame(loop, sock)
+            hs = decode_handshake(headers)
+            peer_addr = hs["address"]
+            is_client = hs["kind"] == "client"
+            await loop.sock_sendall(
+                sock, encode_handshake("silo", silo.silo_address))
+            if is_client:
+                # gateway route for a shard-owned connection: the WRITE
+                # half binds to the main loop over a dup'd fd (zero
+                # cross-thread hops on the response path; one writev
+                # per flush group). Route dict mutation is MARSHALLED
+                # to the main loop — the fabric's route tables are
+                # main-loop state (unregister_silo iterates them) — and
+                # the pump does not START until the registration has
+                # RUN there: call_soon_threadsafe FIFO alone is not
+                # enough, because a ring already armed by another
+                # connection on this shard has its drain queued AHEAD
+                # of the registration callback and would route a
+                # pipelined first request (whose response then finds no
+                # route) before it. One confirmation round trip per
+                # connection setup buys the ordering for every delivery
+                # path — ring, QoS-direct, and bounce alike.
+                writer = ShardWriter(self.main_loop, sock)
+
+                def _on_err(w=writer, f=fabric, a=peer_addr):
+                    f._drop_client_route(a)
+                    w._do_close()
+
+                writer.on_error = _on_err
+                native = bool(hs.get("hotwire", False))
+                registered: asyncio.Future = loop.create_future()
+
+                def _register(f=fabric, a=peer_addr, w=writer,
+                              owner=silo.silo_address, n=native):
+                    f.client_routes[a] = w
+                    f._route_owner[a] = owner
+                    f._client_native[a] = n
+                    try:
+                        self.loop.call_soon_threadsafe(
+                            lambda: registered.done()
+                            or registered.set_result(None))
+                    except RuntimeError:
+                        pass  # shard stopping: the await below is dying
+
+                self.main_loop.call_soon_threadsafe(_register)
+                await registered
+            await self._pump(fabric, silo, sock, bytearray(tail))
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass  # clean EOF / peer died
+        except FrameError as e:
+            log.warning("dropping shard connection from %s: %s",
+                        peer_addr, e)
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001
+            log.exception("shard connection handler failed (peer=%s)",
+                          peer_addr)
+        finally:
+            if is_client and peer_addr is not None and writer is not None:
+                # route cleanup on the main loop (same marshalling rule
+                # as registration; the is-ours identity check must run
+                # where the dict is owned — a reconnected client may
+                # have re-registered a NEW route meanwhile)
+                def _cleanup(f=fabric, a=peer_addr, w=writer):
+                    if f.client_routes.get(a) is w:
+                        f.client_routes.pop(a, None)
+                        f._route_owner.pop(a, None)
+                        f._client_native.pop(a, None)
+
+                try:
+                    self.main_loop.call_soon_threadsafe(_cleanup)
+                except RuntimeError:
+                    pass  # main loop gone: process teardown
+            if writer is not None:
+                writer.close()  # releases the dup'd write fd
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    async def _pump(self, fabric, silo, sock, tail: bytearray) -> None:
+        """The sharded socket pump. Native build: a PERSISTENT reader
+        callback — one ``add_reader`` for the connection's lifetime, and
+        each socket-ready event costs exactly one vectored C call
+        (recv + frame-batch decode) plus the ring push, with no
+        coroutine resumption or per-read selector churn (the same
+        persistent ``_read_ready`` shape the transport layer uses).
+        Fallback: byte-identical ``sock_recv`` + ``decode_frames``.
+        Backpressure: when the ring backs up past capacity the pump
+        unregisters the reader (kernel buffers then slow the peer)
+        instead of growing the hand-off unboundedly."""
+        loop = self.loop
+        if tail:
+            # frames the peer pipelined behind its handshake: decode the
+            # seeded tail NOW — both pump shapes below only parse after
+            # a fresh recv, so without this a conformant peer that sent
+            # handshake+request in one burst and then waited for the
+            # response would hang until its timeout
+            consumed, msgs0, bounces0 = decode_frames(tail)
+            if consumed:
+                del tail[:consumed]
+            if msgs0 or bounces0:
+                self._deliver(fabric, silo, msgs0, bounces0, 0.0, consumed)
+            if leads_hostile_frame(tail):
+                raise FrameError("oversized frame announced")
+        if not _HW_SOCK:
+            buf = bytearray(tail)
+            while True:
+                while self.ring.backlog() > _RING_CAPACITY:
+                    await asyncio.sleep(0.002)
+                chunk = await loop.sock_recv(sock, _READ_SIZE)
+                if not chunk:
+                    if buf:
+                        raise asyncio.IncompleteReadError(bytes(buf), None)
+                    return
+                buf += chunk
+                # decode stage timed AROUND the parse only — the recv
+                # await above is socket idle, not decode cost (the
+                # replayed observation must match the single-loop
+                # path's decode_frames-internal timing)
+                t0 = time.monotonic()
+                consumed, msgs, bounces = decode_frames(buf)
+                decode_s = time.monotonic() - t0
+                if consumed:
+                    del buf[:consumed]
+                if msgs or bounces:
+                    self._deliver(fabric, silo, msgs, bounces,
+                                  decode_s, consumed)
+                if leads_hostile_frame(buf):
+                    raise FrameError("oversized frame announced")
+
+        fd = sock.fileno()
+        done: asyncio.Future = loop.create_future()
+        tail_b = bytes(tail)
+
+        def _finish(exc: BaseException | None) -> None:
+            try:
+                loop.remove_reader(fd)
+            except Exception:  # noqa: BLE001 — already removed/closed
+                pass
+            if not done.done():
+                if exc is None:
+                    done.set_result(None)
+                else:
+                    done.set_exception(exc)
+
+        def on_ready() -> None:
+            nonlocal tail_b
+            # decode-stage timing covers the whole fused C call: the
+            # NONBLOCKING recv syscall is indivisible from the parse
+            # here (that fusion is the vectored pump's point), so the
+            # replayed decode observation includes ~1-2us of syscall
+            # the decode_frames-timed paths don't — noted, accepted
+            t0 = time.monotonic()
+            # adaptive read size: sock_recv_batch round-trips the
+            # partial tail through a fresh buffer each call, so a huge
+            # mid-flight frame read in fixed 64K steps would cost
+            # O(frame^2/64K) memcpy — scaling the read toward the tail
+            # size keeps the total near-linear (cap 4MB per event)
+            bufsize = _READ_SIZE
+            tl = len(tail_b)
+            if tl > bufsize:
+                bufsize = tl if tl < (1 << 22) else (1 << 22)
+            try:
+                r = _HW.sock_recv_batch(fd, tail_b, Message, bufsize)
+            except ValueError as e:
+                _finish(FrameError(str(e)))
+                return
+            except OSError as e:
+                _finish(e)
+                return
+            if r is None:
+                return  # spurious readiness
+            entries, tail2, eof, nrecv = r
+            msgs: list = []
+            bounces: list = []
+            finish_batch_entries(entries, msgs, bounces)
+            nbytes = len(tail_b) + nrecv - len(tail2)  # consumed bytes
+            tail_b = tail2
+            if msgs or bounces:
+                self._deliver(fabric, silo, msgs, bounces,
+                              time.monotonic() - t0, nbytes)
+            if leads_hostile_frame(tail_b):
+                _finish(FrameError("oversized frame announced"))
+                return
+            if eof:
+                _finish(asyncio.IncompleteReadError(tail_b, None)
+                        if tail_b else None)
+                return
+            if self.ring.backlog() > _RING_CAPACITY:
+                # backpressure: stop reading; the kernel buffer fills
+                # and slows the peer. Resume once the consumer drains.
+                try:
+                    loop.remove_reader(fd)
+                except Exception:  # noqa: BLE001
+                    pass
+                loop.call_later(0.002, _resume)
+
+        def _resume() -> None:
+            if done.done():
+                return
+            if self.ring.backlog() > _RING_CAPACITY:
+                loop.call_later(0.002, _resume)
+                return
+            loop.add_reader(fd, on_ready)
+            on_ready()  # bytes may have buffered while paused
+
+        loop.add_reader(fd, on_ready)
+        try:
+            await done
+        finally:
+            if not done.done():
+                # the TASK was cancelled (shard stopping) with `done`
+                # still pending: resolve it so a backpressure `_resume`
+                # scheduled via call_later no-ops instead of re-arming
+                # add_reader on the closed fd
+                done.cancel()
+            try:
+                loop.remove_reader(fd)
+            except Exception:  # noqa: BLE001 — loop/socket tearing down
+                pass
+
+    def _deliver(self, fabric, silo, msgs: list, bounces: list,
+                 decode_s: float, nbytes: int) -> None:
+        """Hand one decoded read to the main loop: PING/SYSTEM peel off
+        ring-free (the QoS split), everything else rides ONE ring entry;
+        decode-stage metrics replay loop-side at drain (StatsRegistry is
+        not thread-safe — the PR-9 stamp-off-loop/replay-loop-side
+        rule)."""
+        now = time.monotonic()
+        n = len(msgs) + len(bounces)
+        self.frames += n
+        app: list | None = None
+        main = self.main_loop
+        for m in msgs:
+            m.received_at = now
+            if m.category is not Category.APPLICATION:
+                # QoS: probes/control RPCs must never wait behind ring
+                # backpressure or an application drain — immediate
+                # per-message hand-off (still FIFO with prior ring
+                # entries only via the ready queue, which is exactly
+                # the cross-category looseness the category-partitioned
+                # inbound queues already allow)
+                self.qos_direct += 1
+                main.call_soon_threadsafe(fabric._route_inbound, silo, m)
+            else:
+                if app is None:
+                    app = []
+                app.append(m)
+        for e in bounces:
+            e.message.received_at = now
+            main.call_soon_threadsafe(fabric._bounce_undecodable,
+                                      e.message, str(e))
+        if app is not None or (n and self.pool._ist is not None):
+            # an entry rides even for QoS/bounce-only reads when metrics
+            # are on: the decode seconds/bytes and the ALL-category
+            # frame counts must replay loop-side exactly like the
+            # single-loop decode_frames observations (only the stats
+            # ride the ring then — the QoS messages themselves were
+            # already handed off above, ring-free)
+            self.batches += 1
+            n_app = len(app) if app is not None else 0
+            self.ring.push((n_app, silo, app or [], decode_s, nbytes, n),
+                           n_app)
+
+
+class IngressLoopPool:
+    """N ingress shards for one silo + the round-robin assigner the
+    listener uses. Constructed by ``SocketFabric.register_silo`` when
+    ``SiloConfig.ingress_loops >= 2``; ``Silo.stop`` closes it (pump
+    threads joined, rings drained) BEFORE the message center stops so
+    every decoded message still delivers — the clean-shutdown drain."""
+
+    def __init__(self, silo: "Silo", n: int):
+        self.silo = silo
+        self.main_loop = asyncio.get_running_loop()
+        self.closed = False
+        self.accept_handle: Any = None   # set by the fabric's acceptor
+        self._rr = 0
+        # ingest stage metrics replayed at drain (loop-side)
+        self._ist = silo.ingest_stats
+        self.shards = [IngressShard(self, i) for i in range(n)]
+
+    def start(self) -> None:
+        for s in self.shards:
+            s.start()
+        for s in self.shards:
+            s._ready.wait(5.0)
+
+    def assign(self) -> IngressShard:
+        self._rr = (self._rr + 1) % len(self.shards)
+        return self.shards[self._rr]
+
+    # -- consumer side (main loop) --------------------------------------
+    def _drain_entry(self, item) -> None:
+        """One ring entry → one ``deliver_batch`` routing hop, with the
+        decode-stage metrics the shard stamped replayed here (loop-side,
+        the only thread the registry tolerates). ``n_total`` counts
+        EVERY frame of the read — QoS-bypassed and bounced included —
+        matching the single-loop ``decode_frames`` observations."""
+        _n, silo, msgs, decode_s, nbytes, n_total = item
+        ist = self._ist
+        if ist is not None and n_total:
+            ist.observe(INGEST_STATS["decode"], decode_s)
+            ist.histogram_with(INGEST_STATS["decode_bytes"],
+                               SIZE_BOUNDS).observe(nbytes)
+            ist.increment(INGEST_STATS["frames"], n_total)
+            ist.histogram_with(INGEST_STATS["frame_batch"],
+                               COUNT_BOUNDS).observe(n_total)
+        if msgs:
+            silo.fabric._route_inbound_batch(silo, msgs)
+
+    # -- lifecycle -------------------------------------------------------
+    def close_acceptor(self) -> None:
+        h = self.accept_handle
+        if h is not None:
+            self.accept_handle = None
+            h()
+
+    def close(self) -> None:
+        """Synchronous teardown half (fabric unregister): stop accepting
+        and stop the shard loops."""
+        self.closed = True
+        self.close_acceptor()
+        for s in self.shards:
+            s.stop()
+
+    async def aclose(self) -> None:
+        """Full teardown (silo stop): stop accepts + pump loops, join
+        the threads, then drain every ring on the main loop so decoded
+        messages still reach the (still-running) message center."""
+        self.close()
+        loop = asyncio.get_running_loop()
+        for s in self.shards:
+            if s.is_alive():
+                await loop.run_in_executor(None, s.join, 5.0)
+            if s.is_alive():
+                # a wedged shard (e.g. a callback deserializing a huge
+                # body) outlived the join budget: its ring drain below
+                # is best-effort only — say so instead of silently
+                # racing the producer
+                log.warning("ingress shard %s did not stop within 5s; "
+                            "draining its ring best-effort", s.name)
+        for s in self.shards:
+            s.ring.drain_now()
+
+    # -- observability ---------------------------------------------------
+    async def loop_profiles(self, windows: int = 8) -> list[dict]:
+        """Per-ingress-loop occupancy profiles (the per-loop attribution
+        the profiler's per-loop install buys; aggregated beside the main
+        loop's profile by ``SiloControl.ctl_loop_profile``). Each
+        profile is read ON its own loop — the profiler's dicts are
+        loop-confined, exactly like the main loop's — with a direct read
+        only once the shard thread is provably dead."""
+        out = []
+        for s in self.shards:
+            p = s.profiler
+            if p is None:
+                continue
+            if s.is_alive():
+                async def _read(prof=p, w=windows):
+                    return prof.profile(w, snapshots=False)
+                try:
+                    prof = await asyncio.wait_for(asyncio.wrap_future(
+                        asyncio.run_coroutine_threadsafe(_read(), s.loop)),
+                        timeout=2.0)
+                except Exception:  # noqa: BLE001 — shard stopping mid-read
+                    continue
+            else:
+                prof = p.profile(windows, snapshots=False)
+            prof["ingress_loop"] = s.index
+            prof["frames"] = s.frames
+            prof["qos_direct"] = s.qos_direct
+            prof["ring_batches"] = s.batches
+            out.append(prof)
+        return out

@@ -118,6 +118,15 @@ class SiloConfig:
     # per-frame decode + per-message hand-off (the A/B lever; bytes on
     # the wire are identical either way)
     batched_ingress: bool = True
+    # multi-loop silo ingress (runtime.multiloop): N >= 2 spawns N
+    # dedicated ingress pump threads, each running its own event loop
+    # with its own (vectored, hotwire.sock_recv_batch) socket pump; the
+    # listener hands accepted connections round-robin and decoded
+    # batches ride SPSC hand-off rings to this loop's turn machinery.
+    # PING/SYSTEM traffic bypasses the rings (QoS). Default 1 = today's
+    # single-loop in-loop pump bit for bit; in-proc fabrics have no
+    # sockets and ignore the knob.
+    ingress_loops: int = 1
     # batched egress (the response-path twin of batched_ingress):
     # responses resolved from one inbound batch group per origin in a
     # per-destination flush accumulator (runtime.egress.EgressBatcher)
@@ -765,6 +774,10 @@ class Silo:
         # hot-path site guards on this None, so the off path costs one
         # attribute check
         self.loop_prof = None
+        # multi-loop ingress pool (runtime.multiloop.IngressLoopPool):
+        # created by SocketFabric.register_silo when ingress_loops >= 2,
+        # closed (threads joined, rings drained) in stop()
+        self.ingress_pool = None
         self._flight_hook = None     # this silo's telemetry trigger hook
         # distributed tracing (observability.tracing): None unless enabled
         # — every hot-path site guards on that None
@@ -979,6 +992,13 @@ class Silo:
         if self.metrics_server is not None:
             await self.metrics_server.aclose()
             self.metrics_server = None
+        if self.ingress_pool is not None:
+            # multi-loop shutdown: stop accepts + pump threads (joined),
+            # then drain every SPSC ring on this loop — BEFORE the
+            # message center stops, so every already-decoded message
+            # still routes (the clean-shutdown drain)
+            await self.ingress_pool.aclose()
+            self.ingress_pool = None
         if self.vector is not None:
             # off-loop tick worker: queued batches finish FIFO, then the
             # thread exits (their loop-side completion callbacks run as

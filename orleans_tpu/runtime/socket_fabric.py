@@ -235,6 +235,19 @@ class _Sender:
             self.writer = None
 
 
+class _PoolAcceptor:
+    """Server-shaped handle for a multi-loop silo's acceptor (what
+    ``unregister_silo`` closes in place of the asyncio server)."""
+
+    __slots__ = ("pool",)
+
+    def __init__(self, pool):
+        self.pool = pool
+
+    def close(self) -> None:
+        self.pool.close_acceptor()
+
+
 class SocketFabric:
     """Drop-in fabric (same surface the Silo/clients use as InProcFabric)
     whose wire is real TCP. One instance per process; it may host several
@@ -302,6 +315,17 @@ class SocketFabric:
         if sock is None:
             raise SiloUnavailableError(
                 f"silo address {addr} was not allocated by this fabric")
+        if silo.config.ingress_loops > 1 and silo.ingress_pool is None:
+            # multi-loop silo (runtime.multiloop): N ingress pump
+            # threads, each with its own event loop + vectored socket
+            # pump, fed by the round-robin acceptor below over SPSC
+            # hand-off rings. ingress_loops=1 (default) constructs none
+            # of this — the start_server path below is today's bit for
+            # bit.
+            from .multiloop import IngressLoopPool
+            silo.ingress_pool = IngressLoopPool(
+                silo, silo.config.ingress_loops)
+            silo.ingress_pool.start()
         loop = asyncio.get_running_loop()
         t = loop.create_task(self._serve(silo, sock))
         self._conn_tasks.add(t)
@@ -310,9 +334,37 @@ class SocketFabric:
             silo.membership.subscribe(self._on_membership_change)
 
     async def _serve(self, silo: "Silo", sock: socket.socket) -> None:
-        server = await asyncio.start_server(
-            lambda r, w: self._handle_conn(silo, r, w), sock=sock)
-        self._servers[silo.silo_address] = server
+        pool = silo.ingress_pool
+        if pool is None:
+            server = await asyncio.start_server(
+                lambda r, w: self._handle_conn(silo, r, w), sock=sock)
+            self._servers[silo.silo_address] = server
+            return
+        # multi-loop acceptor: the listener runs on the main loop and
+        # hands each accepted socket round-robin to an ingress shard
+        # (the listener-thread form of the reference's acceptor; one
+        # process needs no SO_REUSEPORT for this). The shard owns the
+        # connection — handshake, pump, and client-route writes all run
+        # on its loop.
+        accept_task = asyncio.current_task()
+
+        def _close() -> None:
+            if accept_task is not None:
+                accept_task.cancel()
+            sock.close()
+
+        pool.accept_handle = _close
+        self._servers[silo.silo_address] = _PoolAcceptor(pool)
+        loop = asyncio.get_running_loop()
+        try:
+            while not pool.closed:
+                conn, _peer = await loop.sock_accept(sock)
+                conn.setblocking(False)
+                pool.assign().submit_conn(self, silo, conn)
+        except asyncio.CancelledError:
+            pass
+        except OSError:
+            pass  # listener closed under us (silo stopping)
 
     def unregister_silo(self, silo: "Silo", dead: bool = False) -> None:
         addr = silo.silo_address
@@ -465,7 +517,13 @@ class SocketFabric:
         if not chunks:
             return
         try:
-            writer.write(b"".join(chunks))
+            # shard-owned routes (multiloop.ShardWriter) take the chunk
+            # list whole — it rides one writev, no join copy
+            write_many = getattr(writer, "write_many", None)
+            if write_many is not None:
+                write_many(chunks)
+            else:
+                writer.write(b"".join(chunks))
         except Exception:  # noqa: BLE001 — client gone mid-write
             log.info("dropping batch to disconnected client %s", addr)
             self._drop_client_route(addr)

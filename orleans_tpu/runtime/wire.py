@@ -50,7 +50,7 @@ __all__ = [
     "MAX_FRAME_SEGMENT", "FrameError", "WireDecodeError",
     "encode_frame", "read_frame", "frame_stream",
     "encode_message", "decode_message",
-    "encode_message_batch", "decode_frames",
+    "encode_message_batch", "decode_frames", "finish_batch_entries",
     "encode_handshake", "decode_handshake",
 ]
 
@@ -190,50 +190,77 @@ _HW_BATCH = _HW_FRAMES and hasattr(_ser._hotwire, "pack_batch")
 # fields — byte-identical to pack_frame (property-tested).
 _HW_TMPL = _HW_BATCH and hasattr(_ser._hotwire, "pack_batch_tmpl")
 
-# The per-message (varying) header fields of a batched response frame:
-# correlation id, the grain/activation endpoints the response swaps back,
-# the per-class method identity, the result discriminator, and the
-# per-message stamps (trace-context wall stamp from _stamp_response, txn
-# joins from _attach_txn_joins) — everything else is invariant across a
-# response group for one (sending_silo, target_silo, category) key and
-# rides the memcpy'd template. Sampled responses therefore batch
-# IDENTICALLY (their request_context is a varying field); only headers
-# the template cannot carry — rejections, forwarded/resent or
-# chain-carrying envelopes — peel to the per-frame encoder below.
-_RESPONSE_VAR_SLOTS = frozenset((
+# The per-message (varying) header fields of a templated frame:
+# correlation id, the grain/activation endpoints, the per-class method
+# identity, the result discriminator, and the per-message stamps
+# (trace-context wall stamp from _stamp_response / call_batch req_ctx,
+# txn joins from _attach_txn_joins) — everything else is invariant
+# across one template key and rides the memcpy'd chunks. ONE index set
+# serves responses AND requests (the call_batch sender half): a field
+# that is invariant within a request batch but varies across batches
+# (method identity, sender grain) simply encodes per message, which is
+# always byte-correct. Sampled frames batch IDENTICALLY (their
+# request_context is a varying field); only headers the template cannot
+# carry — rejections, forwarded/resent envelopes — peel to the
+# per-frame encoder below.
+_TMPL_VAR_SLOTS = frozenset((
     "id", "sending_grain", "sending_activation", "target_grain",
     "target_activation", "interface_name", "method_name", "response_kind",
     "is_read_only", "request_context", "transaction_info",
     "interface_version"))
-_RESPONSE_VAR_IDX = tuple(i for i, s in enumerate(_HEADER_SLOTS)
-                          if s in _RESPONSE_VAR_SLOTS)
+_TMPL_VAR_IDX = tuple(i for i, s in enumerate(_HEADER_SLOTS)
+                      if s in _TMPL_VAR_SLOTS)
 
-# (sending_silo, target_silo, category) -> pre-encoded chunk tuple.
-# Bounded: a cluster only ever sees O(silos + clients) keys, but a
-# pathological key churn (client generations) must not grow it forever.
+# template key -> pre-encoded chunk tuple. Response keys are
+# (sending_silo, target_silo, category); request keys additionally pin
+# direction and the invariant flags (see _frame_template; chain-carrying
+# envelopes peel, so chains never enter the key space). Bounded: a
+# cluster only ever sees O(silos + clients) keys, but a pathological key
+# churn (client generations) must not grow it forever.
 _TMPL_CACHE: dict = {}
 _TMPL_CACHE_CAP = 512
 
 
-def _response_template(m: Message):
+def _frame_template(m: Message):
     """The cached header-prefix template for ``m``, or None when the
-    message must take the per-frame encoder (not a response, or carrying
-    headers the template's invariant runs can't represent)."""
-    if m.direction != Direction.RESPONSE:
-        return None
+    message must take the per-frame encoder (carrying headers the
+    template's invariant runs can't represent).
+
+    Responses key on (sending_silo, target_silo, category) exactly as
+    the PR-10 response template did. Requests/one-ways — the open
+    PR-3/PR-10 half, landed for the ``call_batch`` native sender — key
+    additionally on direction and the flag fields that are constant per
+    (class, method) batch (is_always_interleave, immutable), which
+    subsumes the per-(sender, target-class, method) keying: one
+    template serves every method a sender batches over one link, since
+    method identity is a varying field. Chain-CARRYING envelopes peel
+    (requests and responses alike): a chain would have to be part of
+    the key, and chain cardinality scales with active calling grains —
+    keying on it would thrash the bounded cache and evict the hot
+    response templates; client senders (the call_batch target) carry
+    empty chains and template fully."""
+    d = m.direction
     if (m.rejection_type is not None or m.rejection_info is not None
-            or m.forward_count or m.resend_count or m.call_chain
-            or m.is_always_interleave or m.is_unordered or not m.immutable
+            or m.forward_count or m.resend_count or m.is_unordered
+            or m.call_chain
             or m.cache_invalidation is not None or m.is_new_placement):
         return None  # peel: headers outside the invariant constants
-    key = (m.sending_silo, m.target_silo, m.category)
+    if d == Direction.RESPONSE:
+        if m.is_always_interleave or not m.immutable:
+            return None  # peel: same response semantics as PR 10
+        key = (m.sending_silo, m.target_silo, m.category)
+    else:
+        # REQUEST / ONE_WAY: flags are invariant within one call_batch
+        # group, so they ride the template keyed, not peeled
+        key = (m.sending_silo, m.target_silo, m.category, d,
+               m.is_always_interleave, m.immutable)
     t = _TMPL_CACHE.get(key)
     if t is None:
         if len(_TMPL_CACHE) >= _TMPL_CACHE_CAP:
             _TMPL_CACHE.clear()
         try:
             t = _TMPL_CACHE[key] = _ser._hotwire.make_header_template(
-                m, _RESPONSE_VAR_IDX)
+                m, _TMPL_VAR_IDX)
         except Exception:  # noqa: BLE001 — unencodable invariant field:
             return None    # the per-frame path owns the error semantics
     return t
@@ -362,13 +389,16 @@ def encode_message_batch(msgs: list, bounce, native: bool = True,
     per-message path so the failing message is identified and bounced
     alone. Output bytes are identical either way.
 
-    ``templates`` (native path only): contiguous runs of responses whose
+    ``templates`` (native path only): contiguous runs of messages whose
     headers a cached prefix template can carry encode via
     ``pack_batch_tmpl`` — the invariant header runs are memcpy'd and only
-    correlation id / stamps / body splice encode per message (the PR-3
-    SocketManager pooled-buffer carry-over). Requests pay ONE direction
-    check for this. ``stats`` (metrics-enabled egress writers): the whole
-    batch encode is timed as one ``egress.encode.seconds`` observation.
+    correlation id / endpoints / stamps / body splice encode per message
+    (the PR-3 SocketManager pooled-buffer carry-over). Responses AND
+    requests ride it: the request-side template is the ``call_batch``
+    native-sender half (keyed per sender link, method
+    identity varying — see :func:`_frame_template`). ``stats``
+    (metrics-enabled egress writers): the whole batch encode is timed as
+    one ``egress.encode.seconds`` observation.
     """
     hw = _ser._hotwire if native else None
     if hw is not None and _HW_BATCH:
@@ -390,7 +420,7 @@ def encode_message_batch(msgs: list, bounce, native: bool = True,
                 if m.expires_at is not None:
                     ttl = max(0.0, m.expires_at - now)
                 body = serialize(m.body)
-                tmpl = _response_template(m) if use_tmpl else None
+                tmpl = _frame_template(m) if use_tmpl else None
             except Exception as e:  # noqa: BLE001 — per-message body failure
                 bounce(m, e)
                 continue
@@ -406,7 +436,7 @@ def encode_message_batch(msgs: list, bounce, native: bool = True,
                     chunks.append(hw.pack_batch(items))
                 else:
                     chunks.append(hw.pack_batch_tmpl(
-                        tmpl, _RESPONSE_VAR_IDX, items))
+                        tmpl, _TMPL_VAR_IDX, items))
             except Exception:  # noqa: BLE001 — a header refused batch
                 # encode: retry per-message so the failure scopes to one
                 # frame (bodies re-serialize; this path is rare)
@@ -425,6 +455,40 @@ def encode_message_batch(msgs: list, bounce, native: bool = True,
         except Exception as e:  # noqa: BLE001 — per-message, not the link
             bounce(m, e)
     return chunks
+
+
+def finish_batch_entries(entries, msgs: list, bounces: list) -> None:
+    """Shared tail of the native batch decode (``unpack_batch`` and the
+    vectored pump's ``sock_recv_batch``): per entry, rebase the TTL,
+    initialise the wire-excluded pool slots, and deserialize the body —
+    pickle-peer (or corrupt-native) frames carry raw header/body
+    segments and fall through the ordinary per-frame
+    :func:`decode_message`, which reproduces the exact per-message error
+    semantics. Appends to ``msgs``/``bounces`` in wire order; callers
+    own the ``received_at`` stamping."""
+    for msg, ttl, body in entries:
+        if msg is None:
+            # pickle-peer (or corrupt-native) frame: ttl/body carry the
+            # raw header/body segments — ordinary per-frame decode
+            try:
+                msgs.append(decode_message(ttl, body))
+            except _BodyDecodeError as e:
+                bounces.append(e)
+            except WireDecodeError as e:
+                log.warning("dropping message with undecodable "
+                            "headers: %s", e)
+            continue
+        msg.expires_at = None if ttl is None else time.monotonic() + ttl
+        msg.received_at = None  # callers stamp once per batch
+        msg._pool_free = False  # full slot set (see decode_message)
+        msg._pool_gen = 0
+        try:
+            msg.body = deserialize(body)
+        except Exception as e:  # noqa: BLE001 — body failure per-message
+            msg.body = None
+            bounces.append(_BodyDecodeError(msg, e))
+            continue
+        msgs.append(msg)
 
 
 def decode_frames(buf, stats=None) -> tuple[int, list, list]:
@@ -458,29 +522,7 @@ def decode_frames(buf, stats=None) -> tuple[int, list, list]:
         except ValueError as e:
             # oversized/hostile frame announcement: connection must drop
             raise FrameError(str(e)) from e
-        for msg, ttl, body in entries:
-            if msg is None:
-                # pickle-peer (or corrupt-native) frame: ttl/body carry the
-                # raw header/body segments — ordinary per-frame decode
-                try:
-                    msgs.append(decode_message(ttl, body))
-                except _BodyDecodeError as e:
-                    bounces.append(e)
-                except WireDecodeError as e:
-                    log.warning("dropping message with undecodable "
-                                "headers: %s", e)
-                continue
-            msg.expires_at = None if ttl is None else time.monotonic() + ttl
-            msg.received_at = None  # stamped once for the whole batch below
-            msg._pool_free = False  # full slot set (see decode_message)
-            msg._pool_gen = 0
-            try:
-                msg.body = deserialize(body)
-            except Exception as e:  # noqa: BLE001 — body failure per-message
-                msg.body = None
-                bounces.append(_BodyDecodeError(msg, e))
-                continue
-            msgs.append(msg)
+        finish_batch_entries(entries, msgs, bounces)
     else:
         end = len(buf)
         pos = 0

@@ -112,6 +112,61 @@ def test_template_batch_decodes_slot_identical():
         assert _slots_equal(got, orig)
 
 
+def _request_corpus(n: int = 40) -> list:
+    """call_batch-shaped REQUEST batches plus the variety the request
+    template must carry: one-ways, traced request_context, in-grain
+    senders with a non-empty call chain, interleaved responses, and the
+    headers that must PEEL (forwarded/resent requests)."""
+    from orleans_tpu.core.message import make_request_fast
+    from orleans_tpu.core.message import Category
+    chain = (GrainId.for_grain(GT, 999),)
+    out = []
+    for i in range(n):
+        d = Direction.ONE_WAY if i % 7 == 0 else Direction.REQUEST
+        ctx = ({"__otpu_trace__": (0xD0 + i, i, 1700000000.0 + i)}
+               if i % 4 == 0 else ({"bag": i} if i % 5 == 0 else None))
+        m = make_request_fast(
+            Category.APPLICATION, d, S2, None, None, S1,
+            GrainId.for_grain(GT, i), "eg.IEcho", f"m{i % 3}",
+            ((), {"x": i}), None,
+            chain if i % 3 == 0 else (), i % 2 == 0, False, ctx, i % 2)
+        if i % 11 == 0:
+            m.forward_count = 1  # must peel
+        out.append(m)
+        if i % 6 == 0:
+            req = make_request(
+                target_grain=GrainId.for_grain(GT, i),
+                interface_name="eg.IEcho", method_name="m",
+                body=((i,), {}), sending_silo=S1, target_silo=S2,
+                timeout=None)
+            resp = make_response(req, i)
+            resp.target_silo = S2
+            out.append(resp)  # mixed run: responses interleave
+    return out
+
+
+@pytest.mark.skipif(hw is None, reason="native toolchain unavailable")
+def test_request_template_batch_bytes_identical_to_per_frame():
+    """The request-side header-prefix template (the call_batch native
+    sender half): batch bytes == concatenated per-frame bytes across
+    one-ways, traced headers, chain-carrying senders, and peels."""
+    msgs = _request_corpus()
+    per_frame = b"".join(encode_message(m) for m in msgs)
+    chunks = encode_message_batch(msgs, bounce=lambda m, e: None)
+    assert b"".join(chunks) == per_frame
+    assert len(chunks) > 1  # template/plain runs actually split
+    plain = encode_message_batch(msgs, bounce=lambda m, e: None,
+                                 templates=False)
+    assert b"".join(plain) == per_frame
+    # round trip: every header slot survives the template encode
+    consumed, decoded, bounces = decode_frames(
+        bytearray(b"".join(chunks)))
+    assert consumed == len(per_frame) and not bounces
+    assert len(decoded) == len(msgs)
+    for got, orig in zip(decoded, msgs):
+        assert _slots_equal(got, orig)
+
+
 def test_pickle_fallback_path_unchanged(monkeypatch):
     """ORLEANS_TPU_NATIVE=0 form: no template machinery, per-frame
     chunks, same decodable bytes."""
@@ -129,7 +184,7 @@ def test_template_peels_headers_it_cannot_carry():
     """Rejections, forwarded and chain-carrying responses must NOT ride
     the template (their headers fall outside the invariant constants) —
     and must still encode byte-identically via the per-frame run."""
-    from orleans_tpu.runtime.wire import _response_template
+    from orleans_tpu.runtime.wire import _frame_template
 
     req = make_request(target_grain=GrainId.for_grain(GT, 1),
                        interface_name="eg.IEcho", method_name="m",
@@ -137,19 +192,27 @@ def test_template_peels_headers_it_cannot_carry():
                        timeout=None)
     ok = make_response(req, 1)
     ok.target_silo = S2
-    assert _response_template(ok) is not None
+    assert _frame_template(ok) is not None
     rej = make_rejection(req, RejectionType.OVERLOADED, "busy")
     rej.target_silo = S2
-    assert _response_template(rej) is None
+    assert _frame_template(rej) is None
     fwd = make_response(req, 1)
     fwd.target_silo = S2
     fwd.forward_count = 1
-    assert _response_template(fwd) is None
+    assert _frame_template(fwd) is None
     chained = make_response(req, 1)
     chained.target_silo = S2
     chained.call_chain = (GrainId.for_grain(GT, 2),)
-    assert _response_template(chained) is None
-    assert _response_template(req) is None  # not a response at all
+    assert _frame_template(chained) is None
+    # requests template too since the call_batch sender half landed —
+    # but a forwarded request still peels
+    assert _frame_template(req) is not None
+    fwd_req = make_request(target_grain=GrainId.for_grain(GT, 3),
+                           interface_name="eg.IEcho", method_name="m",
+                           body=((), {}), sending_silo=S2, target_silo=S1,
+                           timeout=None)
+    fwd_req.forward_count = 1
+    assert _frame_template(fwd_req) is None
     batch = [ok, rej, fwd, chained]
     chunks = encode_message_batch(batch, bounce=lambda m, e: None)
     assert b"".join(chunks) == b"".join(encode_message(m) for m in batch)

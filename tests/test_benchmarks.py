@@ -59,6 +59,22 @@ async def test_ingest_ab_harness():
     assert r["extra"]["batched_msgs_per_sec"] > 0
 
 
+async def test_multiloop_ab_harness():
+    """ISSUE 11: the 1-vs-2 ingress-loop A/B runs end to end and
+    reports both sides plus the main-loop pump-share ratio and the
+    per-ingress-loop profiles (the ratio floor lives in
+    test_perf_floors — this only proves the harness)."""
+    from benchmarks import loop_attribution
+
+    r = await loop_attribution.run_multiloop_ab(seconds=0.5, concurrency=8)
+    _check(r)
+    assert r["extra"]["single"]["calls_per_sec"] > 0
+    assert r["extra"]["multi"]["calls_per_sec"] > 0
+    assert "main_loop_pump_share_ratio" in r["extra"]
+    profs = r["extra"]["multi"]["ingress_loop_profiles"]
+    assert profs and any(p["frames"] > 0 for p in profs)
+
+
 async def test_metrics_overhead_harness():
     from benchmarks.ping import bench_metrics_overhead
 

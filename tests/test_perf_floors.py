@@ -343,3 +343,103 @@ async def test_floor_batched_egress():
         f"batched egress only {ratio:.2f}x over per-message responses " \
         f"(floor {BATCHED_EGRESS_MARGIN}x) — the response-path pipeline " \
         f"is not engaging"
+
+
+# Multi-loop silo ingress (ISSUE 11): 1 vs 2 ingress pump loops on
+# identical mixed TCP traffic. TWO assertions with different trust
+# levels:
+#   * structural (always, best-of-two): the main loop's pump share must
+#     shed onto the shard threads — measured 0.55-0.72x on this box; a
+#     ceiling of 0.85x trips only when the shards stop pumping.
+#   * throughput (gated): the >=1.7x silo msgs/sec ratio is only
+#     meaningful on a genuinely multi-core runner. The 2-loop harness
+#     runs >=4 busy threads (main loop, two ingress shards, the
+#     off-loop tick worker, plus the co-hosted clients), so the gate
+#     requires >=4 visible cores AND a conservative direct parallelism
+#     probe (min-serial/max-parallel over 3 interleaved rounds of
+#     GIL-released hashing — a one-shot probe under suite load can
+#     flatter a throttled box by catching the serial half in a slow
+#     slice): if 2 perfectly parallel threads can't reach 1.7x, a
+#     GIL-sharing pump certainly can't. This container (2 quota-shared
+#     CPUs, ~0.5-1.6x probe) skips deterministically on the core count
+#     and trusts the structural A/B (the ROADMAP's "trust A/B ratios,
+#     not absolutes" rule).
+MULTILOOP_SPEEDUP_FLOOR = 1.7
+MULTILOOP_PUMP_SHARE_RATIO_CEIL = 0.85
+MULTILOOP_MIN_CORES = 4
+
+
+def _parallel_capacity() -> float:
+    """CONSERVATIVE estimate of the speedup 2 threads of GIL-released
+    work see vs serial on this runner: min serial time / max parallel
+    time over 3 interleaved rounds, so transient quota throttling can
+    only understate capacity (understating skips the throughput floor,
+    never falsely arms it)."""
+    import hashlib
+    import threading
+    import time as _t
+    buf = b"x" * (1 << 22)
+
+    def work(n):
+        for _ in range(n):
+            hashlib.sha256(buf).digest()
+
+    serial_best, par_worst = float("inf"), 0.0
+    for _ in range(3):
+        t0 = _t.perf_counter()
+        work(12)
+        serial_best = min(serial_best, _t.perf_counter() - t0)
+        t0 = _t.perf_counter()
+        ts = [threading.Thread(target=work, args=(6,)) for _ in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        par_worst = max(par_worst, _t.perf_counter() - t0)
+    return serial_best / par_worst if par_worst else 0.0
+
+
+async def test_floor_multiloop():
+    import os
+
+    from benchmarks import loop_attribution
+
+    cores = (len(os.sched_getaffinity(0))
+             if hasattr(os, "sched_getaffinity") else (os.cpu_count() or 1))
+    if cores < 2:
+        pytest.skip("multi-loop floor needs >=2 visible cores "
+                    "(single core: trust A/B ratios from multi-core "
+                    "runners)")
+
+    async def once():
+        r = await loop_attribution.run_multiloop_ab(seconds=1.5)
+        return r["value"], r["extra"]["main_loop_pump_share_ratio"]
+
+    speed, pump_ratio = await once()
+    if pump_ratio > MULTILOOP_PUMP_SHARE_RATIO_CEIL * 0.8 or \
+            speed < MULTILOOP_SPEEDUP_FLOOR * 1.1:
+        s2, p2 = await once()  # noise guard: best of two
+        speed = max(speed, s2)
+        pump_ratio = min(pump_ratio, p2)
+    assert pump_ratio <= MULTILOOP_PUMP_SHARE_RATIO_CEIL, \
+        f"main-loop pump share only fell to {pump_ratio:.2f}x of " \
+        f"single-loop (ceiling {MULTILOOP_PUMP_SHARE_RATIO_CEIL}) — " \
+        f"the ingress shards are not pumping"
+    if cores < MULTILOOP_MIN_CORES:
+        pytest.skip(
+            f"only {cores} visible cores — the 2-loop harness needs "
+            f">={MULTILOOP_MIN_CORES} (main loop + 2 shards + tick "
+            f"worker) for the >={MULTILOOP_SPEEDUP_FLOOR}x msgs/sec "
+            f"ratio to be meaningful; structural pump-share A/B "
+            f"verified at {pump_ratio:.2f}x")
+    capacity = _parallel_capacity()
+    if capacity < MULTILOOP_SPEEDUP_FLOOR:
+        pytest.skip(
+            f"runner delivers only {capacity:.2f}x to perfectly parallel "
+            f"GIL-released work (shared/throttled cores) — the "
+            f">={MULTILOOP_SPEEDUP_FLOOR}x msgs/sec ratio is only "
+            f"asserted on genuinely multi-core runners; structural "
+            f"pump-share A/B verified at {pump_ratio:.2f}x")
+    assert speed >= MULTILOOP_SPEEDUP_FLOOR, \
+        f"2 ingress loops only {speed:.2f}x of 1 " \
+        f"(floor {MULTILOOP_SPEEDUP_FLOOR}x on a multi-core runner)"
