@@ -41,7 +41,8 @@ async def bench_host_tier(n_grains: int, concurrency: int,
                           hot_lane: bool = True,
                           tail: bool = False,
                           metrics: bool = False,
-                          profiling: bool = False) -> dict:
+                          profiling: bool = False,
+                          slo: bool = False) -> dict:
     """``trace_sample``: None runs untraced (no collector installed);
     a float enables distributed tracing at that head-sampling rate — the
     overhead-tracking variant wired into run_all and the perf floor.
@@ -54,6 +55,14 @@ async def bench_host_tier(n_grains: int, concurrency: int,
     every message plus the queue/backpressure sampler loop (fast period
     so it actually ticks during the run) — the A/B lever for the
     metrics-overhead floor."""
+    import gc
+
+    # settled-heap start for every A/B pair built on this harness (the
+    # bench_profiling_overhead discipline, hoisted): in a long-lived CI
+    # process (~600 tests of heap by floor time) a gen-2 collection
+    # landing inside ONE side's timed window skews the pair's ratio by
+    # 15-30% — far more than any tax the floors guard
+    gc.collect()
     b = (SiloBuilder().with_name("ping-silo").add_grains(EchoGrain)
          .with_config(hot_lane_enabled=hot_lane))
     if trace_sample is not None:
@@ -61,6 +70,13 @@ async def bench_host_tier(n_grains: int, concurrency: int,
                           trace_tail_enabled=tail)
     if metrics:
         b = b.with_config(metrics_enabled=True, metrics_sample_period=0.2)
+    if slo:
+        # SLO engine at a fast evaluation cadence on top of metrics (the
+        # monitor reads interval diffs of the metrics histograms — the
+        # A/B lever for the slo-overhead floor is metrics+slo vs metrics)
+        b = b.with_config(metrics_enabled=True, metrics_sample_period=0.2,
+                          slo_enabled=True, slo_period=0.1,
+                          slo_fast_window=0.5, slo_slow_window=2.0)
     if profiling:
         b = b.with_config(profiling_enabled=True, profiling_window=0.25)
     silo = b.build()
@@ -101,6 +117,7 @@ async def bench_host_tier(n_grains: int, concurrency: int,
     await silo.stop()
     return {
         "metric": ("ping_host_profiled_calls_per_sec" if profiling
+                   else "ping_host_slo_calls_per_sec" if slo
                    else "ping_host_metered_calls_per_sec" if metrics
                    else "ping_host_calls_per_sec" if trace_sample is None
                    else "ping_host_tail_traced_calls_per_sec" if tail
@@ -228,6 +245,35 @@ async def bench_metrics_overhead(n_grains: int = 128, concurrency: int = 50,
         "extra": {
             "bare_calls_per_sec": base["value"],
             "metered_calls_per_sec": metered["value"],
+            "n_grains": n_grains, "concurrency": concurrency,
+        },
+    }
+
+
+async def bench_slo_overhead(n_grains: int = 128, concurrency: int = 50,
+                             seconds: float = 1.5) -> dict:
+    """slo_overhead: the SLO monitor (10Hz multi-window burn-rate
+    evaluation over interval-diffed registry snapshots) on top of the
+    metrics pipeline vs the metrics pipeline alone, as a ratio. The
+    monitor adds ZERO hot-path instrumentation — both sides pay the
+    identical per-message metrics stamps — so this ratio isolates the
+    evaluation loop's own loop-share tax. Floor companion:
+    tests/test_perf_floors.py::test_floor_slo_overhead (>= 0.85).
+
+    Both sides run with the hot lane off, like the metrics floor: the
+    instrumented sites the monitor's diffs ride must actually execute."""
+    base = await bench_host_tier(n_grains, concurrency, seconds,
+                                 hot_lane=False, metrics=True)
+    slo = await bench_host_tier(n_grains, concurrency, seconds,
+                                hot_lane=False, slo=True)
+    return {
+        "metric": "slo_overhead",
+        "value": round(slo["value"] / base["value"], 3),
+        "unit": "ratio (metrics+slo / metrics)",
+        "vs_baseline": None,
+        "extra": {
+            "metered_calls_per_sec": base["value"],
+            "slo_calls_per_sec": slo["value"],
             "n_grains": n_grains, "concurrency": concurrency,
         },
     }

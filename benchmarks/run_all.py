@@ -15,6 +15,7 @@ if __package__ in (None, ""):
 
 from benchmarks import (
     chirper_fanout,
+    gauntlet,
     gpstracker_stream,
     ingest_attribution,
     loop_attribution,
@@ -103,6 +104,17 @@ def main() -> None:
     # interposition + category accounting; CI floor 0.85)
     print(json.dumps(asyncio.run(ping.bench_profiling_overhead(
         n_grains=128, concurrency=50, seconds=1.5))))
+    # SLO monitor overhead as a ratio vs metrics-only (multi-window
+    # burn-rate evaluation rides snapshot diffs; CI floor 0.85)
+    print(json.dumps(asyncio.run(ping.bench_slo_overhead(
+        n_grains=128, concurrency=50, seconds=1.5))))
+    # traffic-shape gauntlet (ISSUE 12): flash crowd / hot-key Zipf /
+    # diurnal ramp / churn storm over real TCP, each emitting SLO
+    # VERDICTS (objective met/breached, burn rates, budget burned,
+    # time-to-detect) instead of raw msgs/sec — plus the QoS invariant
+    # (probe RTT bounded, zero false suspicions while app traffic sheds)
+    for r in asyncio.run(gauntlet.run(short=True)):
+        print(json.dumps(r))
     print(json.dumps(asyncio.run(mapreduce.run())))
     for r in serialization.run():
         print(json.dumps(r))

@@ -25,7 +25,7 @@ __all__ = [
     "ClusterOptions", "MessagingOptions", "SchedulingOptions",
     "GrainCollectionOptions", "MembershipOptions", "DirectoryOptions",
     "LoadSheddingOptions", "DispatchOptions", "RebalanceOptions",
-    "TracingOptions", "MetricsOptions", "ProfilingOptions",
+    "TracingOptions", "MetricsOptions", "ProfilingOptions", "SloOptions",
     "flatten", "apply_options", "validate_options", "log_options",
 ]
 
@@ -337,6 +337,56 @@ class ProfilingOptions:
 
 
 @dataclass
+class SloOptions:
+    """SLO engine (observability.slo — the judging layer over the
+    metrics/tracing/profiling substrate): when ``enabled`` a per-silo
+    :class:`~orleans_tpu.observability.slo.SloMonitor` evaluates the
+    default objective set (app ingest latency, membership probe RTT,
+    turn error rate, gateway shed rate — or a custom spec list set via
+    ``silo.slo_specs``) every ``period`` seconds from interval-diffed
+    registry snapshots, with Google-SRE multi-window burn-rate
+    detection: breach when BOTH the ``fast_window`` and ``slow_window``
+    burn the error budget faster than ``burn_threshold``× with at least
+    ``min_events`` events in the fast window. A breach snapshots the
+    flight recorder, force-retains in-flight tail traces, and bumps the
+    ``slo.*`` counters/gauges; the cluster rolls up worst-burn-wins via
+    ``ManagementGrain.get_cluster_slo``. Evaluation rides snapshot
+    diffs — zero new hot-path instrumentation."""
+
+    enabled: bool = False
+    period: float = 1.0
+    fast_window: float = 60.0
+    slow_window: float = 300.0
+    burn_threshold: float = 4.0
+    min_events: int = 10
+    # default-spec targets: latency = good fraction of ingest queue-wait
+    # observations under latency_threshold seconds; probe = good fraction
+    # of membership probe RTTs under the probe timeout; error/shed =
+    # good fractions of turns/offered ingress
+    latency_threshold: float = 0.1
+    latency_target: float = 0.99
+    probe_target: float = 0.99
+    error_target: float = 0.999
+    shed_target: float = 0.99
+
+    def validate(self) -> None:
+        _positive(self, "period", "fast_window", "slow_window",
+                  "burn_threshold", "min_events", "latency_threshold")
+        if self.fast_window >= self.slow_window:
+            raise ConfigurationError(
+                f"slo fast_window must be < slow_window "
+                f"({self.fast_window} >= {self.slow_window}) — the slow "
+                "window exists to CONFIRM what the fast window catches")
+        for n in ("latency_target", "probe_target", "error_target",
+                  "shed_target"):
+            v = getattr(self, n)
+            if not (0.0 < v < 1.0):
+                raise ConfigurationError(
+                    f"slo {n} must be in (0, 1), got {v!r} — a target of "
+                    "1.0 leaves zero error budget")
+
+
+@dataclass
 class DispatchOptions:
     """TPU vector-dispatch tier (no reference analog — the batched engine's
     knobs): per-shard slot-pool capacity and exchange lane capacity."""
@@ -411,6 +461,17 @@ _FLAT_MAP = {
     "metrics_port": (MetricsOptions, "port"),
     "metrics_otlp_endpoint": (MetricsOptions, "otlp_endpoint"),
     "metrics_otlp_period": (MetricsOptions, "otlp_period"),
+    "slo_enabled": (SloOptions, "enabled"),
+    "slo_period": (SloOptions, "period"),
+    "slo_fast_window": (SloOptions, "fast_window"),
+    "slo_slow_window": (SloOptions, "slow_window"),
+    "slo_burn_threshold": (SloOptions, "burn_threshold"),
+    "slo_min_events": (SloOptions, "min_events"),
+    "slo_latency_threshold": (SloOptions, "latency_threshold"),
+    "slo_latency_target": (SloOptions, "latency_target"),
+    "slo_probe_target": (SloOptions, "probe_target"),
+    "slo_error_target": (SloOptions, "error_target"),
+    "slo_shed_target": (SloOptions, "shed_target"),
     "profiling_enabled": (ProfilingOptions, "enabled"),
     "profiling_window": (ProfilingOptions, "window"),
     "profiling_ring": (ProfilingOptions, "ring"),

@@ -181,6 +181,29 @@ class SiloControl:
                 windows=min(windows, 8))
         return out
 
+    async def ctl_slo(self) -> dict:
+        """This silo's SLO verdicts (observability.slo.SloMonitor.status:
+        per-objective met/breached, multi-window burn rates, budget
+        burned) plus the top call sites as the breach drill-down — the
+        per-silo leaf of ManagementGrain.get_cluster_slo's
+        worst-burn-wins merge. {} when the SLO engine is disabled."""
+        mon = self.silo.slo
+        if mon is None:
+            return {}
+        out = mon.status()
+        cs = self.silo.call_sites
+        if cs is not None:
+            # which grain methods are hot/slow/erroring behind the burn
+            out["call_sites"] = cs.top(10)
+        return out
+
+    async def ctl_call_sites(self, k: int = 20) -> dict:
+        """Per-(grain_class, method) call-site latency/error table
+        (observability.stats.CallSiteStats.snapshot, top-``k`` by summed
+        turn seconds); {} when metrics are disabled."""
+        cs = self.silo.call_sites
+        return {} if cs is None else cs.snapshot(k)
+
     async def ctl_histogram(self, name: str) -> dict | None:
         """One named histogram's summary (with per-bucket counts so the
         ManagementGrain can merge silos losslessly); None if unknown."""

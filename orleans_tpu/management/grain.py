@@ -173,6 +173,65 @@ class ManagementGrain(Grain):
             "per_silo": per_silo,
         }
 
+    async def get_cluster_slo(self) -> dict:
+        """Cluster-wide SLO rollup over every silo's ``ctl_slo``:
+        per-objective **worst-burn-wins** merge — burn rates and budget
+        burned take the cluster max (an SLO is breached anywhere ⇒
+        breached, and the worst silo defines how fast the budget dies),
+        good/bad event counts sum, and ``worst_silo`` names the max-burn
+        silo so a breach drills straight down to its per-silo payload
+        (burn state + hottest call sites) riding in ``per_silo``. One
+        call answers "is the cluster meeting its SLOs" and "which silo
+        and which grain methods are killing it"."""
+        per_silo = await self._fan_out("ctl_slo")
+        merged: dict[str, dict] = {}
+        total_breaches = 0
+        for addr, snap in per_silo.items():
+            if not snap:
+                continue  # SLO engine disabled on that silo
+            total_breaches += snap.get("breaches", 0)
+            for name, obj in snap.get("objectives", {}).items():
+                cur = merged.get(name)
+                if cur is None:
+                    cur = merged[name] = dict(obj)
+                    # episode timelines are PER-SILO data: carrying the
+                    # first-iterated silo's timestamps on the merged
+                    # objective would attribute them cluster-wide — the
+                    # drill-down lives in per_silo[worst_silo] instead
+                    for k in ("breach_started", "breach_started_mono",
+                              "first_breach_mono", "episodes"):
+                        cur.pop(k, None)
+                    cur["worst_silo"] = addr
+                    continue
+                if obj["burn_fast"] > cur["burn_fast"]:
+                    cur["worst_silo"] = addr
+                cur["burn_fast"] = max(cur["burn_fast"], obj["burn_fast"])
+                cur["burn_slow"] = max(cur["burn_slow"], obj["burn_slow"])
+                cur["budget_burned"] = max(cur["budget_burned"],
+                                           obj["budget_burned"])
+                cur["breached"] = cur["breached"] or obj["breached"]
+                cur["met"] = cur["met"] and obj["met"]
+                cur["breaches"] += obj["breaches"]
+                cur["good"] += obj["good"]
+                cur["bad"] += obj["bad"]
+        return {
+            "breached": any(o["breached"] for o in merged.values()),
+            "breaches": total_breaches,
+            "objectives": merged,
+            "per_silo": per_silo,
+        }
+
+    async def get_cluster_call_sites(self, k: int = 20) -> list[dict]:
+        """Cluster-wide per-(grain_class, method) call-site table: every
+        silo's bounded top table folded (counts/errors/seconds sum, max
+        takes the max), returned as the top-``k`` by summed turn seconds
+        — the "which grain methods carry the cluster's load" read an SLO
+        breach (or the future placement-policy compiler) drills into."""
+        from ..observability.stats import CallSiteStats
+        per_silo = await self._fan_out("ctl_call_sites", k)
+        merged = CallSiteStats.merge(s for s in per_silo.values() if s)
+        return CallSiteStats.format_top(merged["sites"], k)
+
     async def get_cluster_histogram(self, name: str) -> dict | None:
         """One named latency histogram aggregated across every silo
         (Histogram.merge over the per-bucket counts each SiloControl

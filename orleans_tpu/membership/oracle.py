@@ -39,6 +39,12 @@ log = logging.getLogger("orleans.membership")
 
 MEMBERSHIP_TARGET = "MembershipTarget"
 
+# probe round-trip latency histogram (observability.stats.SLO_STATS):
+# the PING-lane QoS objective's source
+from ..observability.stats import SLO_STATS as _SLO  # noqa: E402
+
+_PROBE_RTT = _SLO["probe_rtt"]
+
 __all__ = ["MembershipOracle", "MembershipTarget", "join_cluster"]
 
 
@@ -259,6 +265,7 @@ class MembershipOracle:
 
     async def _probe_one(self, target: SiloAddress) -> None:
         gid = GrainId.system_target(type_code_of(MEMBERSHIP_TARGET), target)
+        t0 = time.monotonic()
         try:
             fut = self.silo.runtime_client.send_request(
                 target_grain=gid, grain_class=MembershipTarget,
@@ -268,12 +275,24 @@ class MembershipOracle:
                 category=Category.PING)
             await fut
         except Exception:  # noqa: BLE001 — timeout/rejection = missed probe
+            # a miss IS a slow probe to the RTT objective — clamped UP
+            # to the probe timeout, because a fast failure (connection
+            # refused, immediate rejection) is at least as bad as a
+            # timeout: observing its ~0 elapsed would count an outage's
+            # probes as GOOD events and keep the objective green
+            self.silo.stats.observe(
+                _PROBE_RTT, max(time.monotonic() - t0, self.probe_timeout))
             missed = self.missed_probes.get(target, 0) + 1
             self.missed_probes[target] = missed
             self.silo.stats.increment("membership.probe.missed")
             if missed >= self.missed_limit and target in self.active:
                 await self.try_suspect_or_kill(target)
         else:
+            # probe round-trip latency (a few observations per second at
+            # most — the QoS-category SLO source: if PING traffic ever
+            # sits behind application load or batching accumulators,
+            # this histogram's tail shows it BEFORE silos get voted dead)
+            self.silo.stats.observe(_PROBE_RTT, time.monotonic() - t0)
             self.missed_probes[target] = 0
 
     # ------------------------------------------------------------------

@@ -27,10 +27,17 @@ from .profiling import (  # noqa: F401
     traced,
     uninstall_loop_profiler,
 )
+from .slo import (  # noqa: F401
+    SloMonitor,
+    SloSpec,
+    default_specs,
+)
 from .stats import (  # noqa: F401
     INGEST_STAGES,
     INGEST_STATS,
     REBALANCE_STATS,
+    SLO_STATS,
+    CallSiteStats,
     Histogram,
     StatsRegistry,
 )

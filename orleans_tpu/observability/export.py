@@ -52,7 +52,11 @@ def chrome_trace_events(spans, loop_profiles: dict | None = None
     COUNTER tracks ("ph": "C") beside the span rows — per-category loop
     occupancy shares sampled once per window, on the same zeroed
     timeline, so a span's latency lines up with what occupied the loop
-    around it. Span links ride into ``args`` (``links``) for the
+    around it — plus a per-silo "slow callbacks" flame row: each
+    window's top-K slowest-callback records as complete spans (labels +
+    categories exact; placement within the window is end-to-end from
+    the window start, since the profiler records durations, not
+    offsets). Span links ride into ``args`` (``links``) for the
     selection panel."""
     dicts = [s if isinstance(s, dict) else s.to_dict() for s in spans]
     starts = [s["start"] for s in dicts]
@@ -102,17 +106,59 @@ def chrome_trace_events(spans, loop_profiles: dict | None = None
             pid = pids[silo] = len(pids) + 1
             events.append({"ph": "M", "name": "process_name", "pid": pid,
                            "tid": 0, "args": {"name": silo}})
+        slow_tid = None
+        cursor = float("-inf")  # monotone across windows: spilled
+        # records must not overlap the NEXT window's records either
         for sl in slices:
             shares = sl.get("shares") or {}
-            if not shares:
+            if shares:
+                # one counter sample per occupancy window, at the window
+                # END (when the slice was cut); Perfetto stacks the args
+                events.append({
+                    "ph": "C", "name": "loop occupancy", "pid": pid,
+                    "tid": 0,
+                    "ts": (sl["ts"] - t0) * 1e6,
+                    "args": {k: v for k, v in sorted(shares.items())},
+                })
+            top = sl.get("top") or ()
+            if not top:
                 continue
-            # one counter sample per occupancy window, at the window END
-            # (when the slice was cut); Perfetto stacks the args series
-            events.append({
-                "ph": "C", "name": "loop occupancy", "pid": pid, "tid": 0,
-                "ts": (sl["ts"] - t0) * 1e6,
-                "args": {k: v for k, v in sorted(shares.items())},
-            })
+            if slow_tid is None:
+                # the flame row: the window's top-K slowest callbacks as
+                # real spans beside the occupancy counter track, so a
+                # breach/anomaly snapshot renders as "what the loop was
+                # running" instead of an opaque record list
+                slow_tid = len(tids) + 1
+                tids[(pid, -1)] = slow_tid
+                events.append({"ph": "M", "name": "thread_name",
+                               "pid": pid, "tid": slow_tid,
+                               "args": {"name": "slow callbacks"}})
+            wall = sl.get("wall_s", 0.0)
+            cursor = max(cursor, sl["ts"] - wall)
+            for rec in top:
+                dur = rec.get("seconds", 0.0)
+                # the profiler records duration + window, not each
+                # callback's offset within it — lay the records
+                # end-to-end from the window start (documented
+                # placement approximation; durations and the owning
+                # window are exact). When the top-K durations sum past
+                # the window end (a callback overrunning the window cut
+                # is booked whole to the window it ends in), records
+                # SPILL past the boundary rather than wrap — and the
+                # cursor stays monotone into the next window — because
+                # overlapping same-tid complete events would render as
+                # bogus nesting
+                events.append({
+                    "name": rec.get("label") or "?",
+                    "cat": rec.get("category", "other"),
+                    "ph": "X",
+                    "ts": (cursor - t0) * 1e6,
+                    "dur": max(dur, 1e-9) * 1e6,
+                    "pid": pid, "tid": slow_tid,
+                    "args": {"category": rec.get("category"),
+                             "window_ts": sl["ts"]},
+                })
+                cursor += dur
     return events
 
 

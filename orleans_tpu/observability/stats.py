@@ -13,9 +13,10 @@ import time
 from collections import deque
 from typing import Callable
 
-__all__ = ["StatsRegistry", "Histogram", "QueueWaitTrend", "DISPATCH_STATS",
-           "REBALANCE_STATS", "INGEST_STATS", "INGEST_STAGES",
-           "EGRESS_STATS", "EGRESS_STAGES", "SIZE_BOUNDS", "COUNT_BOUNDS"]
+__all__ = ["StatsRegistry", "Histogram", "QueueWaitTrend", "CallSiteStats",
+           "DISPATCH_STATS", "REBALANCE_STATS", "INGEST_STATS",
+           "INGEST_STAGES", "EGRESS_STATS", "EGRESS_STAGES", "SLO_STATS",
+           "SIZE_BOUNDS", "COUNT_BOUNDS"]
 
 # Hot-lane dispatch counter pair (runtime.hotlane): hits = calls that ran
 # as frame-collapsed inline turns (including the always-interleave direct
@@ -122,6 +123,28 @@ EGRESS_STATS = {
     "encode": "egress.encode.seconds",
     "group": "egress.flush_group.size",       # COUNT_BOUNDS histogram
     "responses": "egress.responses",          # counter: responses batched
+}
+
+
+# Canonical SLO-engine metric names (observability.slo.SloMonitor writes
+# these; the management surface, the Prometheus endpoint, and the
+# gauntlet verdicts read them by name). Per-objective gauges are
+# formatted with the objective name: ``SLO_STATS['burn_fast'] % name``.
+SLO_STATS = {
+    "breaches": "slo.breaches",                 # counter: breach episodes
+    "evaluations": "slo.evaluations",           # counter: monitor ticks
+    "breach": "slo.breach.%s",                  # counter per objective
+    "burn_fast": "slo.%s.burn_fast",            # gauge: fast-window burn
+    "burn_slow": "slo.%s.burn_slow",            # gauge: slow-window burn
+    "budget_burned": "slo.%s.budget_burned",    # gauge: cum budget spent
+    "breached": "slo.%s.breached",              # gauge: 0/1 current state
+    # membership probe round-trip latency (membership.oracle observes one
+    # sample per probe) — the QoS-category SLO source proving PING
+    # traffic never sits behind application load or SLO evaluation
+    "probe_rtt": "membership.probe.rtt.seconds",
+    # host-turn failures (dispatcher._run_turn error path) — the
+    # error-rate objective's bad-event counter
+    "turn_errors": "turns.errors",
 }
 
 
@@ -274,6 +297,61 @@ class Histogram:
                            for i, (v, t, ts) in ex.items()}
         return h
 
+    def delta(self, snapshot: dict | None) -> "Histogram":
+        """Interval diff: a NEW histogram holding the observations made
+        since ``snapshot`` (a prior :meth:`summary` of this same series)
+        was taken — the primitive burn-rate windows and attribution
+        benches are built on, replacing hand-rolled snapshot subtraction.
+
+        ``snapshot=None`` (no prior read) returns a copy of the whole
+        cumulative state. Mismatched bucket bounds (the series was
+        re-created with different bounds between reads, or the snapshot
+        crossed silos) are safe via the same deterministic widening rule
+        :meth:`merge` uses — each snapshot bucket folds into the bucket
+        of THIS histogram's bounds containing its upper bound before
+        subtracting, so counts never subtract positionally against the
+        wrong bucket. Per-bucket differences clamp at zero (a widened
+        fold can shift counts across buckets; clamping keeps the delta
+        conservative rather than negative), ``count`` is the sum of the
+        clamped buckets, and ``sum`` clamps at 0.0. Exemplars do not
+        carry (they are last-writer point events, not interval state)."""
+        bounds = None if self.bounds is self.BOUNDS else self.bounds
+        out = Histogram(bounds)
+        out.counts = list(self.counts)
+        out.sum = self.sum
+        if snapshot:
+            prev = Histogram.from_snapshot(snapshot)
+            if prev.bounds != self.bounds:
+                # widen the snapshot's counts onto OUR bounds first
+                # (merge's coarsening rule), then subtract
+                folded = [0] * len(self.counts)
+                last = len(folded) - 1
+                for b, c in zip(prev.bounds, prev.counts):
+                    if c:
+                        folded[min(bisect.bisect_left(self.bounds, b),
+                                   last)] += c
+                prev_counts = folded
+            else:
+                prev_counts = prev.counts
+            out.counts = [max(0, c - p)
+                          for c, p in zip(out.counts, prev_counts)]
+            out.sum = max(0.0, out.sum - prev.sum)
+        out.total = sum(out.counts)
+        return out
+
+    def good_below(self, threshold: float) -> int:
+        """Observations provably <= ``threshold`` from bucket counts:
+        the sum of buckets whose upper bound does not exceed it (the
+        bucket the threshold falls INSIDE is excluded — conservative,
+        like merged quantiles). The SLI numerator for latency
+        objectives: good = fast-enough events."""
+        good = 0
+        for b, c in zip(self.bounds, self.counts):
+            if b > threshold:
+                break
+            good += c
+        return good
+
 
 class QueueWaitTrend:
     """Windowed mean of the ingest queue-wait signal, for the load-shed
@@ -316,6 +394,98 @@ class QueueWaitTrend:
 
     def __len__(self) -> int:
         return len(self._samples)
+
+
+class CallSiteStats:
+    """Per-(grain_class, method) call-site latency/error table — bounded,
+    fed by the dispatcher's turn epilogue when ``metrics_enabled`` (one
+    dict lookup + four scalar updates per turn; nothing is installed
+    when metrics are off). The drill-down an SLO breach needs: which
+    grain methods are hot/slow/erroring RIGHT NOW — and the per-class
+    load signal the placement-policy compiler direction needs.
+
+    Bounded at ``cap`` distinct sites: method cardinality is static in
+    practice, but a pathological dynamic-interface workload must not
+    grow an unbounded dict on the turn path — sites past the cap are
+    counted in ``overflow`` and dropped. Single-loop use only (no
+    locking, like the registry itself)."""
+
+    __slots__ = ("cap", "sites", "overflow")
+
+    def __init__(self, cap: int = 256):
+        self.cap = cap
+        # (interface, method) -> [count, errors, sum_seconds, max_seconds]
+        self.sites: dict[tuple[str, str], list] = {}
+        self.overflow = 0
+
+    def note(self, interface: str, method: str, seconds: float,
+             error: bool = False) -> None:
+        key = (interface, method)
+        e = self.sites.get(key)
+        if e is None:
+            if len(self.sites) >= self.cap:
+                self.overflow += 1
+                return
+            e = self.sites[key] = [0, 0, 0.0, 0.0]
+        e[0] += 1
+        if error:
+            e[1] += 1
+        e[2] += seconds
+        if seconds > e[3]:
+            e[3] = seconds
+
+    def top(self, k: int = 10, by: str = "sum") -> list[dict]:
+        """The K hottest call sites, ranked by summed turn seconds
+        (``by="sum"``, the load view), call count (``"count"``), errors
+        (``"errors"``), or worst single turn (``"max"``)."""
+        return self.format_top(
+            {f"{i}.{m}": e for (i, m), e in self.sites.items()}, k, by)
+
+    @staticmethod
+    def format_top(sites: dict, k: int = 10, by: str = "sum"
+                   ) -> list[dict]:
+        """Rank + render ``{site_name: [count, errors, sum, max]}`` rows
+        (the :meth:`snapshot`/:meth:`merge` wire form) as the top-K
+        table — ONE formatter shared by per-silo :meth:`top` and the
+        ManagementGrain's cluster merge, so the two views cannot
+        drift."""
+        idx = {"count": 0, "errors": 1, "sum": 2, "max": 3}[by]
+        ranked = sorted(sites.items(), key=lambda kv: kv[1][idx],
+                        reverse=True)[:k]
+        return [{"site": site, "count": e[0], "errors": e[1],
+                 "seconds": round(e[2], 6),
+                 "mean_ms": round(e[2] / e[0] * 1e3, 3) if e[0] else 0.0,
+                 "max_ms": round(e[3] * 1e3, 3)}
+                for site, e in ranked]
+
+    def snapshot(self, k: int | None = None) -> dict:
+        """Wire/JSON form for the management fan-out (``k`` bounds the
+        payload to the top-K by summed seconds; None ships everything)."""
+        items = self.sites.items()
+        if k is not None and len(self.sites) > k:
+            items = sorted(items, key=lambda kv: kv[1][2],
+                           reverse=True)[:k]
+        return {"sites": {f"{i}.{m}": list(e) for (i, m), e in items},
+                "overflow": self.overflow}
+
+    @staticmethod
+    def merge(snapshots) -> dict:
+        """Fold per-silo :meth:`snapshot` payloads into one cluster-wide
+        table (counts/errors/seconds sum, max takes the max)."""
+        out: dict[str, list] = {}
+        overflow = 0
+        for snap in snapshots:
+            overflow += snap.get("overflow", 0)
+            for site, e in snap.get("sites", {}).items():
+                cur = out.get(site)
+                if cur is None:
+                    out[site] = list(e)
+                else:
+                    cur[0] += e[0]
+                    cur[1] += e[1]
+                    cur[2] += e[2]
+                    cur[3] = max(cur[3], e[3])
+        return {"sites": out, "overflow": overflow}
 
 
 # payload-size buckets (bytes) and small-count buckets (batch sizes) for

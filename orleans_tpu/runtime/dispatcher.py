@@ -56,9 +56,11 @@ log = logging.getLogger("orleans.dispatcher")
 _HDR_UNPARSED = object()
 
 from ..observability.stats import INGEST_STATS as _INGEST  # noqa: E402
+from ..observability.stats import SLO_STATS as _SLO  # noqa: E402
 
 _QUEUE_WAIT = _INGEST["queue_wait"]
 _TURNS = _INGEST["turns"]
+_TURN_ERRORS = _SLO["turn_errors"]
 
 MAX_FORWARD_COUNT = 2  # SiloMessagingOptions.MaxForwardCount default
 
@@ -71,6 +73,11 @@ class Dispatcher:
         # silo's registry when metrics_enabled, else None — cached here so
         # the per-turn guard is one attribute load
         self._istats = silo.ingest_stats
+        # per-(grain_class, method) call-site table (observability.stats.
+        # CallSiteStats): the silo's table when metrics_enabled, else
+        # None — fed in the turn epilogue, read by ctl_call_sites and
+        # the SLO breach drill-down
+        self._call_sites = silo.call_sites
         # host-loop occupancy profiler (observability.profiling): set by
         # Silo._install_loop_profiler when profiling_enabled, else None —
         # the per-turn guard is one attribute load
@@ -628,6 +635,10 @@ class Dispatcher:
             else:
                 log.exception("one-way turn failed on %s.%s",
                               msg.interface_name, msg.method_name)
+            # the SLO error-rate objective's bad-event counter (errors
+            # are rare — the unconditional increment costs nothing on
+            # the clean path, which never reaches here)
+            self.silo.stats.increment(_TURN_ERRORS)
             self.silo.catalog.on_invoke_error(activation, e)
         finally:
             # slow-turn detection (TurnWarningLengthThreshold,
@@ -645,6 +656,12 @@ class Dispatcher:
                             activation.grain_id)
             elif not n & 7:
                 self.silo.stats.observe("scheduler.turn_length", elapsed)
+            cs = self._call_sites
+            if cs is not None:
+                # call-site latency/error table (SLO breach drill-down):
+                # one dict upsert per turn, only when metrics are on
+                cs.note(msg.interface_name, msg.method_name, elapsed,
+                        turn_error is not None)
             if tspan is not None:
                 current_trace.reset(ttoken)
                 if turn_error is not None:

@@ -236,6 +236,27 @@ class SiloConfig:
     # on anomalies (load shed, watchdog lag, tail-retained traces). Off
     # (default): NOTHING is installed — the loop keeps its class methods
     # and hot paths pay one None check per site.
+    # SLO engine (observability.slo / config.SloOptions): a per-silo
+    # SloMonitor loop evaluating declarative objectives (app ingest
+    # latency, membership probe RTT, turn errors, gateway shed rate —
+    # or silo.slo_specs) every slo_period seconds with multi-window
+    # burn-rate detection (fast window catches, slow window confirms,
+    # both over slo_burn_threshold× the error budget). Breach →
+    # flight-recorder snapshot + tail-trace force-retention + slo.*
+    # counters/gauges + telemetry event; cluster rollup via
+    # ManagementGrain.get_cluster_slo. Evaluation rides interval-diffed
+    # registry snapshots — zero new hot-path instrumentation.
+    slo_enabled: bool = False
+    slo_period: float = 1.0
+    slo_fast_window: float = 60.0
+    slo_slow_window: float = 300.0
+    slo_burn_threshold: float = 4.0
+    slo_min_events: int = 10
+    slo_latency_threshold: float = 0.1
+    slo_latency_target: float = 0.99
+    slo_probe_target: float = 0.99
+    slo_error_target: float = 0.999
+    slo_shed_target: float = 0.99
     profiling_enabled: bool = False
     profiling_window: float = 1.0          # seconds per occupancy slice
     profiling_ring: int = 120              # slices retained (flight data)
@@ -757,6 +778,20 @@ class Silo:
         # queue-wait, engine staging/transfer/tick) guards on that None,
         # so the disabled hot path pays one attribute check
         self.ingest_stats = self.stats if config.metrics_enabled else None
+        # per-(grain_class, method) call-site latency/error table
+        # (observability.stats.CallSiteStats): fed by the dispatcher's
+        # turn epilogue when metrics are on — the drill-down an SLO
+        # breach resolves to ("which grain methods are hot/slow"), and
+        # the per-class load signal placement policies will consume
+        self.call_sites = None
+        if config.metrics_enabled:
+            from ..observability.stats import CallSiteStats
+            self.call_sites = CallSiteStats()
+        # SLO monitor (observability.slo.SloMonitor): installed at start
+        # when slo_enabled; silo.slo_specs (set pre-start by a builder
+        # configurator) overrides the default objective set
+        self.slo = None
+        self.slo_specs = None
         # queue-wait-trend shedding (observability.stats.QueueWaitTrend):
         # installed only when the knob is armed — fed by the dispatcher's
         # turn-start (and the engine's batch-start) queue-wait sites,
@@ -901,6 +936,19 @@ class Silo:
             from ..observability.metrics import MetricsHttpServer
             self.metrics_server = await MetricsHttpServer(self).start(
                 self.config.metrics_port)
+        if self.config.slo_enabled:
+            from ..observability.slo import SloMonitor
+            if not self.config.metrics_enabled and self.slo_specs is None:
+                # the latency/error/shed objectives ride the metrics
+                # substrate; default_specs installs ONLY the probe-RTT
+                # objective without it (a ratio objective whose bad
+                # counters still tick against a gated-off total would
+                # fabricate 100%-bad intervals)
+                log.warning("slo_enabled without metrics_enabled: only "
+                            "the probe-RTT objective is installed on %s",
+                            self.config.name)
+            self.slo = SloMonitor(self, specs=self.slo_specs)
+            self.slo.start()
         # replicated journaled grains need the notification target up
         # before any replica confirms events (eventsourcing notifications)
         for cls in self.registry.all_classes():
@@ -980,6 +1028,9 @@ class Silo:
         if self.tracer is not None:
             # graceful: decide + export what's buffered; kill: drop it
             await self.tracer.aclose(flush=graceful)
+        if self.slo is not None:
+            self.slo.stop()
+            self.slo = None
         if self.metrics is not None:
             self.metrics.stop()
             if graceful and self.metrics_sink is not None:

@@ -139,6 +139,34 @@ class TestClusterBuilder:
         self.config.update(cfg)
         return self
 
+    def with_slo(self, period: float = 0.05, fast_window: float = 0.3,
+                 slow_window: float = 0.8, burn_threshold: float = 2.0,
+                 min_events: int = 3, *,
+                 latency_threshold: float | None = None,
+                 latency_target: float | None = None,
+                 shed_target: float | None = None) -> "TestClusterBuilder":
+        """SLO engine on every silo (observability.slo.SloMonitor) with
+        test-sized windows: the fast/slow burn windows fill within a
+        sub-second drive so short tests see breaches detected and
+        recovered. Implies metrics (the latency objectives read the
+        ingest stage histograms); combine with ``with_profiling`` /
+        ``with_tracing(tail=True)`` to exercise the full breach path."""
+        cfg = dict(slo_enabled=True, slo_period=period,
+                   slo_fast_window=fast_window,
+                   slo_slow_window=slow_window,
+                   slo_burn_threshold=burn_threshold,
+                   slo_min_events=min_events)
+        if latency_threshold is not None:
+            cfg["slo_latency_threshold"] = latency_threshold
+        if latency_target is not None:
+            cfg["slo_latency_target"] = latency_target
+        if shed_target is not None:
+            cfg["slo_shed_target"] = shed_target
+        if not self.config.get("metrics_enabled"):
+            cfg.update(metrics_enabled=True, metrics_sample_period=0.1)
+        self.config.update(cfg)
+        return self
+
     def with_profiling(self, window: float = 0.1, ring: int = 120,
                        top_k: int = 8,
                        trigger_interval: float = 0.2
