@@ -260,13 +260,138 @@ def run(n_accounts: int = 65536, followers_per: int = 16,
     }
 
 
+# ---------------------------------------------------------------------------
+# Primitive-vs-message-per-edge A/B (ISSUE 13): celebrity-post follower
+# multicast through the HOST tier — one RPC per (chirp, follower) edge vs
+# one broadcast_actors collective carrying the whole edge list.
+# ---------------------------------------------------------------------------
+
+async def run_ab(n_followers: int = 64, n_chirpers: int = 8,
+                 n_accounts: int = 512, repeats: int = 2) -> dict:
+    """Follower fan-out on IDENTICAL edge traffic: per-edge
+    ``TimelineVec.recv`` RPCs (message-per-edge, the pre-primitive
+    shape) vs ONE ``broadcast_actors`` call per drive. Fan-out per chirp
+    is ``n_followers`` (the >=64 acceptance regime); emits the
+    wall-clock ratio + messages-eliminated; best-of-``repeats`` per side
+    with per-side ``gc.collect()`` (the ping-floor A/B discipline)."""
+    import asyncio
+    import gc
+
+    import jax.numpy as jnp
+    from orleans_tpu.dispatch import (VectorGrain, actor_method,
+                                      add_vector_grains)
+    from orleans_tpu.runtime import ClusterClient, SiloBuilder
+
+    class TimelineVec(VectorGrain):
+        STATE = {"received": (jnp.int32, ()), "last": (jnp.int32, ())}
+
+        @staticmethod
+        def initial_state(key_hash):
+            return {"received": jnp.int32(0), "last": jnp.int32(0)}
+
+        @actor_method(args={"chirp": (jnp.int32, ())})
+        def recv(state, args):
+            new = {"received": state["received"] + 1,
+                   "last": args["chirp"]}
+            return new, new["received"]
+
+        @actor_method(read_only=True)
+        def count(state, args):
+            return state, state["received"]
+
+    rng = np.random.default_rng(17)
+    # each chirper multicasts one chirp to its n_followers followers
+    followers = rng.integers(0, n_accounts, (n_chirpers, n_followers))
+    targets = followers.reshape(-1).astype(np.int64)
+    chirps = np.repeat(
+        rng.integers(1, 1 << 30, n_chirpers), n_followers).astype(np.int32)
+    n_edges = int(targets.size)
+
+    async def side(bulk: bool) -> tuple[float, int]:
+        b = SiloBuilder().with_name("chirp-ab")
+        add_vector_grains(b, TimelineVec, mesh=make_mesh(1),
+                          capacity_per_shard=n_accounts,
+                          dense={TimelineVec: n_accounts})
+        silo = b.build()
+        await silo.start()
+        client = await ClusterClient(silo.fabric).connect()
+        async def drive() -> int:
+            if bulk:
+                return await client.broadcast_actors(
+                    TimelineVec, "recv", targets, {"chirp": chirps})
+            delivered = 0
+            for off in range(0, n_edges, 256):
+                got = await asyncio.gather(*(
+                    client.get_grain(TimelineVec, int(t)).recv(
+                        chirp=np.int32(c))
+                    for t, c in zip(targets[off:off + 256],
+                                    chirps[off:off + 256])))
+                delivered += len(got)
+            return delivered
+
+        try:
+            # SYMMETRIC warmup: one full identical drive per side, out
+            # of the timed window — both sides' first-shape jit compiles
+            # / first-bucket tick-kernel builds are amortized equally,
+            # so the ratio measures steady-state dispatch, not compile
+            await drive()
+            gc.collect()
+            msgs0 = silo.stats.get("messaging.received.application")
+            t0 = time.perf_counter()
+            delivered = await drive()
+            wall = time.perf_counter() - t0
+            msgs = silo.stats.get("messaging.received.application") - msgs0
+            assert delivered == n_edges, (delivered, n_edges)
+            total = int(await client.reduce_actors(TimelineVec, "count"))
+            assert total == n_edges * 2, (total, n_edges * 2)
+            return wall, msgs
+        finally:
+            await client.close_async()
+            await silo.stop()
+
+    best_edge = best_bulk = float("inf")
+    msgs_edge = msgs_bulk = 0
+    for _ in range(repeats):
+        w, m = await side(bulk=False)
+        if w < best_edge:
+            best_edge, msgs_edge = w, m
+        w, m = await side(bulk=True)
+        if w < best_bulk:
+            best_bulk, msgs_bulk = w, m
+    ratio = best_edge / best_bulk
+    return {
+        "metric": "chirper_bulk_vs_per_edge_ratio",
+        "value": round(ratio, 2),
+        "unit": "x",
+        "vs_baseline": None,
+        "extra": {
+            "n_edges": n_edges,
+            "fan_out": n_followers,
+            "n_chirpers": n_chirpers,
+            "per_edge_wall_s": round(best_edge, 4),
+            "bulk_wall_s": round(best_bulk, 4),
+            "per_edge_deliveries_per_sec": round(n_edges / best_edge, 1),
+            "bulk_deliveries_per_sec": round(n_edges / best_bulk, 1),
+            "per_edge_app_msgs": msgs_edge,
+            "bulk_app_msgs": msgs_bulk,
+            "messages_eliminated": msgs_edge - msgs_bulk,
+        },
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--accounts", type=int, default=65536)
     ap.add_argument("--followers", type=int, default=16)
     ap.add_argument("--chirps", type=int, default=16384)
     ap.add_argument("--seconds", type=float, default=8.0)
+    ap.add_argument("--ab", action="store_true",
+                    help="run the host-tier bulk-vs-per-edge A/B")
     a = ap.parse_args()
+    if a.ab:
+        import asyncio
+        print(json.dumps(asyncio.run(run_ab())))
+        return
     print(json.dumps(run(a.accounts, a.followers, a.chirps,
                          seconds=a.seconds)))
 

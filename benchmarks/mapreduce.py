@@ -10,9 +10,11 @@ final table; prints elapsed ms for the whole pipeline.
 import argparse
 import asyncio
 import collections
+import gc
 import json
 import random
 import time
+import zlib
 
 if __package__ in (None, ""):
     import os
@@ -30,6 +32,15 @@ def make_text(n_words: int, seed: int) -> str:
     return " ".join(rng.choice(_WORDS) for _ in range(n_words))
 
 
+def word_partition(word: str, n_reducers: int) -> int:
+    """Stable word → reducer partition. crc32, NOT ``hash``: Python's
+    string hash is salted per process (PYTHONHASHSEED), so ``hash(w) %
+    n`` drives a different reducer traffic shape on every run and on
+    each side of an A/B — the partitions must be identical for the
+    comparison (and run-to-run numbers) to mean anything."""
+    return zlib.crc32(word.encode()) % n_reducers
+
+
 class MapperGrain(Grain):
     """Tokenize a block and push partial counts to reducers
     (MapReduce/WordCount mapper dataflow node)."""
@@ -38,7 +49,7 @@ class MapperGrain(Grain):
         counts: dict[str, int] = collections.Counter(text.split())
         by_reducer: dict[int, dict[str, int]] = {}
         for w, c in counts.items():
-            by_reducer.setdefault(hash(w) % n_reducers, {})[w] = c
+            by_reducer.setdefault(word_partition(w, n_reducers), {})[w] = c
         await asyncio.gather(*(
             self.get_grain(ReducerGrain, r).reduce_partial(part)
             for r, part in by_reducer.items()))
@@ -110,13 +121,147 @@ async def run(n_mappers: int = 16, n_reducers: int = 4,
     }
 
 
+# ---------------------------------------------------------------------------
+# Primitive-vs-message-per-edge A/B (ISSUE 13): the reduce phase of the
+# word count as device-tier bulk collectives vs one RPC per (block, word)
+# edge — identical edge traffic on both sides.
+# ---------------------------------------------------------------------------
+
+def _word_edges(n_blocks: int, words_per_block: int, vocab: int,
+                seed: int = 13):
+    """The shared traffic: per-(block, word) count edges over a synthetic
+    ``vocab``-word universe, flattened to (word_id, count) pairs."""
+    import numpy as np
+    rng = random.Random(seed)
+    words = [f"w{i:04d}" for i in range(vocab)]
+    targets, counts = [], []
+    for _ in range(n_blocks):
+        block = collections.Counter(
+            rng.choice(words) for _ in range(words_per_block))
+        for w, c in block.items():
+            targets.append(int(w[1:]))
+            counts.append(c)
+    return np.asarray(targets, np.int64), np.asarray(counts, np.int32)
+
+
+async def run_ab(n_blocks: int = 16, words_per_block: int = 512,
+                 vocab: int = 128, repeats: int = 2) -> dict:
+    """Word-count aggregation A/B on IDENTICAL edge traffic: per-edge
+    ``WordCountCell.add`` RPCs + per-word drain reads (message-per-edge)
+    vs ONE ``broadcast_actors`` + ONE ``reduce_actors`` (the bulk
+    collectives). Emits the wall-clock ratio and the messages-eliminated
+    count; best-of-``repeats`` per side with a per-side ``gc.collect()``
+    (the shared-core A/B discipline every ping-based floor uses)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+    from orleans_tpu.dispatch import (VectorGrain, actor_method,
+                                      add_vector_grains)
+    from orleans_tpu.parallel import make_mesh
+
+    class WordCountCell(VectorGrain):
+        STATE = {"count": (jnp.int32, ())}
+
+        @staticmethod
+        def initial_state(key_hash):
+            return {"count": jnp.int32(0)}
+
+        @actor_method(args={"c": (jnp.int32, ())})
+        def add(state, args):
+            new = {"count": state["count"] + args["c"]}
+            return new, new["count"]
+
+        @actor_method(read_only=True)
+        def read(state, args):
+            return state, state["count"]
+
+    targets, counts = _word_edges(n_blocks, words_per_block, vocab)
+    n_edges = int(targets.size)
+    expect = int(counts.sum())
+
+    async def side(bulk: bool) -> tuple[float, int]:
+        b = SiloBuilder().with_name("mr-ab")
+        add_vector_grains(b, WordCountCell, mesh=make_mesh(1),
+                          capacity_per_shard=vocab,
+                          dense={WordCountCell: vocab})
+        silo = b.build()
+        await silo.start()
+        client = await ClusterClient(silo.fabric).connect()
+        async def drive() -> int:
+            if bulk:
+                await client.broadcast_actors(WordCountCell, "add",
+                                              targets, {"c": counts})
+                return int(await client.reduce_actors(
+                    WordCountCell, "read"))
+            for off in range(0, n_edges, 256):
+                await asyncio.gather(*(
+                    client.get_grain(WordCountCell, int(t)).add(
+                        c=np.int32(c))
+                    for t, c in zip(targets[off:off + 256],
+                                    counts[off:off + 256])))
+            reads = await asyncio.gather(*(
+                client.get_grain(WordCountCell, w).read()
+                for w in range(vocab)))
+            return sum(int(r) for r in reads)
+
+        try:
+            # SYMMETRIC warmup: one full identical drive per side, out
+            # of the timed window, so both sides' first-shape jit
+            # compiles amortize equally and the ratio measures
+            # steady-state dispatch, not compile cost
+            await drive()
+            gc.collect()
+            msgs0 = silo.stats.get("messaging.received.application")
+            t0 = time.perf_counter()
+            total = await drive()
+            wall = time.perf_counter() - t0
+            msgs = silo.stats.get("messaging.received.application") - msgs0
+            assert total == expect * 2, (total, expect * 2)
+            return wall, msgs
+        finally:
+            await client.close_async()
+            await silo.stop()
+
+    best_edge = best_bulk = float("inf")
+    msgs_edge = msgs_bulk = 0
+    for _ in range(repeats):
+        w, m = await side(bulk=False)
+        if w < best_edge:
+            best_edge, msgs_edge = w, m
+        w, m = await side(bulk=True)
+        if w < best_bulk:
+            best_bulk, msgs_bulk = w, m
+    ratio = best_edge / best_bulk
+    return {
+        "metric": "mapreduce_bulk_vs_per_edge_ratio",
+        "value": round(ratio, 2),
+        "unit": "x",
+        "vs_baseline": None,
+        "extra": {
+            "n_edges": n_edges,
+            "vocab": vocab,
+            "fan_out": n_edges,  # edges per bulk dispatch
+            "per_edge_wall_s": round(best_edge, 4),
+            "bulk_wall_s": round(best_bulk, 4),
+            "per_edge_app_msgs": msgs_edge,
+            "bulk_app_msgs": msgs_bulk,
+            "messages_eliminated": msgs_edge - msgs_bulk,
+        },
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--mappers", type=int, default=16)
     ap.add_argument("--reducers", type=int, default=4)
     ap.add_argument("--words", type=int, default=2000)
     ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--ab", action="store_true",
+                    help="run the bulk-vs-per-edge A/B instead")
     a = ap.parse_args()
+    if a.ab:
+        print(json.dumps(asyncio.run(run_ab())))
+        return
     print(json.dumps(asyncio.run(
         run(a.mappers, a.reducers, a.words, a.repeats))))
 

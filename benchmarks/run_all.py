@@ -116,6 +116,13 @@ def main() -> None:
     for r in asyncio.run(gauntlet.run(short=True)):
         print(json.dumps(r))
     print(json.dumps(asyncio.run(mapreduce.run())))
+    # MapReduce-over-actors A/B (ISSUE 13): bulk collectives
+    # (broadcast_actors + reduce_actors) vs one RPC per (block, word) /
+    # (chirp, follower) edge on identical traffic — CI floor 3x at
+    # fan-out >= 64 in test_floor_map_actors, measured ~10-13x in-proc
+    # (symmetric warmup: steady-state dispatch, compile excluded)
+    print(json.dumps(asyncio.run(mapreduce.run_ab())))
+    print(json.dumps(asyncio.run(chirper_fanout.run_ab())))
     for r in serialization.run():
         print(json.dumps(r))
     print(json.dumps(asyncio.run(transactions.run(seconds=3.0))))

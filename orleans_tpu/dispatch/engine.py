@@ -74,6 +74,30 @@ def _emit(sink, st, key: str, value: float) -> None:
         st.observe(key, value)
 
 
+async def join_poll(reduce_once, need: int, timeout: float | None,
+                    poll: float) -> int:
+    """The ONE join_when poll driver, shared by the engine surface
+    (local reductions) and the client surface (one envelope per poll):
+    await ``reduce_once()`` — a sum-reduction over the key set — until
+    the first leaf reaches ``need`` or ``timeout`` elapses. Extracted so
+    readiness semantics (leaf extraction, deadline handling) cannot
+    drift between the two surfaces of the same primitive."""
+    loop = asyncio.get_running_loop()
+    deadline = None if timeout is None else loop.time() + timeout
+    while True:
+        val = await reduce_once()
+        ready = 0
+        if val is not None:
+            leaves = jax.tree_util.tree_leaves(val)
+            ready = int(leaves[0]) if leaves else 0
+        if ready >= need:
+            return ready
+        if deadline is not None and loop.time() >= deadline:
+            raise asyncio.TimeoutError(
+                f"join_when: {ready}/{need} ready after {timeout}s")
+        await asyncio.sleep(poll)
+
+
 def _validate_args(cls: type, method: str, schema: dict, args: dict) -> None:
     missing = set(schema) - set(args)
     extra = set(args) - set(schema)
@@ -1383,70 +1407,579 @@ class VectorRuntime:
         flows should persist via scheduled table checkpoints
         (``add_vector_grains(checkpoint_dir=...)``) instead.
         """
+        tbl = self.table(dest_class)
+        self.method_of(dest_class, method)  # validate the method exists
+
+        if sparse:
+            recv_lo, recv_hi = recv_keys
+            tk_lo, tk_hi, tv = tbl.device_dir.device_arrays()
+            slots, applied, khash = self._apply_resolver(
+                dest_class, True)(recv_lo, recv_hi, recv_valid,
+                                  tk_lo, tk_hi, tv)
+            fresh = jnp.zeros_like(applied)
+            results = self.call_batch_device(dest_class, method, slots,
+                                             khash, fresh, applied, args)
+            return results, applied
+
+        slots, applied, khash = self._apply_resolver(dest_class, False)(
+            recv_keys, recv_valid)
+        fresh = jnp.zeros_like(applied)
+        results = self.call_batch_device(dest_class, method, slots, khash,
+                                         fresh, applied, args)
+        return results, applied
+
+    def _apply_resolver(self, dest_class: type, sparse: bool):
+        """The cached jitted slot-resolution half of
+        :meth:`apply_received` (key → local slot + first-delivery dedup
+        mask). Cached per (class, regime, capacity, shard layout): a
+        fresh ``jax.jit(local)`` per call would RETRACE on every
+        delivery round — the repeated-fan-out hot path
+        (broadcast_actors' dedup rounds) pays a full compile per round
+        without this."""
         from ..ops.route import rank_dense_keys
 
         tbl = self.table(dest_class)
-        self.method_of(dest_class, method)  # validate the method exists
         per = max(tbl.dense_per_shard, 1)
+        key = ("apply", dest_class, sparse, per, tbl.capacity,
+               tbl.n_shards,
+               tbl.device_dir.max_probes if sparse else 0)
+        cached = self._kernel_cache.get(key)
+        if cached is not None:
+            return cached
+        capacity = tbl.capacity
+        n_shards = tbl.n_shards
 
         if sparse:
             from ..ops.hash_probe import device_lookup64
             from .table import _LOC_STRIDE
-            recv_lo, recv_hi = recv_keys
-            tk_lo, tk_hi, tv = tbl.device_dir.device_arrays()
             probes = tbl.device_dir.max_probes
 
             def local(klo, khi, ok, dlo, dhi, dv):
                 lo, hi, v = klo[0], khi[0], ok[0]
                 loc, found = device_lookup64(dlo, dhi, dv, lo, hi, probes)
-                if tbl.n_shards > 1:
+                if n_shards > 1:
                     myshard = jax.lax.axis_index(SILO_AXIS)
                 else:
                     myshard = 0
                 # defensive: a lane misrouted against a stale directory
                 # must not scribble another actor's slot on this shard
                 v = v & found & ((loc // _LOC_STRIDE) == myshard)
-                slot = jnp.where(v, loc % _LOC_STRIDE, tbl.capacity)
+                slot = jnp.where(v, loc % _LOC_STRIDE, capacity)
                 first = rank_dense_keys(jnp.where(v, slot,
-                                                  tbl.capacity + 1)) == 0
+                                                  capacity + 1)) == 0
                 applied = v & first
-                slot = jnp.where(applied, slot, tbl.capacity)
+                slot = jnp.where(applied, slot, capacity)
                 return slot[None], applied[None], lo[None]
 
-            if tbl.n_shards > 1:
+            if n_shards > 1:
                 spec = P(SILO_AXIS)
                 local = shard_map_compat(
                     local, mesh=self.mesh,
                     in_specs=(spec, spec, spec, P(), P(), P()),
                     out_specs=(spec, spec, spec), check_vma=False)
-            slots, applied, khash = jax.jit(local)(
-                recv_lo, recv_hi, recv_valid, tk_lo, tk_hi, tv)
-            fresh = jnp.zeros_like(applied)
-            results = self.call_batch_device(dest_class, method, slots,
-                                             khash, fresh, applied, args)
-            return results, applied
+        else:
+            def local(keys, ok):
+                k, v = keys[0], ok[0]
+                slot = jnp.where(v, k % per, capacity)
+                # dedup: only the first delivery per actor applies this
+                # tick
+                first = rank_dense_keys(jnp.where(v, slot,
+                                                  capacity + 1)) == 0
+                applied = v & first
+                slot = jnp.where(applied, slot, capacity)
+                return slot[None], applied[None], \
+                    (k & 0x7FFFFFFF).astype(jnp.int32)[None]
 
-        def local(keys, ok):
-            k, v = keys[0], ok[0]
-            slot = jnp.where(v, k % per, tbl.capacity)
-            # dedup: only the first delivery per actor applies this tick
-            first = rank_dense_keys(jnp.where(v, slot,
-                                              tbl.capacity + 1)) == 0
-            applied = v & first
-            slot = jnp.where(applied, slot, tbl.capacity)
-            return slot[None], applied[None], \
-                (k & 0x7FFFFFFF).astype(jnp.int32)[None]
+            if n_shards > 1:
+                spec = P(SILO_AXIS)
+                local = shard_map_compat(
+                    local, mesh=self.mesh, in_specs=(spec, spec),
+                    out_specs=(spec, spec, spec), check_vma=False)
+        cached = jax.jit(local)
+        self._kernel_cache[key] = cached
+        return cached
 
+    # ------------------------------------------------------------------
+    # Bulk-population collectives (MapReduce over actors — ROADMAP's
+    # DrJAX direction, arXiv 2403.07128): population-wide fan-out/fan-in
+    # compiled onto the sharded table as single-dispatch ticks instead of
+    # message-per-edge RPC trains. All three primitives serialize with
+    # the off-loop tick worker through the PR-9 fence, re-resolve key
+    # locations per round (so grow/migration/checkpoint interleaving at
+    # their await points is safe by construction), and defer keys that
+    # have in-flight per-key turns exactly like call_group conflicts
+    # defer (turn semantics: at most one message per activation per
+    # tick, bulk or not).
+    # ------------------------------------------------------------------
+    def _bulk_resolve(self, cls: type, keys: np.ndarray | None
+                      ) -> tuple[np.ndarray, np.ndarray, np.ndarray,
+                                 np.ndarray]:
+        """Resolve a bulk target set into ``(keys, shard, slot, fresh)``
+        numpy arrays. ``keys=None`` targets every LIVE activation (dense
+        keys actually touched + resident hashed rows — the "apply to the
+        whole population" form). An explicit key subset may include
+        dense-provisioned keys not yet activated (they fresh-init this
+        tick, the call_batch auto-activate contract); hashed keys must
+        be resident — non-resident ones are skipped, mirroring the
+        live-actor semantics (the returned keys array is the applied
+        set). Locations are resolved HERE, per call: bulk rounds never
+        cache a (shard, slot) across an await, so a migration or grow
+        between rounds can never strand a stale address."""
+        tbl = self.table(cls)
+        if keys is None:
+            dense = np.flatnonzero(tbl.dense_active).astype(np.int64)
+            n_h = len(tbl.key_to_slot)
+            hashed = np.fromiter(tbl.key_to_slot, dtype=np.int64,
+                                 count=n_h)
+            fresh = np.zeros(dense.size + n_h, dtype=bool)
+        else:
+            # np.unique deduplicates: one message per actor per bulk op
+            keys = np.unique(np.asarray(keys, dtype=np.int64))
+            is_dense = (keys >= 0) & (keys < tbl.dense_n)
+            dense = keys[is_dense]
+            resident = np.fromiter(
+                (k in tbl.key_to_slot for k in keys[~is_dense].tolist()),
+                dtype=bool, count=int((~is_dense).sum()))
+            hashed = keys[~is_dense][resident]
+            fresh = np.concatenate([
+                ~tbl.dense_active[dense] if dense.size else
+                np.zeros(0, bool),
+                np.zeros(hashed.size, bool)])
+        d_sh, d_sl = tbl.dense_shard_slot(dense)
+        d_shard, d_slot = d_sh.astype(np.int32), d_sl.astype(np.int32)
+        if hashed.size:
+            locs = np.array([tbl.key_to_slot[int(k)] for k in hashed],
+                            dtype=np.int32).reshape(-1, 2)
+            h_shard, h_slot = locs[:, 0], locs[:, 1]
+        else:
+            h_shard = h_slot = np.zeros(0, dtype=np.int32)
+        out_keys = np.concatenate([dense, hashed]) if hashed.size \
+            else dense
+        shard = np.concatenate([d_shard, h_shard])
+        slot = np.concatenate([d_slot, h_slot])
+        return out_keys, shard, slot, fresh
+
+    def _bulk_pack(self, tbl, shard: np.ndarray, slot: np.ndarray,
+                   keys: np.ndarray, fresh: np.ndarray):
+        """Arbitrary-location analog of ``make_dense_plan``'s layout:
+        group M (shard, slot) targets into padded ``[n_shards, B]``
+        batch buffers (idle lanes aim at the sink row)."""
+        n = tbl.n_shards
+        order = np.argsort(shard, kind="stable")
+        counts = np.bincount(shard, minlength=n)
+        B = _bucket(int(counts.max()) if shard.size else MIN_BUCKET)
+        starts = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(counts, out=starts[1:])
+        ss = shard[order]
+        lane = np.arange(shard.size) - starts[ss]
+        slots_b = np.full((n, B), tbl.sink_slot, dtype=np.int32)
+        valid_b = np.zeros((n, B), dtype=bool)
+        khash_b = np.zeros((n, B), dtype=np.int32)
+        fresh_b = np.zeros((n, B), dtype=bool)
+        slots_b[ss, lane] = slot[order]
+        valid_b[ss, lane] = True
+        khash_b[ss, lane] = (keys[order] & 0x7FFFFFFF).astype(np.int32)
+        fresh_b[ss, lane] = fresh[order]
+        return slots_b, khash_b, fresh_b, valid_b, B
+
+    def _bulk_args(self, cls: type, m, kwargs: dict | None, n: int,
+                   B: int) -> dict:
+        """Broadcast ONE kwargs row to every lane of a ``[n, B]`` batch
+        (the map/reduce payload form: same message to the whole
+        population; per-actor payloads are call_batch's job)."""
+        kwargs = kwargs or {}
+        if m.args_schema is None:
+            m.args_schema = {
+                k: (np.asarray(v).dtype, np.asarray(v).shape)
+                for k, v in kwargs.items()}
+        _validate_args(cls, m.name, m.args_schema, kwargs)
+        return {f: np.broadcast_to(
+                    np.asarray(kwargs[f], dtype=dtype), (n, B, *shape))
+                for f, (dtype, shape) in m.args_schema.items()}
+
+    def _bulk_apply_once(self, cls: type, method: str, keys: np.ndarray,
+                         shard: np.ndarray, slot: np.ndarray,
+                         fresh: np.ndarray, kwargs: dict | None):
+        """One bulk tick over resolved targets: pack → kernel → commit,
+        under the tick fence (the caller IS the tick, like call_batch).
+        Returns ``(results_device, valid_b)`` for the reduce half."""
+        tbl = self.table(cls)
+        m = self.method_of(cls, method)
+        slots_b, khash_b, fresh_b, valid_b, B = self._bulk_pack(
+            tbl, shard, slot, keys, fresh)
+        args_b = self._bulk_args(cls, m, kwargs, tbl.n_shards, B)
+        # the fence/kernel/commit/telemetry block is call_batch_device's
+        # (one tick-semantics implementation, not two that drift); this
+        # wrapper only adds the host-side bulk bookkeeping it can do
+        # because it HOLDS the keys: write-behind dirty marks and dense
+        # activation
+        results = self.call_batch_device(
+            cls, method, slots_b,
+            jnp.asarray(khash_b), jnp.asarray(fresh_b), valid_b,
+            {k: jnp.asarray(v) for k, v in args_b.items()})
+        if not m.read_only:
+            self._mark_dirty(cls, keys)
+            if fresh.any():
+                # read-only bulk ticks never write the fresh-init rows
+                # back (the kernel skips the scatter), so marking those
+                # keys active would hand later writes an uninitialized
+                # row; the fresh mask just re-derives next call —
+                # idempotent reads
+                tbl.mark_dense_active(keys[fresh])
+        return results, valid_b
+
+    def _busy_split(self, cls: type, keys: np.ndarray):
+        """Split targets into ``(ready, deferred, busy_mask)`` against
+        keys with queued or worker-in-flight per-key turns — the bulk
+        analog of ``_claim``'s same-slot conflict defer. ``busy_mask``
+        is None when nothing is busy (the common case — callers use it
+        to slice parallel arrays without recomputing the membership
+        test)."""
+        busy = self.pending_key_hashes(cls)
+        if not busy:
+            return keys, keys[:0], None
+        mask = np.isin(keys, np.fromiter(busy, dtype=np.int64,
+                                         count=len(busy)))
+        return keys[~mask], keys[mask], mask
+
+    async def _bulk_yield(self) -> None:
+        """Let deferred per-key turns drain one round: run the pending
+        tick (or await the off-loop worker's quiescence) before the next
+        bulk round re-resolves."""
+        if self.pending:
+            self._tick()
+        if self._inflight:
+            await self._quiesced.wait()
+        else:
+            await asyncio.sleep(0)
+
+    async def _bulk_rounds(self, grain_class: type, method: str,
+                           kwargs: dict | None, keys, skip_busy: bool,
+                           on_apply) -> None:
+        """The ONE deferral-round driver behind map_actors and
+        reduce_actors: resolve targets → split off keys with queued/
+        in-flight per-key turns (unless ``skip_busy`` — read-only
+        reductions have no turn to conflict with) → bulk-apply the
+        ready slice → yield a tick round for the deferred rest and
+        re-resolve. ``on_apply(results, valid_b, n_ready)`` accumulates
+        per round. Shared so the conflict/selection logic cannot drift
+        between the two primitives."""
+        target_keys = keys
+        while True:
+            ks, shard, slot, fresh = self._bulk_resolve(grain_class,
+                                                        target_keys)
+            if skip_busy:
+                ready, deferred, bmask = ks, ks[:0], None
+            else:
+                ready, deferred, bmask = self._busy_split(grain_class,
+                                                          ks)
+            if ready.size:
+                sel = slice(None) if bmask is None else ~bmask
+                results, valid_b = self._bulk_apply_once(
+                    grain_class, method, ks[sel], shard[sel], slot[sel],
+                    fresh[sel], kwargs)
+                on_apply(results, valid_b, int(ready.size))
+            if not deferred.size:
+                return
+            target_keys = deferred
+            await self._bulk_yield()
+
+    async def map_actors(self, grain_class: type, method: str,
+                         kwargs: dict | None = None,
+                         keys: np.ndarray | None = None) -> int:
+        """Apply ``method`` (one broadcast kwargs row) to every live
+        activation of ``grain_class`` — or a key subset — as bulk ticks:
+        ONE kernel dispatch per conflict-free round instead of N per-key
+        messages. Keys with in-flight per-key turns defer to later
+        rounds (call_group conflict semantics); locations re-resolve per
+        round, so migration/grow/checkpoint racing the await points stay
+        safe under the tick fence. Returns the number of activations
+        applied."""
+        m = self.method_of(grain_class, method)
+        if m.args_schema is not None:
+            # validate up front: a schema mismatch must fail even when
+            # the live population is empty (no batch ever runs)
+            _validate_args(grain_class, method, m.args_schema,
+                           kwargs or {})
+        applied = 0
+
+        def on_apply(_results, _valid_b, n: int) -> None:
+            nonlocal applied
+            applied += n
+
+        await self._bulk_rounds(grain_class, method, kwargs, keys,
+                                False, on_apply)
+        return applied
+
+    async def reduce_actors(self, grain_class: type, method: str,
+                            kwargs: dict | None = None,
+                            keys: np.ndarray | None = None,
+                            combine: str = "sum"):
+        """Run ``method`` over the population and reduce the per-actor
+        results ON DEVICE (ops.segment_reduce.masked_reduce): ONE
+        scalar/row crosses the host boundary instead of N responses.
+        ``combine``: "sum" | "max" | "min" | "mean" (mean = sum/count,
+        combined exactly across rounds and silos as (sum, count) pairs).
+        Returns the reduced result pytree (host numpy); None when no
+        live actor matched."""
+        value, count = await self.reduce_actors_partial(
+            grain_class, method, kwargs, keys, combine)
+        if value is None or count == 0:
+            return None
+        if combine == "mean":
+            return jax.tree_util.tree_map(lambda v: v / count, value)
+        return value
+
+    async def reduce_actors_partial(self, grain_class: type, method: str,
+                                    kwargs: dict | None = None,
+                                    keys: np.ndarray | None = None,
+                                    combine: str = "sum"):
+        """The combinable form of :meth:`reduce_actors`: returns
+        ``(partial_value, count)`` where mean partials carry the SUM
+        (divide at the top) — what the dispatcher's cross-silo merge
+        folds, and what multi-round conflict deferral folds locally."""
+        from ..ops.segment_reduce import (REDUCE_OPS, host_fold,
+                                          masked_reduce)
+        op = "sum" if combine == "mean" else combine
+        if op not in REDUCE_OPS:
+            raise ValueError(
+                f"combine must be one of {REDUCE_OPS + ('mean',)}, "
+                f"got {combine!r}")
+        m = self.method_of(grain_class, method)
+        if m.args_schema is not None:
+            _validate_args(grain_class, method, m.args_schema,
+                           kwargs or {})  # fail fast on empty tables too
+        total = None
+        count = 0
+        fold = host_fold(op)
+
+        def on_apply(results, valid_b, n: int) -> None:
+            nonlocal total, count
+            part = jax.tree_util.tree_map(
+                np.asarray,
+                masked_reduce(results, jnp.asarray(valid_b), op=op))
+            count += n
+            total = part if total is None else \
+                jax.tree_util.tree_map(fold, total, part)
+
+        # read-only reductions never write, so there is no turn to
+        # conflict with — they run in one tick over everything
+        await self._bulk_rounds(grain_class, method, kwargs, keys,
+                                m.read_only, on_apply)
+        return total, count
+
+    def _init_kernel(self, cls: type, B: int):
+        """Bulk OnActivate kernel: scatter ``initial_state(khash)`` rows
+        at masked lanes — no handler, so it serves read-only methods
+        too. Cached per (class, B, capacity, shards) like the tick
+        kernels."""
+        tbl = self.tables[cls]
+        key = ("bulkinit", cls, B, tbl.capacity, tbl.n_shards)
+        k = self._kernel_cache.get(key)
+        if k is not None:
+            return k
+        init = cls.initial_state
+        mesh = tbl.mesh
+
+        def local(state, slots, khash, fresh):
+            state_l = jax.tree_util.tree_map(lambda a: a[0], state)
+            slots_l, khash_l, fresh_l = slots[0], khash[0], fresh[0]
+            rows = jax.tree_util.tree_map(lambda f: f[slots_l], state_l)
+            init_rows = jax.vmap(init)(khash_l)
+
+            def sel(a, b):
+                return jnp.where(
+                    fresh_l.reshape(fresh_l.shape
+                                    + (1,) * (a.ndim - 1)), a, b)
+
+            new_state_l = jax.tree_util.tree_map(
+                lambda f, ir, r: f.at[slots_l].set(sel(ir, r)),
+                state_l, init_rows, rows)
+            return jax.tree_util.tree_map(lambda a: a[None], new_state_l)
+
+        body = local
         if tbl.n_shards > 1:
             spec = P(SILO_AXIS)
-            local = shard_map_compat(
-                local, mesh=self.mesh, in_specs=(spec, spec),
-                out_specs=(spec, spec, spec), check_vma=False)
-        slots, applied, khash = jax.jit(local)(recv_keys, recv_valid)
-        fresh = jnp.zeros_like(applied)
-        results = self.call_batch_device(dest_class, method, slots, khash,
-                                         fresh, applied, args)
-        return results, applied
+            body = shard_map_compat(
+                body, mesh=mesh, in_specs=(spec, spec, spec, spec),
+                out_specs=spec, check_vma=False)
+        k = jax.jit(body, donate_argnums=(0,))
+        self._kernel_cache[key] = k
+        return k
+
+    def _bulk_activate(self, cls: type, keys: np.ndarray) -> None:
+        """Bulk OnActivate for dense keys a broadcast is about to
+        scatter into: fresh-init rows land BEFORE apply_received's
+        zero-fresh batches touch them (the per-key paths do this one
+        activation at a time; bulk fan-out does it as one scatter)."""
+        tbl = self.table(cls)
+        fresh = tbl.dense_fresh_mask(keys)
+        if fresh is None:
+            return
+        ks = np.unique(keys[fresh])
+        sh, sl = tbl.dense_shard_slot(ks)
+        shard, slot = sh.astype(np.int32), sl.astype(np.int32)
+        slots_b, khash_b, fresh_b, _valid_b, B = self._bulk_pack(
+            tbl, shard, slot, ks, np.ones(ks.size, bool))
+        kern = self._init_kernel(cls, B)
+        with self._fence:
+            tbl.state = kern(tbl.state, jnp.asarray(slots_b),
+                             jnp.asarray(khash_b), jnp.asarray(fresh_b))
+        tbl.mark_dense_active(ks)
+
+    async def broadcast_actors(self, grain_class: type, method: str,
+                               targets: np.ndarray,
+                               args: dict | None = None,
+                               chunk: int = 16384) -> int:
+        """Edge-list fan-out as device collectives: deliver ``method``
+        to ``targets[i]`` with per-edge payload ``args[f][i]`` — the
+        celebrity-post multicast as a handful of batched dispatches
+        instead of O(edges) messages. Targets must be dense-regime keys
+        (the follower-list case); each host-side chunk rides ONE
+        ``parallel.transport`` exchange to the owning shards (capacity
+        sized so overflow drops are impossible) and scatters into target
+        rows via :meth:`apply_received`, whose on-device dedup gives
+        duplicate targets the mailbox-defer semantics across ticks.
+        Edge targets with in-flight per-key turns defer to later rounds
+        like map_actors. Returns the number of edges delivered."""
+        tbl = self.table(grain_class)
+        targets = np.asarray(targets, dtype=np.int64).reshape(-1)
+        if targets.size and (targets.min() < 0
+                             or targets.max() >= tbl.dense_n):
+            raise ValueError(
+                "broadcast_actors targets must be dense-regime keys "
+                f"in [0, {tbl.dense_n}); route hashed-key traffic "
+                "through map_actors/call paths")
+        m = self.method_of(grain_class, method)
+        E = targets.shape[0]
+        args = args or {}
+        if m.args_schema is None:
+            m.args_schema = {
+                k: (np.asarray(v).dtype, np.asarray(v).shape[1:]
+                    if np.asarray(v).ndim else ())
+                for k, v in args.items()}
+        schema = m.args_schema
+        if set(args) != set(schema):
+            _validate_args(grain_class, method, schema, args)
+        # per-edge [E, *shape] payloads; scalars broadcast to every edge
+        flat_args = {f: np.broadcast_to(
+                         np.asarray(args[f], dtype=dtype), (E, *shape))
+                     for f, (dtype, shape) in schema.items()}
+        delivered = 0
+        pending = (targets, flat_args)
+        while pending[0].size:
+            tg, fa = pending
+            _ready, deferred, bmask = self._busy_split(grain_class, tg)
+            if deferred.size:
+                pending = (tg[bmask],
+                           {f: a[bmask] for f, a in fa.items()})
+                tg, fa = tg[~bmask], \
+                    {f: a[~bmask] for f, a in fa.items()}
+            else:
+                pending = (tg[:0], {f: a[:0] for f, a in fa.items()})
+            for off in range(0, tg.shape[0], chunk):
+                ce = tg[off:off + chunk]
+                ca = {f: a[off:off + chunk] for f, a in fa.items()}
+                delivered += self._broadcast_chunk(grain_class, method,
+                                                   ce, ca)
+            if not pending[0].size:
+                return delivered
+            await self._bulk_yield()
+        return delivered
+
+    def _broadcast_chunk(self, cls: type, method: str,
+                         targets: np.ndarray, args: dict) -> int:
+        """Route one edge chunk to its owning shards (one all_to_all)
+        and apply it, re-applying deduped duplicate-target lanes tick by
+        tick until every edge lands. Synchronous: the dedup rounds are
+        back-to-back device calls (each under the tick fence via
+        call_batch_device), so no per-key turn can interleave
+        mid-chunk."""
+        tbl = self.table(cls)
+        self._bulk_activate(cls, targets)
+        n = tbl.n_shards
+        E = targets.shape[0]
+        if E == 0:
+            return 0
+        schema = tbl.methods[method].args_schema
+        if n == 1:
+            # lane count bucketed to a power of two so partition-size
+            # jitter across rounds reuses the same compiled kernels
+            B = _bucket(E)
+            pad = B - E
+            recv_keys = jnp.asarray(np.concatenate(
+                [targets, np.zeros(pad, dtype=targets.dtype)])[None, :])
+            recv_valid = jnp.asarray(np.concatenate(
+                [np.ones(E, bool), np.zeros(pad, bool)])[None, :])
+            recv_args = {}
+            for f, (dtype, shape) in schema.items():
+                a = np.asarray(args[f], dtype=dtype)
+                recv_args[f] = jnp.asarray(np.concatenate(
+                    [a, np.zeros((pad, *shape), dtype=dtype)])[None])
+        else:
+            # split edges across source shards (the host is every
+            # shard's ingress here), pad to equal POWER-OF-TWO lanes
+            # (bucketed so varying edge counts reuse the compiled
+            # exchange), capacity = lanes-per-shard so per-(src, dst)
+            # overflow is impossible by construction (rank < L <=
+            # capacity)
+            L = _bucket(-(-E // n))
+            pad = n * L - E
+            tg = np.concatenate([targets,
+                                 np.zeros(pad, dtype=targets.dtype)])
+            vd = np.concatenate([np.ones(E, bool), np.zeros(pad, bool)])
+            payload = {}
+            for f, (dtype, shape) in schema.items():
+                a = np.asarray(args[f], dtype=dtype)
+                a = np.concatenate(
+                    [a, np.zeros((pad, *shape), dtype=dtype)])
+                payload[f] = jnp.asarray(a.reshape(n, L, *shape))
+            recv_keys, recv_args, recv_valid, drops = self.route(
+                cls, jnp.asarray(tg.reshape(n, L)), payload,
+                jnp.asarray(vd.reshape(n, L)), capacity=L)
+            # capacity == L makes overflow impossible; a nonzero count
+            # here means the invariant broke, not load
+            assert int(np.asarray(drops).sum()) == 0
+        delivered = 0
+        valid = recv_valid
+        while True:
+            _res, applied = self.apply_received(cls, method, recv_keys,
+                                                valid, recv_args)
+            valid = valid & ~applied
+            got = int(np.asarray(jnp.sum(applied)))
+            delivered += got
+            left = int(np.asarray(jnp.sum(valid)))
+            if left == 0 or got == 0:
+                # got == 0 with lanes left cannot happen for in-range
+                # dense keys (dedup always applies the first of each);
+                # the guard keeps a logic bug from spinning forever
+                break
+        if delivered and not tbl.methods[method].read_only:
+            # write-behind dirty marks: apply_received's device-resident
+            # exchange exemption does NOT apply here — broadcast holds
+            # the target keys on the host, so the flusher must see the
+            # written rows or a restart silently reverts every
+            # broadcast-applied update
+            self._mark_dirty(cls, np.unique(targets))
+        return delivered
+
+    async def join_when(self, grain_class: type, keys: np.ndarray,
+                        k: int | None = None, *, method: str,
+                        kwargs: dict | None = None,
+                        timeout: float | None = None,
+                        poll: float = 0.02) -> int:
+        """Join-calculus readiness step (arXiv 1302.6329 direction):
+        resolve when at least ``k`` of ``keys`` (default: all) report
+        ready through ``method`` — a read-only actor method returning
+        0/1 per actor. Each poll is ONE reduce_actors sum (a single
+        device reduction, one scalar to host) instead of K host futures
+        bouncing through the loop. Returns the ready count observed."""
+        keys = np.asarray(keys, dtype=np.int64)
+        need = int(keys.size if k is None else k)
+        return await join_poll(
+            lambda: self.reduce_actors(grain_class, method, kwargs,
+                                       keys=keys, combine="sum"),
+            need, timeout, poll)
 
     # ------------------------------------------------------------------
     # Kernel construction

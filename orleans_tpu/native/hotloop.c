@@ -89,13 +89,14 @@ fail:
     PyErr_WriteUnraisable(d);
 }
 
-/* call profiler.<name>(...) with any pending exception preserved */
+/* call profiler.<name>(...) with any pending exception preserved
+ * (a2/a3 may be NULL — ObjArgs terminates at the first NULL) */
 static void call_slow_path(Runner *r, PyObject *name, PyObject *a1,
-                           PyObject *a2) {
+                           PyObject *a2, PyObject *a3) {
     PyObject *exc_type, *exc_val, *exc_tb;
     PyErr_Fetch(&exc_type, &exc_val, &exc_tb);
     PyObject *res = PyObject_CallMethodObjArgs(r->profiler, name, a1, a2,
-                                               NULL);
+                                               a3, NULL);
     if (res == NULL)
         PyErr_WriteUnraisable(r->profiler);
     else
@@ -156,17 +157,21 @@ static PyObject *runner_vectorcall(PyObject *self, PyObject *const *args,
     r->last_end = end;
     if (end - now > r->top_min) {
         /* top-K slow-callback record (rare: the bar rises to the K-th
-         * slowest as the window fills) */
+         * slowest as the window fills).  The third argument is the
+         * callback's start offset WITHIN the open window, so the
+         * Perfetto flame row places the record exactly instead of
+         * laying durations end-to-end from the window start. */
         PyObject *dur = PyFloat_FromDouble(end - now);
-        if (dur != NULL) {
-            call_slow_path(r, s_record_top, cb, dur);
-            Py_DECREF(dur);
-        }
+        PyObject *off = PyFloat_FromDouble(now - r->win_start);
+        if (dur != NULL && off != NULL)
+            call_slow_path(r, s_record_top, cb, dur, off);
+        Py_XDECREF(dur);
+        Py_XDECREF(off);
     }
     if (end - r->win_start >= r->window) {
         PyObject *endf = PyFloat_FromDouble(end);
         if (endf != NULL) {
-            call_slow_path(r, s_finalize_window, endf, NULL);
+            call_slow_path(r, s_finalize_window, endf, NULL, NULL);
             Py_DECREF(endf);
         }
     }

@@ -53,11 +53,11 @@ def chrome_trace_events(spans, loop_profiles: dict | None = None
     occupancy shares sampled once per window, on the same zeroed
     timeline, so a span's latency lines up with what occupied the loop
     around it — plus a per-silo "slow callbacks" flame row: each
-    window's top-K slowest-callback records as complete spans (labels +
-    categories exact; placement within the window is end-to-end from
-    the window start, since the profiler records durations, not
-    offsets). Span links ride into ``args`` (``links``) for the
-    selection panel."""
+    window's top-K slowest-callback records as complete spans (labels,
+    categories, and placement exact — the profiler stamps each record's
+    start offset within its window; offset-less legacy records fall
+    back to end-to-end cursor placement from the window start). Span
+    links ride into ``args`` (``links``) for the selection panel."""
     dicts = [s if isinstance(s, dict) else s.to_dict() for s in spans]
     starts = [s["start"] for s in dicts]
     for slices in (loop_profiles or {}).values():
@@ -134,31 +134,43 @@ def chrome_trace_events(spans, loop_profiles: dict | None = None
                                "pid": pid, "tid": slow_tid,
                                "args": {"name": "slow callbacks"}})
             wall = sl.get("wall_s", 0.0)
-            cursor = max(cursor, sl["ts"] - wall)
+            win_start = sl["ts"] - wall
+            cursor = max(cursor, win_start)
             for rec in top:
                 dur = rec.get("seconds", 0.0)
-                # the profiler records duration + window, not each
-                # callback's offset within it — lay the records
-                # end-to-end from the window start (documented
-                # placement approximation; durations and the owning
-                # window are exact). When the top-K durations sum past
-                # the window end (a callback overrunning the window cut
-                # is booked whole to the window it ends in), records
-                # SPILL past the boundary rather than wrap — and the
-                # cursor stays monotone into the next window — because
-                # overlapping same-tid complete events would render as
-                # bogus nesting
+                off = rec.get("offset")
+                if off is not None:
+                    # exact placement: the profiler stamps each record's
+                    # start offset within its window (hotloop.c / the
+                    # Python reference), so the record sits where the
+                    # callback actually ran — no cursor approximation.
+                    # Exact records cannot overlap (callbacks are
+                    # sequential on one loop); the cursor still advances
+                    # past them so any offset-less legacy record in the
+                    # same stream stays non-overlapping.
+                    start = win_start + off
+                    cursor = max(cursor, start + dur)
+                else:
+                    # legacy records carry duration + window only — lay
+                    # them end-to-end from the window start (placement
+                    # approximation; durations and the owning window are
+                    # exact). When durations sum past the window end,
+                    # records SPILL past the boundary rather than wrap —
+                    # and the cursor stays monotone into the next window
+                    # — because overlapping same-tid complete events
+                    # would render as bogus nesting
+                    start = cursor
+                    cursor += dur
                 events.append({
                     "name": rec.get("label") or "?",
                     "cat": rec.get("category", "other"),
                     "ph": "X",
-                    "ts": (cursor - t0) * 1e6,
+                    "ts": (start - t0) * 1e6,
                     "dur": max(dur, 1e-9) * 1e6,
                     "pid": pid, "tid": slow_tid,
                     "args": {"category": rec.get("category"),
                              "window_ts": sl["ts"]},
                 })
-                cursor += dur
     return events
 
 

@@ -189,7 +189,8 @@ class LoopProfiler:
         self.started = now
         self._win_start = now
         self._win_cats: dict[str, float] = {}
-        self._win_top: list[tuple[float, str, str]] = []
+        # (duration, category, label, within-window start offset)
+        self._win_top: list[tuple[float, str, str, float | None]] = []
         self._top_min = 0.0      # admission bar for the top-K record path
         self._last_end = now     # end of the previous callback (idle from)
         self._depth = 0          # >0 while inside a wrapped callback
@@ -266,14 +267,21 @@ class LoopProfiler:
             if end - now > self._top_min:
                 # top-K slow-callback record (rare by construction: the
                 # bar rises to the K-th slowest as the window fills)
-                self._record_top(cb, end - now)
+                self._record_top(cb, end - now, now - self._win_start)
             if end - self._win_start >= self.window:
                 self._finalize_window(end)
 
-    def _record_top(self, cb, dur: float) -> None:
+    def _record_top(self, cb, dur: float,
+                    offset: float | None = None) -> None:
+        """``offset`` = the callback's START relative to the open
+        window's start (stamped by the hot path — C runner or the
+        Python reference — so the Perfetto flame row places each record
+        exactly instead of laying durations end-to-end from the window
+        start). None only from legacy callers; the exporter falls back
+        to cursor placement then."""
         top = self._win_top
         top.append((dur, self._cur,
-                    self._cb_label or _describe_callback(cb)))
+                    self._cb_label or _describe_callback(cb), offset))
         if len(top) > self.top_k:
             top.sort(key=lambda t: t[0], reverse=True)
             del top[self.top_k:]
@@ -368,8 +376,11 @@ class LoopProfiler:
             "shares": shares,
             "top": [{"seconds": round(d, 6), "category": c,
                      "label": lb if isinstance(lb, str)
-                     else ".".join(str(p) for p in lb)}
-                    for d, c, lb in self._win_top[:self.top_k]],
+                     else ".".join(str(p) for p in lb),
+                     # within-window start offset: exact flame-row
+                     # placement (None only via legacy _record_top calls)
+                     "offset": None if off is None else round(off, 6)}
+                    for d, c, lb, off in self._win_top[:self.top_k]],
         })
         self.last_shares = shares
         self._win_cats = {}

@@ -14,12 +14,16 @@ emits.
 from .hash_probe import DeviceDirectory, build_directory_arrays, device_lookup
 from .route import pack_by_dest, rank_by_dest, rank_dense_keys
 from .segment_reduce import (
+    host_fold,
+    masked_reduce,
     segment_sum,
     segment_sum_onehot,
     segment_sum_pallas,
 )
 
 __all__ = [
+    "host_fold",
+    "masked_reduce",
     "segment_sum",
     "segment_sum_onehot",
     "segment_sum_pallas",

@@ -36,9 +36,11 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 
-__all__ = ["segment_sum", "segment_sum_onehot", "segment_sum_pallas"]
+__all__ = ["segment_sum", "segment_sum_onehot", "segment_sum_pallas",
+           "masked_reduce", "host_fold", "REDUCE_OPS"]
 
 
 def _as_2d(values: jax.Array) -> tuple[jax.Array, bool]:
@@ -113,6 +115,85 @@ def segment_sum_pallas(values: jax.Array, seg_ids: jax.Array,
     )(ids[None, :], v)
     out = out[:num_segments].astype(values.dtype)
     return out[:, 0] if squeeze else out
+
+
+# ---------------------------------------------------------------------------
+# Masked full reduction (the reduce_actors device half)
+# ---------------------------------------------------------------------------
+
+# combine ops reduce_actors accepts. "mean" is NOT here deliberately: it
+# is not associative per-silo — callers combine it as (sum, count) pairs
+# and divide once at the top (the engine and the dispatcher's cross-silo
+# merge both do), so partial reductions stay exactly combinable.
+REDUCE_OPS = ("sum", "max", "min")
+
+
+def host_fold(op: str):
+    """The numpy fold that combines :func:`masked_reduce` partials
+    host-side (across deferral rounds and across silos) — the ONE place
+    the op → fold mapping lives, so the engine's round combiner and the
+    dispatcher's cross-silo merge cannot drift when an op is added.
+    ``mean`` partials carry sums (divide once at the top)."""
+    if op in ("sum", "mean"):
+        return np.add
+    if op == "max":
+        return np.maximum
+    if op == "min":
+        return np.minimum
+    raise ValueError(f"op must be one of {REDUCE_OPS + ('mean',)}, "
+                     f"got {op!r}")
+
+
+def _reduce_identity(op: str, dtype) -> jax.Array:
+    """The op's identity element in ``dtype`` — what masked-off lanes
+    contribute. Integer sums stay in the integer dtype (exact,
+    order-independent: the determinism contract reduce_actors tests pin);
+    float sums keep the value dtype and are bit-stable only per layout."""
+    if op == "sum":
+        return jnp.zeros((), dtype)
+    if jnp.issubdtype(dtype, jnp.floating):
+        v = -jnp.inf if op == "max" else jnp.inf
+        return jnp.asarray(v, dtype)
+    if dtype == jnp.bool_:
+        return jnp.asarray(op == "min", jnp.bool_)
+    info = np.iinfo(np.dtype(dtype))
+    return jnp.asarray(info.min if op == "max" else info.max, dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("op",))
+def masked_reduce(values, valid: jax.Array, op: str = "sum"):
+    """Full tree reduction of per-lane results down to ONE row.
+
+    values: pytree of ``[n_shards, B, *feature]`` arrays (a tick's
+    per-actor results); valid: ``[n_shards, B]`` bool. Reduces every leaf
+    over the two lane axes — masked lanes contribute the op's identity —
+    returning a pytree of ``[*feature]`` arrays: the single row that
+    crosses the host boundary instead of N per-actor responses
+    (DrJAX-style MapReduce leaf, arXiv 2403.07128).
+
+    Accumulation dtype is the value dtype: integer sums are exact and
+    layout-independent (the reduce_actors determinism contract — bool
+    promotes to int32, the readiness-count case); float sums reduce in a
+    deterministic tree order per shape but differ across shard layouts
+    by normal float reassociation. All-masked max/min returns the
+    identity — callers hold the valid count and decide."""
+    if op not in REDUCE_OPS:
+        raise ValueError(f"op must be one of {REDUCE_OPS}, got {op!r}")
+
+    def one(v):
+        dtype = v.dtype
+        if op == "sum" and dtype == jnp.bool_:
+            v = v.astype(jnp.int32)   # bool sum = count of True lanes
+            dtype = v.dtype
+        mask = valid.reshape(valid.shape + (1,) * (v.ndim - valid.ndim))
+        filled = jnp.where(mask, v, _reduce_identity(op, dtype))
+        if op == "sum":
+            return jnp.sum(filled, axis=(0, 1))
+        if op == "max":
+            return jnp.max(filled, axis=(0, 1))
+        return jnp.min(filled, axis=(0, 1))
+
+    return jax.tree_util.tree_map(one, values)
 
 
 def segment_sum(values: jax.Array, seg_ids: jax.Array,

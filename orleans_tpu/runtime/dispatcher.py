@@ -64,6 +64,19 @@ _TURN_ERRORS = _SLO["turn_errors"]
 
 MAX_FORWARD_COUNT = 2  # SiloMessagingOptions.MaxForwardCount default
 
+# Bulk-population collective methods (MapReduce over actors): reserved
+# method names carried by ordinary APPLICATION requests to a vector
+# interface. Intercepted BEFORE per-key ring-ownership routing — any silo
+# receiving one anchors the collective (fans ONE envelope per peer silo,
+# never one per actor/edge) or, with spec["local"], executes its own
+# partition. PING/SYSTEM QoS lanes are untouched: bulk traffic rides the
+# APPLICATION category end to end.
+BULK_METHODS = {
+    "__bulk_map__": "map",
+    "__bulk_reduce__": "reduce",
+    "__bulk_broadcast__": "broadcast",
+}
+
 
 class Dispatcher:
     def __init__(self, silo: "Silo"):
@@ -270,6 +283,12 @@ class Dispatcher:
         if msg.is_expired:
             log.warning("dropping expired vector request %s", msg.method_name)
             return
+        if msg.method_name in BULK_METHODS:
+            # population-wide collective: no single target key, so the
+            # per-key ownership forward below must not see it — the
+            # receiving silo anchors (or runs its partition of) the op
+            self._handle_vector_bulk(vcls, msg)
+            return
         # (no queue-wait observe here: vector requests record it in the
         # engine, enqueue -> batch start, so only the OWNING silo's tick
         # counts it — a forwarded/rejected hop must not add samples)
@@ -385,6 +404,11 @@ class Dispatcher:
                 log.warning("dropping expired vector request %s",
                             msg.method_name)
                 continue
+            if msg.method_name in BULK_METHODS:
+                # bulk collectives peel before the per-key ownership
+                # check (they have no single target key to route by)
+                self._handle_vector_bulk(vcls, msg)
+                continue
             owner = ring.owner(msg.target_grain.uniform_hash)
             if owner is not None and owner != my_addr:
                 if msg.target_silo is None or msg.target_silo != my_addr:
@@ -460,6 +484,244 @@ class Dispatcher:
             for (m, _, _, _, hdr), fut in zip(items, futs):
                 if fut is not None:
                     self._finish_vector_call(m, fut, hdr)
+
+    # ==================================================================
+    # Bulk-population collectives (MapReduce over actors): the host-tier
+    # surface of VectorRuntime.map_actors/reduce_actors/broadcast_actors.
+    # One client envelope reaches an anchor silo; the anchor fans ONE
+    # envelope per peer silo (broadcast edges partitioned by ring
+    # ownership, map/reduce key sets filtered at each silo), combines the
+    # partials, and answers once — O(silos) envelopes end to end instead
+    # of O(actors)/O(edges) messages.
+    # ==================================================================
+    def _handle_vector_bulk(self, vcls: type, msg: Message) -> None:
+        try:
+            _args, kwargs = msg.body if msg.body is not None else ((), {})
+            spec = kwargs["spec"]
+            if not isinstance(spec, dict) or "method" not in spec:
+                raise TypeError(
+                    "bulk collective body must carry a spec dict with "
+                    "a 'method' field")
+            # validate the target method exists up front so a typo fails
+            # fast instead of after the peer fan-out
+            self.silo.vector.method_of(vcls, spec["method"])
+        except Exception as e:  # noqa: BLE001 — malformed spec → caller
+            if msg.direction != Direction.ONE_WAY:
+                self.send_response(msg, make_error_response(msg, e))
+            return
+        self.silo.stats.increment("vector.bulk.ops")
+        self._track(asyncio.ensure_future(
+            self._run_vector_bulk(vcls, msg, spec)))
+
+    async def _run_vector_bulk(self, vcls: type, msg: Message,
+                               spec: dict) -> None:
+        op = BULK_METHODS[msg.method_name]
+        try:
+            if spec.get("local"):
+                result = await self._vector_bulk_local(vcls, op, spec)
+            else:
+                result = await self._vector_bulk_anchor(vcls, msg, op,
+                                                        spec)
+        except asyncio.CancelledError:
+            raise  # silo stop: the caller's future breaks via close()
+        except BaseException as e:  # noqa: BLE001 — op errors → caller
+            log.exception("bulk collective %s failed on %s",
+                          msg.method_name, vcls.__name__)
+            if msg.direction != Direction.ONE_WAY:
+                self.send_response(msg, make_error_response(msg, e))
+            return
+        if msg.direction != Direction.ONE_WAY:
+            self.send_response(msg, make_response(msg, result))
+
+    def _bulk_owned_hashes(self, rt, vcls: type, keys):
+        """Explicit bulk key list → the key-hash slice THIS silo's ring
+        view owns (every silo receives the full list and applies its own
+        partition — byte cost O(silos × keys), envelope cost O(silos)).
+        Routing hashes are noted so ownership sweeps can re-range
+        bulk-touched rows exactly like per-key traffic. Fast path: on a
+        single-silo ring, dense-range int keys ARE their key hashes
+        (``key_hash_for``) and dense rows are never ownership-swept, so
+        the whole int subset vectorizes — no per-key GrainId work for
+        the million-key populations this surface exists for. Multi-silo
+        ownership needs the per-key uniform hash (vectorizing it is a
+        ROADMAP follow-on)."""
+        import numpy as np
+
+        from ..core.ids import GrainId, GrainType
+        ring = self.silo.locator.ring
+        me = self.silo.silo_address
+        multi = len(ring.silos) > 1
+        tbl = rt.table(vcls)
+        slow = list(keys)
+        fast = np.zeros(0, dtype=np.int64)
+        if not multi:
+            arr = np.asarray(slow)
+            if arr.dtype.kind in "iu":
+                dense = (arr >= 0) & (arr < tbl.dense_n)
+                fast = arr[dense].astype(np.int64)
+                slow = arr[~dense].tolist()
+        gtype = GrainType.of(vcls.__name__)
+        out = []
+        for k in slow:
+            k = k.item() if hasattr(k, "item") else k
+            gid = GrainId.for_grain(gtype, k)
+            if multi and (ring.owner(gid.uniform_hash) or me) != me:
+                continue
+            kh = rt.key_hash_for(k, gid.uniform_hash)
+            tbl.note_route(kh, gid.uniform_hash)
+            out.append(kh)
+        return np.concatenate([fast, np.asarray(out, dtype=np.int64)])
+
+    async def _vector_bulk_local(self, vcls: type, op: str, spec: dict):
+        """Execute this silo's partition of one bulk collective. Map/
+        reduce key sets filter by ring ownership here (keys=None targets
+        local live actors, which ARE the owned partition); broadcast
+        slices arrive pre-partitioned by the anchor."""
+        import numpy as np
+        rt = self.silo.vector
+        method = spec["method"]
+        kwargs = spec.get("kwargs") or None
+        st = self.silo.stats
+        if op == "map":
+            keys = spec.get("keys")
+            if keys is not None:
+                keys = self._bulk_owned_hashes(rt, vcls, keys)
+            n = await rt.map_actors(vcls, method, kwargs, keys=keys)
+            st.increment("vector.bulk.applied", n)
+            return n
+        if op == "reduce":
+            keys = spec.get("keys")
+            if keys is not None:
+                keys = self._bulk_owned_hashes(rt, vcls, keys)
+            value, count = await rt.reduce_actors_partial(
+                vcls, method, kwargs, keys=keys,
+                combine=spec.get("combine", "sum"))
+            st.increment("vector.bulk.applied", count)
+            return {"value": value, "count": count}
+        targets = np.asarray(spec["targets"], dtype=np.int64)
+        d = await rt.broadcast_actors(vcls, method, targets,
+                                      spec.get("args") or {},
+                                      chunk=spec.get("chunk", 16384))
+        st.increment("vector.bulk.delivered", d)
+        return d
+
+    async def _vector_bulk_anchor(self, vcls: type, msg: Message,
+                                  op: str, spec: dict):
+        """Anchor role: fan one ``local=True`` envelope per peer silo,
+        run the local partition, combine. A peer failure fails the whole
+        collective to the caller (honest partial-cluster semantics — the
+        caller retries against a converged view)."""
+        ring = self.silo.locator.ring
+        me = self.silo.silo_address
+        peers = [s for s in ring.silos if s != me]
+        combine = spec.get("combine", "sum")
+        rc = self.silo.runtime_client
+        work = []
+        if op == "broadcast" and peers:
+            slices = self._partition_broadcast(vcls, spec, peers)
+            local_spec = slices.pop(me, None)
+            if local_spec is not None:
+                work.append(self._vector_bulk_local(vcls, op, local_spec))
+            peer_specs = list(slices.items())
+        else:
+            work.append(self._vector_bulk_local(
+                vcls, op, {**spec, "local": True}))
+            peer_specs = [(p, {**spec, "local": True}) for p in peers]
+        for peer, pspec in peer_specs:
+            work.append(rc.send_request(
+                target_grain=msg.target_grain, grain_class=vcls,
+                interface_name=msg.interface_name,
+                method_name=msg.method_name, args=(),
+                kwargs={"spec": pspec}, target_silo=peer,
+                # the caller's budget rides the spec: without it a
+                # 120s-budget collective would die at the peer leg's
+                # 30s default
+                timeout=spec.get("timeout")))
+        # return_exceptions: a failing partition must not abandon the
+        # other in-flight peer futures with no awaiter (their late
+        # rejections would log "exception was never retrieved"); the
+        # first failure still fails the whole collective to the caller
+        parts = await asyncio.gather(*work, return_exceptions=True)
+        for p in parts:
+            if isinstance(p, BaseException):
+                raise p
+        if op == "reduce":
+            return self._finalize_reduce(parts, combine)
+        return int(sum(parts))
+
+    def _partition_broadcast(self, vcls: type, spec: dict,
+                             peers: list) -> dict:
+        """Partition a broadcast edge list by ring ownership: one spec
+        slice per owning silo (targets + per-edge args rows travel with
+        their edges; scalar args replicate). The anchor pays O(unique
+        targets) hash computations once so the wire carries each edge
+        exactly once."""
+        import numpy as np
+
+        from ..core.ids import GrainId, GrainType
+        ring = self.silo.locator.ring
+        me = self.silo.silo_address
+        targets = np.asarray(spec["targets"], dtype=np.int64)
+        args = spec.get("args") or {}
+        E = targets.shape[0]
+        gtype = GrainType.of(vcls.__name__)
+        silos = [me] + peers
+        idx_of = {s: i for i, s in enumerate(silos)}
+        uniq, inv = np.unique(targets, return_inverse=True)
+        owner_idx = np.fromiter(
+            (idx_of.get(ring.owner(
+                GrainId.for_grain(gtype, int(k)).uniform_hash) or me, 0)
+             for k in uniq), dtype=np.int64, count=uniq.size)
+        per_edge = owner_idx[inv]
+        # per-edge vs replicated is decided by the method's args schema
+        # when one exists (an arg is per-edge iff it is [E, *feature]):
+        # a replicated feature vector whose length happens to equal E
+        # must NOT be sliced per edge — a peer owning k edges would
+        # receive a k-length fragment and fail the whole collective.
+        # With no schema yet (method never called), the engine will
+        # infer per-edge semantics from these arrays, so the shape
+        # heuristic matches what the engine is about to assume.
+        schema = self.silo.vector.method_of(vcls,
+                                            spec["method"]).args_schema
+
+        def per_edge_arg(f, arr):
+            if schema is not None and f in schema:
+                return arr.shape == (E, *schema[f][1])
+            return bool(arr.ndim) and arr.shape[0] == E
+        out = {}
+        for i, addr in enumerate(silos):
+            m = per_edge == i
+            if not m.any():
+                continue
+            sliced = {}
+            for f, a in args.items():
+                arr = np.asarray(a)
+                sliced[f] = arr[m] if per_edge_arg(f, arr) else a
+            out[addr] = {**spec, "local": True, "targets": targets[m],
+                         "args": sliced}
+        return out
+
+    @staticmethod
+    def _finalize_reduce(parts: list, combine: str) -> dict:
+        """Fold per-silo reduce partials (``{"value", "count"}``) into
+        the final answer with the shared op→fold mapping
+        (``ops.segment_reduce.host_fold`` — the same one the engine's
+        round combiner uses, so the two cannot drift). Partials carry
+        SUMS for mean (division happens exactly once, here)."""
+        import jax
+
+        from ..ops.segment_reduce import host_fold
+        count = sum(p["count"] for p in parts)
+        vals = [p["value"] for p in parts if p["value"] is not None]
+        if not vals or count == 0:
+            return {"value": None, "count": 0}
+        fold = host_fold(combine)
+        total = vals[0]
+        for v in vals[1:]:
+            total = jax.tree_util.tree_map(fold, total, v)
+        if combine == "mean":
+            total = jax.tree_util.tree_map(lambda a: a / count, total)
+        return {"value": total, "count": count}
 
     @staticmethod
     def _vector_key_is_fresh(rt, vcls: type, key_hash: int) -> bool:

@@ -125,6 +125,44 @@ class GrainFactory:
         return self._client.call_batch(grain_class, method_name, calls,
                                        timeout=timeout)
 
+    # -- bulk-population collectives (MapReduce over actors) -----------
+    def map_actors(self, grain_class: type, method: str,
+                   kwargs: dict | None = None, keys=None, *,
+                   timeout: float | None = None):
+        """Apply ``method`` to every live device-tier activation (or a
+        key subset) as single-dispatch bulk ticks — one envelope per
+        silo, not one message per actor (``RuntimeClient.map_actors``)."""
+        return self._client.map_actors(grain_class, method, kwargs,
+                                       keys=keys, timeout=timeout)
+
+    def reduce_actors(self, grain_class: type, method: str,
+                      kwargs: dict | None = None, keys=None,
+                      combine: str = "sum", *,
+                      timeout: float | None = None):
+        """Device-side reduction over per-actor results: one row crosses
+        each host/silo boundary (``RuntimeClient.reduce_actors``)."""
+        return self._client.reduce_actors(grain_class, method, kwargs,
+                                          keys=keys, combine=combine,
+                                          timeout=timeout)
+
+    def broadcast_actors(self, grain_class: type, method: str, targets,
+                         args: dict | None = None, *,
+                         timeout: float | None = None):
+        """Edge-list fan-out as device collectives
+        (``RuntimeClient.broadcast_actors``)."""
+        return self._client.broadcast_actors(grain_class, method,
+                                             targets, args,
+                                             timeout=timeout)
+
+    def join_when(self, grain_class: type, keys, k: int | None = None, *,
+                  method: str, kwargs: dict | None = None,
+                  timeout: float | None = None, poll: float = 0.02):
+        """Readiness-mask join over a key set
+        (``RuntimeClient.join_when``)."""
+        return self._client.join_when(grain_class, keys, k,
+                                      method=method, kwargs=kwargs,
+                                      timeout=timeout, poll=poll)
+
     def get_system_target(self, grain_class: type, grain_id: GrainId) -> GrainRef:
         ref = GrainRef(grain_class, grain_id, self._client)
         return ref

@@ -365,6 +365,102 @@ class RuntimeClient:
             raise
         return out
 
+    # -- bulk-population collectives (MapReduce over actors) -------------
+    _bulk_seq = 0
+
+    def _bulk_request(self, grain_class: type, bulk_method: str,
+                      spec: dict, timeout: float | None = None):
+        """One APPLICATION request carrying a whole population-wide
+        collective: the receiving silo anchors it (dispatcher
+        ``BULK_METHODS``) — fan-out to peers, device-tier execution, and
+        the combine all happen silo-side, so the CLIENT side of a
+        million-actor operation is exactly one envelope + one response.
+        The anchor key is SALTED per request: any silo can anchor by
+        design, and a constant key would hash every bulk op for a class
+        onto one gateway — concentrating the partition/combine work on
+        one silo while the rest idle."""
+        from .grain import grain_type_of
+        self._bulk_seq += 1
+        gid = GrainId.for_grain(grain_type_of(grain_class),
+                                f"__bulk__{self._bulk_seq}")
+        return self.send_request(
+            target_grain=gid, grain_class=grain_class,
+            interface_name=grain_class.__name__, method_name=bulk_method,
+            args=(), kwargs={"spec": spec}, timeout=timeout)
+
+    async def map_actors(self, grain_class: type, method: str,
+                         kwargs: dict | None = None, keys=None,
+                         timeout: float | None = None) -> int:
+        """Apply one device-tier method (one broadcast kwargs row) to
+        every live activation of ``grain_class`` — or an explicit key
+        subset — as single-dispatch bulk ticks. Returns the number of
+        activations applied across the cluster."""
+        spec: dict = {"method": method, "kwargs": kwargs or {}}
+        if keys is not None:
+            spec["keys"] = list(keys) if not hasattr(keys, "tolist") \
+                else keys
+        if timeout is not None:
+            spec["timeout"] = timeout  # anchor extends it to peer legs
+        return await self._bulk_request(grain_class, "__bulk_map__",
+                                        spec, timeout)
+
+    async def reduce_actors(self, grain_class: type, method: str,
+                            kwargs: dict | None = None, keys=None,
+                            combine: str = "sum",
+                            timeout: float | None = None):
+        """Run a device-tier method over the population and reduce the
+        per-actor results on device + across silos: ONE row crosses each
+        host boundary (and each silo boundary) instead of N responses.
+        ``combine``: "sum" | "max" | "min" | "mean". Returns the reduced
+        result pytree (None when no live actor matched)."""
+        spec: dict = {"method": method, "kwargs": kwargs or {},
+                      "combine": combine}
+        if keys is not None:
+            spec["keys"] = list(keys) if not hasattr(keys, "tolist") \
+                else keys
+        if timeout is not None:
+            spec["timeout"] = timeout
+        r = await self._bulk_request(grain_class, "__bulk_reduce__",
+                                     spec, timeout)
+        return r["value"]
+
+    async def broadcast_actors(self, grain_class: type, method: str,
+                               targets, args: dict | None = None,
+                               timeout: float | None = None) -> int:
+        """Edge-list fan-out: deliver ``method`` to ``targets[i]`` with
+        per-edge payload ``args[f][i]`` (scalars broadcast) — the
+        celebrity-post multicast as ONE client envelope, partitioned by
+        the anchor silo into one envelope per owning silo and scattered
+        into target rows as device collectives. Returns edges
+        delivered."""
+        spec: dict = {"method": method, "targets": targets,
+                      "args": args or {}}
+        if timeout is not None:
+            spec["timeout"] = timeout
+        return await self._bulk_request(grain_class, "__bulk_broadcast__",
+                                        spec, timeout)
+
+    async def join_when(self, grain_class: type, keys, k: int | None = None,
+                        *, method: str, kwargs: dict | None = None,
+                        timeout: float | None = None,
+                        poll: float = 0.02) -> int:
+        """Readiness-mask join (join-calculus style): resolve when at
+        least ``k`` of ``keys`` (default: all) report ready through
+        ``method`` — a read-only actor method returning 0/1. Each poll
+        is ONE reduce_actors collective (one envelope per silo, one
+        device reduction each) — scatter-gather aggregations never fan K
+        host futures through the loop. Returns the ready count."""
+        # the poll driver is the engine's (ONE readiness semantics for
+        # both surfaces); imported lazily — only vector-facing callers
+        # pull the dispatch/jax stack into a client process
+        from ..dispatch.engine import join_poll
+        keys = list(keys)
+        need = len(keys) if k is None else int(k)
+        return await join_poll(
+            lambda: self.reduce_actors(grain_class, method, kwargs,
+                                       keys=keys, combine="sum"),
+            need, timeout, poll)
+
     # -- request path (SendRequest) --------------------------------------
     def send_request(self, *, target_grain: GrainId, grain_class: type,
                      interface_name: str, method_name: str,

@@ -69,6 +69,72 @@ def test_profiler_attribution_and_windows():
     assert abs(sum(occ.values()) - 1.0) < 1e-6
 
 
+def test_top_records_carry_within_window_offsets():
+    """ISSUE 13 satellite: every top-K record stamps its callback's
+    start offset within the window (both the pure-Python reference here
+    and the native runner below), so the Perfetto flame row places
+    records exactly instead of end-to-end from the window start."""
+    import time as _t
+    prof = LoopProfiler(window=60.0)
+    run = prof._wrap
+
+    def spin(ms):
+        end = _t.perf_counter() + ms / 1e3
+        while _t.perf_counter() < end:
+            pass
+
+    run(lambda: spin(2))()
+    _t.sleep(0.01)  # real gap: the second record's offset must see it
+    run(lambda: spin(2))()
+    prof._finalize_window(_t.perf_counter())
+    top = prof.ring[-1]["top"]
+    assert len(top) == 2
+    offs = sorted(r["offset"] for r in top)
+    assert all(o is not None and o >= 0.0 for o in offs)
+    # the second callback started after the first one's 2ms + the 10ms
+    # sleep — its offset reflects WHERE it ran, not a cursor sum
+    assert offs[1] - offs[0] >= 0.010
+    # offsets sit inside the window's wall
+    assert offs[1] <= prof.ring[-1]["wall_s"]
+
+
+def test_native_runner_stamps_offsets():
+    """The C hot path (hotloop.c) stamps the same offsets as the Python
+    reference; skipped where the toolchain is unavailable."""
+    import time as _t
+
+    from orleans_tpu.observability import profiling
+    if profiling._hotloop is None:
+        import pytest
+        pytest.skip("native hotloop unavailable")
+    loop = asyncio.new_event_loop()
+    try:
+        prof = install_loop_profiler(loop, window=60.0)
+        assert type(prof) is not LoopProfiler
+
+        def spin():
+            end = _t.perf_counter() + 0.002
+            while _t.perf_counter() < end:
+                pass
+
+        def done():
+            loop.stop()
+
+        loop.call_soon(spin)
+        loop.call_later(0.02, spin)
+        loop.call_later(0.04, done)
+        loop.run_forever()
+        prof._finalize_window(_t.perf_counter())
+        top = [r for r in prof.ring[-1]["top"] if r["seconds"] >= 0.002]
+        assert len(top) >= 2
+        offs = sorted(r["offset"] for r in top)
+        assert all(o is not None and o >= 0.0 for o in offs)
+        assert offs[1] - offs[0] >= 0.015  # the call_later gap is real
+    finally:
+        uninstall_loop_profiler(loop)
+        loop.close()
+
+
 def test_profiler_enter_exit_restores_category():
     prof = LoopProfiler(window=60.0)
 
@@ -287,7 +353,7 @@ async def test_slow_turn_lands_in_top_k_with_label():
         lp = silo.loop_prof
         lp._flush()
         labels = [lb if isinstance(lb, str) else ".".join(map(str, lb))
-                  for _, _, lb in lp._win_top]
+                  for _, _, lb, _off in lp._win_top]
         assert any("SlowGrain.crunch" in lb for lb in labels), labels
     finally:
         await client.close_async()

@@ -10,6 +10,7 @@ from orleans_tpu.ops import (
     DeviceDirectory,
     build_directory_arrays,
     device_lookup,
+    masked_reduce,
     pack_by_dest,
     rank_by_dest,
     rank_dense_keys,
@@ -17,6 +18,56 @@ from orleans_tpu.ops import (
     segment_sum_onehot,
     segment_sum_pallas,
 )
+
+
+# ---------------------------------------------------------------------------
+# masked_reduce (the reduce_actors device half)
+# ---------------------------------------------------------------------------
+
+class TestMaskedReduce:
+    def test_int_sum_exact_any_layout(self):
+        rng = np.random.default_rng(0)
+        vals = rng.integers(-500, 500, 64).astype(np.int32)
+        expect = int(vals.sum())
+        for shape in ((1, 64), (4, 16), (8, 8)):
+            v = jnp.asarray(vals.reshape(shape))
+            out = masked_reduce(v, jnp.ones(shape, bool), op="sum")
+            assert int(out) == expect
+
+    def test_mask_excludes_lanes(self):
+        v = jnp.asarray([[1, 2], [4, 8]], jnp.int32)
+        m = jnp.asarray([[True, False], [True, True]])
+        assert int(masked_reduce(v, m, op="sum")) == 13
+        assert int(masked_reduce(v, m, op="max")) == 8
+        assert int(masked_reduce(v, m, op="min")) == 1
+
+    def test_tree_and_feature_axes(self):
+        vals = {"a": jnp.ones((2, 4, 3), jnp.float32),
+                "b": jnp.full((2, 4), 2, jnp.int32)}
+        m = jnp.ones((2, 4), bool).at[0, 0].set(False)
+        out = masked_reduce(vals, m, op="sum")
+        np.testing.assert_allclose(np.asarray(out["a"]), [7.0] * 3)
+        assert int(out["b"]) == 14
+
+    def test_bool_sum_counts(self):
+        v = jnp.asarray([[True, True, False, True]])
+        m = jnp.asarray([[True, True, True, False]])
+        assert int(masked_reduce(v, m, op="sum")) == 2
+
+    def test_all_masked_identities(self):
+        v = jnp.asarray([[3, 4]], jnp.int32)
+        m = jnp.zeros((1, 2), bool)
+        assert int(masked_reduce(v, m, op="sum")) == 0
+        assert int(masked_reduce(v, m, op="max")) == \
+            np.iinfo(np.int32).min
+        f = jnp.asarray([[1.5]], jnp.float32)
+        assert float(masked_reduce(f, jnp.zeros((1, 1), bool),
+                                   op="max")) == -np.inf
+
+    def test_rejects_unknown_op(self):
+        with pytest.raises(ValueError):
+            masked_reduce(jnp.ones((1, 1)), jnp.ones((1, 1), bool),
+                          op="median")
 
 
 def _np_segment_sum(values, ids, S):

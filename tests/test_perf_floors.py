@@ -469,3 +469,31 @@ async def test_floor_slo_overhead():
     assert ratio >= SLO_OVERHEAD_FLOOR, \
         f"metrics+slo ping at {ratio:.3f}x of metrics-only (floor " \
         f"{SLO_OVERHEAD_FLOOR}) — SLO evaluation is taxing the hot path"
+
+
+# Bulk collectives vs message-per-edge (ISSUE 13): a same-process ratio
+# on IDENTICAL edge traffic at fan-out >= 64 (interpreter speed cancels,
+# no needs_eager; both sides get one full warmup drive, so the ratio is
+# steady-state dispatch). Measured ~10-13x in-proc (BENCH_r13); 3x is
+# the acceptance criterion with a wide noise band — a regression that
+# turns broadcast_actors back into per-edge dispatch (a lost kernel
+# cache, a per-round recompile, per-edge envelopes) collapses it.
+MAP_ACTORS_FLOOR = 3.0
+
+
+async def test_floor_map_actors():
+    from benchmarks.chirper_fanout import run_ab
+
+    async def once():
+        # run_ab is itself best-of-two per side with per-side
+        # gc.collect() (the ping-floor A/B discipline lives in the bench)
+        r = await run_ab(n_followers=64, n_chirpers=8, n_accounts=512,
+                         repeats=2)
+        assert r["extra"]["fan_out"] >= 64
+        return r["value"]
+    ratio = await once()
+    if ratio < MAP_ACTORS_FLOOR * 1.5:
+        ratio = max(ratio, await once())  # noise guard: best of two
+    assert ratio >= MAP_ACTORS_FLOOR, \
+        f"bulk fan-out only {ratio:.2f}x of message-per-edge at " \
+        f"fan-out 64 (floor {MAP_ACTORS_FLOOR}x)"

@@ -414,6 +414,33 @@ def test_chrome_trace_flame_rows_never_overlap_across_windows():
         assert nxt["ts"] >= prev["ts"] + prev["dur"] - 1e-6
 
 
+def test_chrome_trace_places_offset_records_exactly():
+    """ISSUE 13 satellite: records carrying a within-window start
+    offset (stamped by the profiler hot path) render at window_start +
+    offset — exact placement, not the end-to-end cursor layout — and
+    exact records never overlap (callbacks are sequential)."""
+    from orleans_tpu.observability.export import chrome_trace_events
+    windows = [
+        {"ts": 100.5, "wall_s": 0.5, "shares": {"turns": 1.0},
+         "top": [
+             {"seconds": 0.05, "category": "turns", "label": "a",
+              "offset": 0.30},
+             {"seconds": 0.02, "category": "pump", "label": "b",
+              "offset": 0.10},
+         ]},
+    ]
+    events = chrome_trace_events([], loop_profiles={"s": windows})
+    spans = {e["name"]: e for e in events if e.get("ph") == "X"}
+    # window start = ts - wall = 100.0 = t0 (zeroed timeline)
+    assert abs(spans["a"]["ts"] - 0.30e6) < 1.0
+    assert abs(spans["b"]["ts"] - 0.10e6) < 1.0
+    # exact records do not overlap even though the list is
+    # duration-sorted, not time-sorted
+    ordered = sorted(spans.values(), key=lambda e: e["ts"])
+    for prev, nxt in zip(ordered, ordered[1:]):
+        assert nxt["ts"] >= prev["ts"] + prev["dur"] - 1e-6
+
+
 # ---------------------------------------------------------------------------
 # Gauntlet: flash-crowd QoS invariant + negative controls
 # ---------------------------------------------------------------------------
