@@ -3,18 +3,22 @@ for a Python runtime).
 
 Orleans keeps grain code inside the virtual-actor contract with compile-time
 codegen and Roslyn analyzers; this package is the reproduction's equivalent:
-a stdlib-``ast`` lint pass over ``orleans_tpu/`` that statically checks the
-invariants the hot lane (PR 3) and migration fences made load-bearing —
-pool discipline for recycled ``Message``/``CallbackData`` shells, turn
-discipline inside ``async def`` grain/runtime methods, and purity of
-functions handed to ``jit``/``shard_map`` on the device tier.
+a two-phase, summary-based interprocedural engine over ``orleans_tpu/``
+that statically checks the invariants the hot lane (PR 3), the migration
+fences (PR 9), and the multi-loop split (PR 11) made load-bearing.
+Phase 1 summarizes each file independently (release/escape/alias
+behavior per function, thread-affinity and scheduling edges, fence
+state, registry writes, grain interface tables — cached per content
+hash); phase 2 links the summaries into a program index the rules query
+at call sites (``analysis.summaries``).
 
 Rules
 -----
 
 ========  ==========================================================
 OTPU001   pool-discipline: pooled object used/stored after release,
-          or released twice along one path
+          or released twice along one path — cross-function,
+          alias-aware, loop-carried
 OTPU002   blocking-in-turn: ``time.sleep`` / sync IO / ``.result()``
           inside an ``async def`` turn
 OTPU003   interleaving-hazard: grain attribute written before and
@@ -22,15 +26,32 @@ OTPU003   interleaving-hazard: grain attribute written before and
 OTPU004   mutable-state-leak: grain method returns a shared mutable
           internal (``return self._rows``)
 OTPU005   unawaited-grain-call: grain-ref coroutine dropped without
-          an explicit fire-and-forget marker
+          an explicit fire-and-forget marker (``@one_way`` drops are
+          recognized via the typed interface tables)
 OTPU006   traced-impurity: function traced by ``jit``/``shard_map``/
           ``pjit`` captures or mutates host runtime state
+OTPU007   loop-confinement: loop-confined registry (StatsRegistry/
+          Histogram/QueueWaitTrend/SpanCollector/CallSiteStats)
+          written from a worker-thread or ingress-shard context
+          without the stamp-and-replay pattern
+OTPU008   fence-discipline: donated device state (``.state`` /
+          ``.hits`` on a fence-owning receiver) touched outside a
+          held tick fence
+OTPU009   grain-interface: ``get_grain``/``call_batch``/
+          ``map_actors``/``broadcast_actors``/``join_when`` call site
+          disagrees with the class's interface table (the Roslyn
+          ``IncorrectGrainInterface`` analog)
 ========  ==========================================================
 
 Usage::
 
     python -m orleans_tpu.analysis orleans_tpu/ \
         --baseline analysis/baseline.json
+
+``--explain OTPU007`` prints a rule's rationale plus its canonical
+bad/clean fixture pair; ``--format sarif`` emits SARIF 2.1.0 for CI
+annotation rendering; ``--intra-only`` reproduces the legacy
+per-function configuration (no summaries — OTPU007-009 disabled).
 
 Suppress one finding in place with a trailing (or preceding full-line)
 comment: ``# otpu: ignore[OTPU002]`` (rule list, or bare ``# otpu: ignore``
@@ -44,9 +65,11 @@ baselined.
 from .baseline import load_baseline, match_baseline, write_baseline
 from .engine import analyze_paths, analyze_source
 from .model import RULES, Finding, Rule, all_rules
+from .summaries import Program, build_program, module_summary
 
 __all__ = [
-    "Finding", "Rule", "RULES", "all_rules",
-    "analyze_paths", "analyze_source",
-    "load_baseline", "match_baseline", "write_baseline",
+    "Finding", "Program", "Rule", "RULES", "all_rules",
+    "analyze_paths", "analyze_source", "build_program",
+    "load_baseline", "match_baseline", "module_summary",
+    "write_baseline",
 ]

@@ -7,7 +7,9 @@ errors); 2 — usage/configuration error.
 from __future__ import annotations
 
 import argparse
+import glob
 import json
+import os
 import sys
 
 from .baseline import load_baseline, match_baseline, write_baseline
@@ -17,14 +19,61 @@ from .model import RULES, all_rules
 SEVERITY_ORDER = {"warning": 0, "error": 1}
 
 
+def _explain(rule_id: str) -> int:
+    """``--explain OTPU007``: the rule's rationale plus the canonical
+    bad/clean fixture pair, so a finding is self-documenting at the
+    CLI without opening the docs."""
+    rule_id = rule_id.strip().upper()
+    all_rules()
+    rule = RULES.get(rule_id)
+    if rule is None:
+        print(f"unknown rule id {rule_id!r} (known: "
+              f"{', '.join(sorted(RULES))})", file=sys.stderr)
+        return 2
+    print(f"{rule.id} {rule.name} [{rule.severity}]")
+    print(f"  {rule.description}\n")
+    if rule.rationale:
+        print("Why:")
+        for line in rule.rationale.split(". "):
+            line = line.strip().rstrip(".")
+            if line:
+                print(f"  {line}.")
+        print()
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    fixtures = os.path.join(repo, "tests", "analysis_fixtures")
+    shown = False
+    for kind, label in (("bad", "Flagged (the canonical violation)"),
+                        ("clean", "Clean (the sanctioned pattern)")):
+        pats = [os.path.join(fixtures, f"{rule_id.lower()}_{kind}.py"),
+                os.path.join(fixtures, "*",
+                             f"{rule_id.lower()}_{kind}.py")]
+        for pat in pats:
+            for path in sorted(glob.glob(pat)):
+                shown = True
+                rel = os.path.relpath(path, repo)
+                print(f"--- {label} — {rel} ---")
+                with open(path, encoding="utf-8") as fh:
+                    print(fh.read().rstrip())
+                print()
+                break
+            else:
+                continue
+            break
+    if not shown:
+        print("(no fixture pair found beside this checkout — see "
+              "tests/analysis_fixtures/ in the repository)")
+    return 0
+
+
 def main(argv: "list[str] | None" = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m orleans_tpu.analysis",
-        description="Actor-invariant static analyzer (OTPU001-OTPU006).")
+        description="Actor-invariant static analyzer (OTPU001-OTPU009).")
     parser.add_argument("paths", nargs="*", default=["orleans_tpu"],
                         help="files or directories to scan "
                              "(default: orleans_tpu)")
-    parser.add_argument("--format", choices=("text", "json"),
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
                         default="text")
     parser.add_argument("--baseline", metavar="FILE",
                         help="accepted-findings file; only NEW findings "
@@ -38,16 +87,29 @@ def main(argv: "list[str] | None" = None) -> int:
     parser.add_argument("--min-severity", choices=("warning", "error"),
                         default="warning",
                         help="drop findings below this severity")
+    parser.add_argument("--intra-only", action="store_true",
+                        help="legacy per-function configuration: no "
+                             "summaries, no cross-function propagation, "
+                             "program-backed rules (OTPU007-OTPU009) "
+                             "disabled")
+    parser.add_argument("--explain", metavar="RULE",
+                        help="print a rule's rationale and its "
+                             "canonical bad/clean fixture pair, then "
+                             "exit")
     args = parser.parse_args(argv)
 
+    if args.explain:
+        return _explain(args.explain)
+
     if args.write_baseline and (args.rules
-                                or args.min_severity != "warning"):
+                                or args.min_severity != "warning"
+                                or args.intra_only):
         # a filtered write would silently DROP accepted findings outside
         # the filter from the ratchet, and the next full gate run would
         # report them as new — refuse rather than corrupt the baseline
         print("--write-baseline must run unfiltered (no --rules / "
-              "--min-severity): the baseline is the full ratchet",
-              file=sys.stderr)
+              "--min-severity / --intra-only): the baseline is the "
+              "full ratchet", file=sys.stderr)
         return 2
 
     rules = all_rules()
@@ -60,7 +122,8 @@ def main(argv: "list[str] | None" = None) -> int:
             return 2
         rules = [RULES[r] for r in sorted(wanted)]
 
-    findings = analyze_paths(args.paths, rules=rules)
+    findings = analyze_paths(args.paths, rules=rules,
+                             interprocedural=not args.intra_only)
     floor = SEVERITY_ORDER[args.min_severity]
     findings = [f for f in findings
                 if SEVERITY_ORDER.get(f.severity, 1) >= floor
@@ -75,7 +138,8 @@ def main(argv: "list[str] | None" = None) -> int:
     baseline = load_baseline(args.baseline) if args.baseline else None
     if baseline is not None:
         new, stale = match_baseline(findings, baseline)
-        if args.rules or args.min_severity != "warning":
+        if args.rules or args.min_severity != "warning" or \
+                args.intra_only:
             # a filtered run cannot produce findings outside the filter,
             # so baseline entries for them are NOT evidence of fixed code
             # — reporting them stale would nudge the user toward churning
@@ -90,6 +154,9 @@ def main(argv: "list[str] | None" = None) -> int:
             "baselined": len(findings) - len(new),
             "stale_baseline": [list(k) for k in sorted(stale)],
         }, indent=1, sort_keys=True))
+    elif args.format == "sarif":
+        from .sarif import sarif_json
+        print(sarif_json(new))
     else:
         for f in new:
             print(f.render())

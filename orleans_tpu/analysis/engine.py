@@ -104,20 +104,33 @@ def _is_suppressed(f: Finding,
 
 def analyze_source(source: str, rel_path: str, *,
                    rules: Iterable | None = None,
-                   path: str | None = None) -> list[Finding]:
+                   path: str | None = None,
+                   program: "object | None" = None,
+                   interprocedural: bool = True,
+                   tree: "ast.Module | None" = None) -> list[Finding]:
     """Run the (selected) rules over one source blob. Syntax errors come
     back as an ``OTPU000`` error finding rather than an exception — a
-    file the analyzer cannot parse is a finding about that file."""
+    file the analyzer cannot parse is a finding about that file.
+
+    ``program`` is the linked cross-module summary index; when None and
+    ``interprocedural`` is set, a single-module program is built from
+    this source alone (helper + caller in one file still link).
+    ``interprocedural=False`` reproduces the legacy per-function pass —
+    no summaries, no call-site propagation, no program-backed rules."""
     rel_path = rel_path.replace(os.sep, "/")
-    try:
-        tree = ast.parse(source)
-    except SyntaxError as e:
-        return [Finding("OTPU000", "error", rel_path, e.lineno or 0,
-                        (e.offset or 0) or 1,
-                        f"file does not parse: {e.msg}")]
+    if tree is None:
+        try:
+            tree = ast.parse(source)
+        except SyntaxError as e:
+            return [Finding("OTPU000", "error", rel_path, e.lineno or 0,
+                            (e.offset or 0) or 1,
+                            f"file does not parse: {e.msg}")]
+    if program is None and interprocedural:
+        from .summaries import build_program
+        program = build_program([(source, rel_path, tree)])
     ctx = FileContext(path=path or rel_path, rel_path=rel_path,
                       source=source, tree=tree,
-                      lines=source.splitlines())
+                      lines=source.splitlines(), program=program)
     supp = suppressed_lines(source)
     _spread_over_statements(supp, tree)
     findings: list[Finding] = []
@@ -173,11 +186,33 @@ def iter_python_files(paths: Sequence[str]) -> list[tuple[str, str]]:
 
 
 def analyze_paths(paths: Sequence[str], *,
-                  rules: Iterable | None = None) -> list[Finding]:
-    findings: list[Finding] = []
+                  rules: Iterable | None = None,
+                  interprocedural: bool = True) -> list[Finding]:
+    """Two-phase run: phase 1 summarizes every file (cached per content
+    hash — see summaries.module_summary), phase 2 links them into one
+    Program, then the rules run per file against the linked view. Files
+    are parsed once and the tree shared between summary and rules."""
+    loaded: list[tuple[str, str, str, "ast.Module | None"]] = []
     for full, rel in iter_python_files(paths):
         with open(full, encoding="utf-8") as fh:
             src = fh.read()
-        findings.extend(analyze_source(src, rel, rules=rules, path=full))
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            tree = None
+        loaded.append((full, rel.replace(os.sep, "/"), src, tree))
+
+    program = None
+    if interprocedural:
+        from .summaries import build_program
+        program = build_program(
+            [(src, rel, tree) for _, rel, src, tree in loaded
+             if tree is not None])
+
+    findings: list[Finding] = []
+    for full, rel, src, tree in loaded:
+        findings.extend(analyze_source(
+            src, rel, rules=rules, path=full, program=program,
+            interprocedural=interprocedural, tree=tree))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings

@@ -55,6 +55,16 @@ class FileContext:
     source: str
     tree: ast.Module
     lines: list[str] = field(default_factory=list)
+    # the linked cross-module summary index (summaries.Program), or None
+    # when running the legacy intra-procedural configuration
+    program: object | None = None
+
+    @property
+    def module(self):
+        """This file's ModuleSummary inside ``program`` (or None)."""
+        if self.program is None:
+            return None
+        return self.program.by_rel.get(self.rel_path)
 
     def finding(self, rule: "Rule", node: ast.AST, message: str,
                 symbol: str = "") -> Finding:
@@ -72,6 +82,9 @@ class Rule:
     name: str = ""
     severity: str = "error"
     description: str = ""
+    # longer prose for ``--explain``: WHY the invariant exists and what
+    # breaks when it is violated
+    rationale: str = ""
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
         raise NotImplementedError

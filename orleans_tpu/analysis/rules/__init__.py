@@ -1,5 +1,6 @@
 """Rule modules — importing this package registers every rule."""
 
-from . import pool, state, traced, turns  # noqa: F401
+from . import concurrency, interfaces, pool, state, traced, turns  # noqa: F401
 
-__all__ = ["pool", "state", "traced", "turns"]
+__all__ = ["concurrency", "interfaces", "pool", "state", "traced",
+           "turns"]

@@ -5,31 +5,15 @@ from __future__ import annotations
 import ast
 from typing import Iterator
 
+# shared with the summary engine (which rule modules must not be
+# imported BY — rules import common, common imports summaries)
+from ..summaries import GRAIN_BASES, dotted_name, func_params
+
 __all__ = [
     "GRAIN_BASES", "dotted_name", "decorator_names", "is_grain_class",
     "is_reentrant_grain", "iter_functions", "iter_grain_classes",
     "func_params", "lexical_walk",
 ]
-
-# Class bases that make a class a host-tier grain (turn discipline applies).
-# VectorGrain is deliberately absent: its methods are kernel specs executed
-# by the tick engine, not turns (OTPU006 covers that tier instead).
-GRAIN_BASES = {
-    "Grain", "StatefulGrain", "JournaledGrain", "TransactionalGrain",
-    "GrainService",
-}
-
-
-def dotted_name(node: ast.AST) -> str:
-    """``a.b.c`` for Name/Attribute chains, "" for anything else."""
-    parts: list[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return ""
 
 
 def decorator_names(node: ast.ClassDef | ast.FunctionDef |
@@ -94,17 +78,6 @@ def iter_grain_classes(tree: ast.AST,
             yield from iter_grain_classes(node, qn + ".")
         elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             yield from iter_grain_classes(node, f"{qualprefix}{node.name}.")
-
-
-def func_params(node: "ast.FunctionDef | ast.AsyncFunctionDef |"
-                " ast.Lambda") -> set[str]:
-    a = node.args
-    names = {p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)}
-    if a.vararg:
-        names.add(a.vararg.arg)
-    if a.kwarg:
-        names.add(a.kwarg.arg)
-    return names
 
 
 def lexical_walk(node: ast.AST, *, into_defs: bool = False

@@ -6,16 +6,25 @@ hot-lane running marker (``hotlane._release_marker``). A released shell may
 be re-acquired and re-initialized by any later allocation on the event
 loop, so touching a local variable after passing it to a releaser is a
 use-after-free with Python characteristics: no crash, just another call's
-fields. This rule runs a small branch-aware dataflow over each function
-that calls a releaser and reports
+fields. The rule runs the shared release dataflow
+(``analysis.summaries.ReleaseWalker``) over each candidate function and
+reports
 
 * any read of a name after it was released on every path reaching the
-  read, and
+  read,
 * a second release of an already-released name along one path.
 
-Rebinding (``x = ...``) or ``del x`` clears the released state. The
-analysis is intra-procedural and ignores aliases — the cross-function
-dataflow upgrade is a ROADMAP follow-on.
+Since PR 14 the dataflow is **cross-function, alias-aware, and
+loop-carried**: a helper whose summary definitely releases a parameter
+poisons the caller's argument at the call site (the Infer-style
+compositional propagation, resolved module-locally plus through explicit
+imports); ``y = x`` (and ``y = helper(x)`` when the helper returns its
+argument) makes ``y`` an alias whose release poisons the group; and loop
+bodies run twice with the back-edge state merged in, so a release in
+iteration N reaches a use in iteration N+1. Rebinding (``x = ...``) or
+``del x`` still clears the released state. The legacy intra-procedural
+configuration (no call-site propagation) stays available via the CLI's
+``--intra-only``.
 """
 
 from __future__ import annotations
@@ -24,171 +33,39 @@ import ast
 from typing import Iterator
 
 from ..model import FileContext, Finding, Rule, register
+from ..summaries import (
+    RELEASERS,
+    ReleaseWalker,
+    _call_alias,
+    _call_releases,
+)
 from .common import iter_functions
 
-RELEASERS = {
-    "recycle_message", "_recycle_callback", "recycle_callback",
-    "_release_marker", "release_marker",
-}
 
-_TERMINATED = None  # sentinel state for paths that return/raise/break
-
-
-def _walk_shallow(root: ast.AST) -> Iterator[ast.AST]:
-    """Walk without entering nested def/lambda/class bodies — code there
-    does not execute at this lexical position."""
-    stack: list[ast.AST] = [root]
-    while stack:
-        node = stack.pop()
-        if node is not root and isinstance(
-                node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                       ast.Lambda, ast.ClassDef)):
-            continue
-        yield node
-        stack.extend(ast.iter_child_nodes(node))
+def _direct_releases(call: ast.Call) -> list[str]:
+    fn = call.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else \
+        fn.id if isinstance(fn, ast.Name) else ""
+    if name in RELEASERS and call.args and \
+            isinstance(call.args[0], ast.Name):
+        return [call.args[0].id]
+    return []
 
 
-def _release_calls(stmt: ast.stmt) -> list[tuple[ast.Call, str]]:
-    """(call, released-name) for every releaser call in the statement."""
-    out = []
-    for node in _walk_shallow(stmt):
+def _has_releaser_call(fn) -> bool:
+    for node in ast.walk(fn):
         if isinstance(node, ast.Call):
-            fn = node.func
-            name = fn.attr if isinstance(fn, ast.Attribute) else \
-                fn.id if isinstance(fn, ast.Name) else ""
-            if name in RELEASERS and node.args and \
-                    isinstance(node.args[0], ast.Name):
-                out.append((node, node.args[0].id))
-    return out
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else \
+                f.id if isinstance(f, ast.Name) else ""
+            if name in RELEASERS:
+                return True
+    return False
 
 
-class _FuncAnalysis:
-    def __init__(self, rule: "PoolDiscipline", ctx: FileContext,
-                 qualname: str):
-        self.rule = rule
-        self.ctx = ctx
-        self.qualname = qualname
-        self.findings: list[Finding] = []
-        self.reported: set[tuple[str, int]] = set()
-
-    # -- state: dict name -> line of the release ------------------------
-    def run(self, body: list[ast.stmt]) -> None:
-        self.exec_block(body, {})
-
-    def exec_block(self, stmts: list[ast.stmt], state: "dict | None"):
-        for stmt in stmts:
-            if state is _TERMINATED:
-                return _TERMINATED
-            state = self.exec_stmt(stmt, state)
-        return state
-
-    def _emit(self, node: ast.AST, name: str, message: str) -> None:
-        key = (name, getattr(node, "lineno", 0))
-        if key not in self.reported:
-            self.reported.add(key)
-            self.findings.append(self.ctx.finding(
-                self.rule, node, message, self.qualname))
-
-    def _scan_uses(self, stmt: ast.stmt, state: dict,
-                   skip: set[int]) -> None:
-        """Report loads of released names anywhere in the statement,
-        skipping the releaser-arg Name nodes (handled as events) and any
-        nested def/lambda bodies (executed later, maybe never)."""
-        for node in _walk_shallow(stmt):
-            if isinstance(node, ast.Name) and id(node) not in skip and \
-                    isinstance(node.ctx, ast.Load) and node.id in state:
-                self._emit(node, node.id,
-                           f"pooled '{node.id}' used after release")
-
-    def _apply_simple(self, stmt: ast.stmt, state: dict) -> dict:
-        """Uses → releases → rebinds, in that order, for one statement."""
-        releases = _release_calls(stmt)
-        skip = {id(call.args[0]) for call, _ in releases}
-        self._scan_uses(stmt, state, skip)
-        for call, name in releases:
-            if name in state:
-                self._emit(call, name,
-                           f"pooled '{name}' released twice along one path")
-            else:
-                state[name] = call.lineno
-        for node in _walk_shallow(stmt):
-            if isinstance(node, ast.Name) and \
-                    isinstance(node.ctx, (ast.Store, ast.Del)):
-                state.pop(node.id, None)
-        return state
-
-    @staticmethod
-    def _merge(states: list) -> "dict | None":
-        live = [s for s in states if s is not _TERMINATED]
-        if not live:
-            return _TERMINATED
-        merged = dict(live[0])
-        for s in live[1:]:
-            merged = {k: min(v, s[k]) for k, v in merged.items() if k in s}
-        return merged
-
-    def exec_stmt(self, stmt: ast.stmt, state: dict):
-        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.ClassDef)):
-            # the body runs later (analyzed as its own function); only the
-            # binding of the name happens here
-            state.pop(stmt.name, None)
-            return state
-        if isinstance(stmt, (ast.Return, ast.Raise)):
-            self._apply_simple(stmt, state)
-            return _TERMINATED
-        if isinstance(stmt, (ast.Break, ast.Continue)):
-            return _TERMINATED
-        if isinstance(stmt, ast.If):
-            self._apply_simple(ast.Expr(stmt.test), state)
-            s_body = self.exec_block(stmt.body, dict(state))
-            s_else = self.exec_block(stmt.orelse, dict(state))
-            return self._merge([s_body, s_else])
-        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
-            if isinstance(stmt, ast.While):
-                self._apply_simple(ast.Expr(stmt.test), state)
-            else:
-                self._apply_simple(ast.Expr(stmt.iter), state)
-                for node in ast.walk(stmt.target):
-                    if isinstance(node, ast.Name):
-                        state.pop(node.id, None)
-            # one symbolic pass through the body catches straight-line
-            # release→use inside an iteration; loop-carried state (release
-            # in iteration N, use in N+1) is a known gap (ROADMAP)
-            self.exec_block(stmt.body, dict(state))
-            self.exec_block(stmt.orelse, dict(state))
-            return state
-        if isinstance(stmt, (ast.Try, getattr(ast, "TryStar", ast.Try))):
-            s_body = self.exec_block(stmt.body, dict(state))
-            if s_body is not _TERMINATED and stmt.orelse:
-                s_body = self.exec_block(stmt.orelse, s_body)
-            # handlers run from the PRE-try state: the exception may have
-            # fired before any release in the body executed
-            ends = [s_body]
-            for handler in stmt.handlers:
-                ends.append(self.exec_block(handler.body, dict(state)))
-            merged = self._merge(ends)
-            fin_in = merged if merged is not _TERMINATED else dict(state)
-            fin_out = self.exec_block(stmt.finalbody, dict(fin_in))
-            if merged is _TERMINATED or fin_out is _TERMINATED:
-                return _TERMINATED
-            return fin_out
-        if isinstance(stmt, (ast.With, ast.AsyncWith)):
-            for item in stmt.items:
-                self._apply_simple(ast.Expr(item.context_expr), state)
-                if item.optional_vars is not None:
-                    for node in ast.walk(item.optional_vars):
-                        if isinstance(node, ast.Name):
-                            state.pop(node.id, None)
-            return self.exec_block(stmt.body, state)
-        match_cls = getattr(ast, "Match", None)
-        if match_cls is not None and isinstance(stmt, match_cls):
-            self._apply_simple(ast.Expr(stmt.subject), state)
-            ends = [self.exec_block(case.body, dict(state))
-                    for case in stmt.cases]
-            ends.append(dict(state))  # no case may match
-            return self._merge(ends)
-        return self._apply_simple(stmt, state)
+def _pos_params(fn) -> list[str]:
+    a = fn.args
+    return [p.arg for p in (*a.posonlyargs, *a.args)]
 
 
 @register
@@ -198,11 +75,70 @@ class PoolDiscipline(Rule):
     severity = "error"
     description = ("pooled Message/CallbackData/marker used after "
                    "release, or released twice along one path")
+    rationale = (
+        "Freelist-recycled objects (Message, CallbackData, the hot-lane "
+        "running marker) may be re-acquired and re-initialized by ANY "
+        "later allocation the moment they are released. Reading one "
+        "after release silently observes another request's fields — no "
+        "crash, just wrong data on the wire. The analysis is "
+        "interprocedural: a helper that definitely recycles its "
+        "argument poisons the caller's variable, aliases share the "
+        "poison, and loop-carried state catches a release in iteration "
+        "N used in iteration N+1.")
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
+        program = ctx.program
+        ms = ctx.module
+        releasing_short: set[str] = set()
+        if ms is not None:
+            for q, s in ms.functions.items():
+                if s.releases:
+                    releasing_short.add(q.rsplit(".", 1)[-1])
+            if program is not None:
+                for (mod, q), s in program.functions.items():
+                    if s.releases:
+                        releasing_short.add(q.rsplit(".", 1)[-1])
+
         for qualname, fn in iter_functions(ctx.tree):
-            if not any(_release_calls(s) for s in fn.body):
+            candidate = _has_releaser_call(fn)
+            if not candidate and releasing_short:
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call):
+                        f = node.func
+                        name = f.attr if isinstance(f, ast.Attribute) \
+                            else f.id if isinstance(f, ast.Name) else ""
+                        if name in releasing_short:
+                            candidate = True
+                            break
+            if not candidate:
                 continue
-            analysis = _FuncAnalysis(self, ctx, qualname)
-            analysis.run(fn.body)
-            yield from analysis.findings
+
+            findings: list[Finding] = []
+
+            def on_use(node, name, line, _q=qualname, _f=findings):
+                _f.append(ctx.finding(
+                    self, node,
+                    f"pooled '{name}' used after release", _q))
+
+            def on_double(node, name, _q=qualname, _f=findings):
+                _f.append(ctx.finding(
+                    self, node,
+                    f"pooled '{name}' released twice along one path",
+                    _q))
+
+            if ms is not None:
+                extern = program.extern_summary(ms, qualname) \
+                    if program is not None else None
+                rel = (lambda c, _q=qualname, _e=extern:
+                       _call_releases(ms, _q, c, _e))
+                alias = (lambda c, _q=qualname, _e=extern:
+                         _call_alias(ms, _q, c, _e))
+            else:
+                rel = _direct_releases
+                alias = None
+
+            walker = ReleaseWalker(_pos_params(fn), release_of_call=rel,
+                                   alias_of_call=alias, on_use=on_use,
+                                   on_double=on_double)
+            walker.run(fn.body)
+            yield from findings

@@ -51,6 +51,12 @@ class MutableStateLeak(Rule):
     severity = "warning"
     description = ("grain method returns a shared mutable internal "
                    "by reference")
+    rationale = (
+        "In-silo calls pass results by reference on the hot lane and "
+        "direct-interleave paths: returning self._rows hands the "
+        "caller the grain's OWN container, and a later turn's "
+        "mutation is visible across the actor isolation boundary. "
+        "Return a copy (list(...)/dict(...)).")
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
         for cls_qual, cls in iter_grain_classes(ctx.tree):
@@ -98,12 +104,38 @@ class UnawaitedGrainCall(Rule):
     severity = "error"
     description = ("grain-ref coroutine dropped without await or an "
                    "explicit fire-and-forget marker")
+    rationale = (
+        "ref.method() returns a coroutine; dropping it on the floor "
+        "means Python never schedules it — the call silently does "
+        "not happen. Await it, keep the handle, or mark a deliberate "
+        "drop with # otpu: ignore[OTPU005]. @one_way methods are "
+        "exempt via the typed interface tables: their invoke returns "
+        "None by design.")
+
+    def _ref_class(self, ctx: FileContext, call: ast.Call) -> str | None:
+        """The grain class a get_grain(...) call names, when the program
+        has an interface table for it."""
+        if ctx.program is None or not call.args:
+            return None
+        name = dotted_name(call.args[0]).rsplit(".", 1)[-1]
+        return name if name and name in ctx.program.grains else None
+
+    def _is_one_way(self, ctx: FileContext, cls: str | None,
+                    method: str) -> bool:
+        """A dropped @one_way call is the CORRECT usage (the invoke
+        returns None, there is no coroutine to lose) — the typed
+        interface table makes that knowable statically."""
+        if cls is None or ctx.program is None:
+            return False
+        gm = ctx.program.grains[cls].methods.get(method)
+        return gm is not None and gm.one_way
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
         for qualname, fn in iter_functions(ctx.tree):
             # which Name-store nodes bind a grain ref (targets of
-            # `x = <something>.get_grain(...)` assignments)
-            ref_binds: set[int] = set()
+            # `x = <something>.get_grain(...)` assignments), and the
+            # grain class when the call names it literally
+            ref_binds: dict[int, str | None] = {}
             for node in lexical_walk(fn):
                 if isinstance(node, ast.Assign) and \
                         isinstance(node.value, ast.Call) and \
@@ -111,18 +143,19 @@ class UnawaitedGrainCall(Rule):
                         in GRAIN_REF_PRODUCERS:
                     for t in node.targets:
                         if isinstance(t, ast.Name):
-                            ref_binds.add(id(t))
+                            ref_binds[id(t)] = self._ref_class(
+                                ctx, node.value)
             # single lexical pass: a rebind to anything else KILLS the
             # ref-ness of the name, so `r = get_grain(..); r = conn();
             # r.flush()` is not flagged
-            refs: set[str] = set()
+            refs: dict[str, str | None] = {}
             for node in lexical_walk(fn):
                 if isinstance(node, ast.Name) and \
                         isinstance(node.ctx, (ast.Store, ast.Del)):
                     if id(node) in ref_binds:
-                        refs.add(node.id)
+                        refs[node.id] = ref_binds[id(node)]
                     else:
-                        refs.discard(node.id)
+                        refs.pop(node.id, None)
                     continue
                 if not (isinstance(node, ast.Expr) and
                         isinstance(node.value, ast.Call)):
@@ -131,11 +164,18 @@ class UnawaitedGrainCall(Rule):
                 if not isinstance(call.func, ast.Attribute):
                     continue
                 recv = call.func.value
-                dropped = (isinstance(recv, ast.Name) and recv.id in refs) \
-                    or (isinstance(recv, ast.Call) and
-                        dotted_name(recv.func).rsplit(".", 1)[-1]
-                        in GRAIN_REF_PRODUCERS)
-                if dropped:
+                cls = None
+                dropped = False
+                if isinstance(recv, ast.Name) and recv.id in refs:
+                    dropped = True
+                    cls = refs[recv.id]
+                elif isinstance(recv, ast.Call) and \
+                        dotted_name(recv.func).rsplit(".", 1)[-1] \
+                        in GRAIN_REF_PRODUCERS:
+                    dropped = True
+                    cls = self._ref_class(ctx, recv)
+                if dropped and not self._is_one_way(ctx, cls,
+                                                    call.func.attr):
                     yield ctx.finding(
                         self, call,
                         f"grain call '.{call.func.attr}(...)' result "

@@ -71,6 +71,13 @@ class TracedImpurity(Rule):
     severity = "warning"
     description = ("jit/shard_map/pjit-traced function captures or "
                    "mutates host runtime state")
+    rationale = (
+        "A function handed to jit/pjit/shard_map runs ONCE at trace "
+        "time: attribute writes, captured-container mutations, and "
+        "host clock/RNG reads are baked into the compiled program (or "
+        "silently lost), then never re-execute. Kernel specs must be "
+        "closure-pure — pass runtime values as traced arguments and "
+        "use jax.random with explicit keys.")
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
         if not _in_device_dir(ctx.rel_path):

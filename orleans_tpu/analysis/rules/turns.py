@@ -51,6 +51,13 @@ class BlockingInTurn(Rule):
     severity = "error"
     description = ("time.sleep / sync IO / Future.result() inside an "
                    "async def turn")
+    rationale = (
+        "A grain turn shares the silo's single event loop with every "
+        "other activation: one synchronous sleep, file read, or "
+        ".result() wait stalls the WHOLE silo — probe responses "
+        "included, which gets healthy silos voted dead under load. "
+        "Await the async form, or move the blocking work to "
+        "loop.run_in_executor.")
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
         for qualname, fn in iter_functions(ctx.tree):
@@ -198,6 +205,14 @@ class InterleavingHazard(Rule):
     severity = "warning"
     description = ("grain attribute written before and read after an "
                    "await in a non-reentrant grain method")
+    rationale = (
+        "Non-reentrant grains still interleave at awaits: "
+        "always-interleave methods, call-chain reentrancy, read-only "
+        "interleaving, and timer turns can all run between an await "
+        "and the statement after it. Instance state written before "
+        "the await may be stale when read after — re-validate it, or "
+        "move the await so the read-modify-write is atomic within "
+        "one turn segment.")
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
         for cls_qual, cls in iter_grain_classes(ctx.tree):

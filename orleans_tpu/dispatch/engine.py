@@ -893,9 +893,15 @@ class VectorRuntime:
     def _run_batch(self, cls: type, method: str, ready: list[_Pending],
                    trace_roll: bool = False) -> None:
         """Inline (on-loop) batch execution — the ``offloop_tick=False``
-        path, semantically today's tick."""
-        per_shard, host, span = self._execute_batch(
-            cls, method, ready, self.loop_prof, trace_roll=trace_roll)
+        path, semantically today's tick. Runs under the tick fence like
+        the worker path: the loop being the only ticker does NOT make
+        the donated state safe — checkpoint capture() is documented
+        callable from any thread, and a worker batch may still be in
+        flight when offloop_tick is flipped off (restart-in-process).
+        Uncontended reentrant acquire is ~100ns against a multi-ms tick."""
+        with self._fence:
+            per_shard, host, span = self._execute_batch(
+                cls, method, ready, self.loop_prof, trace_roll=trace_roll)
         self._record_tick_span(span, len(ready))
         self._resolve_batch(ready, per_shard, host)
 

@@ -7,10 +7,20 @@
 # just fails faster and prints the findings without the pytest wrapping.
 #
 # Usage: scripts/check.sh [extra pytest args]
+#   CHECK_SARIF=out.sarif scripts/check.sh   # also write the findings
+#   as SARIF 2.1.0 (CI annotation rendering) to the named file
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== static analysis (orleans_tpu/ vs analysis/baseline.json) =="
+if [[ -n "${CHECK_SARIF:-}" ]]; then
+    # SARIF first (non-fatal) so CI gets annotations even when the
+    # gate run below fails the build
+    python -m orleans_tpu.analysis orleans_tpu/ \
+        --baseline analysis/baseline.json --format sarif \
+        > "${CHECK_SARIF}" || true
+    echo "wrote SARIF findings to ${CHECK_SARIF}"
+fi
 python -m orleans_tpu.analysis orleans_tpu/ --baseline analysis/baseline.json
 
 echo "== tier-1 tests =="

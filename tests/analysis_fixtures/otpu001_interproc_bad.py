@@ -1,0 +1,30 @@
+"""OTPU001 interprocedural fixture — all three shapes below are invisible
+to the legacy per-function pass (asserted via --intra-only in the tests):
+the release happens in a helper, behind an alias, or on a loop back edge."""
+from orleans_tpu.core.message import recycle_message
+
+
+def finish(msg):
+    msg.handled = True
+    recycle_message(msg)
+
+
+def handler_uses_after_helper_release(msg):
+    finish(msg)
+    return msg.correlation_id
+
+
+def passthrough(m):
+    return m
+
+
+def alias_poisoned_by_release(m):
+    twin = passthrough(m)
+    recycle_message(m)
+    return twin.body
+
+
+def loop_carried_release(queue, shell):
+    while queue:
+        queue.pop().reply_to = shell.sending
+        recycle_message(shell)

@@ -1,0 +1,52 @@
+"""OTPU007 bad: loop-confined registries written from worker contexts —
+a Thread target writing a Histogram directly, a Thread-subclass pump
+incrementing a StatsRegistry, a live registry handed into a decode
+helper from shard code, and a run_in_executor callable noting a trend."""
+import asyncio
+import threading
+
+from orleans_tpu.observability.stats import Histogram, StatsRegistry
+
+
+def decode_chunk(buf, stats):
+    if stats is not None:
+        stats.observe("decode", 0.1)
+    return buf
+
+
+class TickWorker:
+    def __init__(self):
+        self.hist = Histogram()
+        self.stats = StatsRegistry()
+        self.thread = threading.Thread(target=self._worker_main)
+
+    def _worker_main(self):
+        while True:
+            self.hist.observe(0.5)
+            decode_chunk(b"", self.stats)
+
+
+class ShardPump(threading.Thread):
+    def __init__(self, registry):
+        super().__init__(daemon=True)
+        self.loop = asyncio.new_event_loop()
+        self.registry: StatsRegistry = registry
+
+    def run(self):
+        self.loop.call_soon(self._drain)
+        self.loop.run_forever()
+
+    def _drain(self):
+        self.registry.increment("frames")
+
+
+class Flusher:
+    def __init__(self, trend):
+        self.trend = trend
+
+    async def flush(self):
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, self._flush_sync)
+
+    def _flush_sync(self):
+        self.trend.note(0.2)

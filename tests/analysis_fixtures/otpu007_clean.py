@@ -1,0 +1,43 @@
+"""OTPU007 clean: the stamp-and-replay pattern and its boundary idioms —
+the worker appends (key, value) stamps to a plain list and a loop-side
+callback replays them into the registry; decode helpers receive a None
+sink off-loop; callables handed BACK to the main loop may write."""
+import asyncio
+import threading
+
+from orleans_tpu.observability.stats import Histogram, StatsRegistry
+
+
+def decode_chunk(buf, stats=None):
+    if stats is not None:
+        stats.observe("decode", 0.1)
+    return buf
+
+
+def emit(sink, registry, key, value):
+    if sink is not None:
+        sink.append((key, value))
+    else:
+        registry.observe(key, value)
+
+
+class TickWorker:
+    def __init__(self):
+        self.hist = Histogram()
+        self.stats = StatsRegistry()
+        self._loop = asyncio.get_running_loop()
+        self.thread = threading.Thread(target=self._worker_main)
+
+    def _worker_main(self):
+        while True:
+            stamps = []
+            stamps.append(("tick", 0.5))
+            emit(stamps, self.stats, "staging", 0.1)
+            decode_chunk(b"", None)
+            decode_chunk(b"")
+            self._loop.call_soon_threadsafe(self._replay, stamps)
+
+    def _replay(self, stamps):
+        for key, value in stamps:
+            self.stats.observe(key, value)
+        self.hist.observe(0.5)

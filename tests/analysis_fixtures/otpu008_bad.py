@@ -1,0 +1,42 @@
+"""OTPU008 bad: donated device state touched outside the tick fence —
+an unfenced entry point reads .state directly, and a table method whose
+only call site is unfenced inherits the violation."""
+import threading
+
+
+class MiniTable:
+    def __init__(self):
+        self.fence = threading.RLock()
+        self.state = {}
+        self.hits = None
+
+    def snapshot(self):
+        return dict(self.state)
+
+    def grow(self):
+        with self.fence:
+            self.state = {}
+
+
+def drain_rows(tbl: MiniTable):
+    return list(tbl.state.values())
+
+
+def unfenced_caller(tbl: MiniTable):
+    return tbl.snapshot()
+
+
+def reset_hits(tbl: MiniTable):
+    tbl.hits = None
+
+
+def ping_state(tbl: MiniTable, n: int):
+    # mutually-recursive unfenced cycle: neither side may vouch for
+    # the other (the least-fixpoint case)
+    if n <= 0:
+        return tbl.state
+    return pong_state(tbl, n - 1)
+
+
+def pong_state(tbl: MiniTable, n: int):
+    return ping_state(tbl, n - 1)

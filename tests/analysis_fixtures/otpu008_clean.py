@@ -1,0 +1,43 @@
+"""OTPU008 clean: every donated-state touch is fenced — lexically, or
+by summary propagation (every known call site of ``snapshot`` holds the
+fence, so the method itself needs none)."""
+import threading
+
+
+class FencedTable:
+    def __init__(self):
+        self.fence = threading.RLock()
+        self.state = {}
+        self.hits = None
+
+    def snapshot(self):
+        return dict(self.state)
+
+    def grow(self):
+        with self.fence:
+            self.state = {}
+            self.hits = None
+
+
+def fenced_caller(tbl: FencedTable):
+    with tbl.fence:
+        return tbl.snapshot()
+
+
+def fenced_direct(tbl: FencedTable):
+    with tbl.fence:
+        return list(tbl.state.values())
+
+
+def fenced_recursive_walk(tbl: FencedTable, n: int):
+    # recursion under a fenced entry: the fenced root promotes the
+    # whole cycle (least fixpoint — an UNFENCED cycle cannot vouch
+    # for itself, see otpu008_bad's mutual recursion)
+    with tbl.fence:
+        return _walk(tbl, n)
+
+
+def _walk(tbl: FencedTable, n: int):
+    if n <= 0:
+        return tbl.state
+    return _walk(tbl, n - 1)

@@ -1,0 +1,40 @@
+"""OTPU009 bad: call sites disagreeing with the grain interface tables —
+wrong get_grain shape, wrong method arity, unknown methods, an awaited
+@one_way, a typo'd call_batch string, a host grain in a device-tier
+collective, and a bad map_actors/broadcast_actors method name."""
+from orleans_tpu.dispatch.vector_grain import VectorGrain, actor_method
+from orleans_tpu.runtime.grain import Grain, one_way
+
+
+class LedgerAccount(Grain):
+    async def deposit(self, amount):
+        return amount
+
+    async def transfer(self, dest, amount, memo=None):
+        return amount
+
+    @one_way
+    async def fire_audit(self):
+        pass
+
+
+class PresenceRow(VectorGrain):
+    @actor_method
+    def heartbeat(state, amount):
+        return state
+
+
+async def bad_call_sites(factory, client):
+    ref = factory.get_grain(LedgerAccount, 1, "ext", "extra")
+    await ref.deposit(1, 2)
+    await ref.withdraw(5)
+    await ref.transfer(2, 10, memo="x", urgency=9)
+    await ref.fire_audit()
+    factory.call_batch(LedgerAccount, "depost", [(1, {"amount": 2})])
+    await client.map_actors(LedgerAccount, "deposit", {})
+    await client.map_actors(PresenceRow, "missing_tick", {})
+    await client.broadcast_actors(PresenceRow, "heartbeet", [], {})
+    await client.join_when(PresenceRow, [1, 2], method="absent")
+    factory.get_grain(LedgerAccount)
+    late = factory.get_grain(LedgerAccount, 3)
+    await late.depositt(1)

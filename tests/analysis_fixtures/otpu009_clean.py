@@ -1,0 +1,49 @@
+"""OTPU009 clean: the same call shapes, all matching the interface
+tables built from the grain class definitions."""
+from orleans_tpu.dispatch.vector_grain import VectorGrain, actor_method
+from orleans_tpu.runtime.grain import Grain, one_way
+
+
+class SavingsAccount(Grain):
+    async def deposit(self, amount):
+        return amount
+
+    async def transfer(self, dest, amount, memo=None):
+        return amount
+
+    @one_way
+    async def fire_audit(self):
+        pass
+
+
+class PresenceCell(VectorGrain):
+    @actor_method
+    def heartbeat(state, amount):
+        return state
+
+
+async def good_call_sites(factory, client, grain_cls):
+    ref = factory.get_grain(SavingsAccount, 1, "ext")
+    await ref.deposit(1)
+    await ref.transfer(2, 10, memo="x")
+    ref.fire_audit()
+    await factory.get_grain(SavingsAccount, 2).deposit(amount=3)
+    factory.call_batch(SavingsAccount, "deposit", [(1, {"amount": 2})])
+    await client.map_actors(PresenceCell, "heartbeat", {"amount": 1})
+    await client.broadcast_actors(PresenceCell, "heartbeat", [], {})
+    await client.join_when(PresenceCell, [1, 2], method="heartbeat")
+    # a variable class is never checked — the plumbing stays silent
+    await client.map_actors(grain_cls, "whatever", {})
+    ref = factory.get_grain(SavingsAccount, key=4)
+    await ref.deposit(1)
+
+
+async def rebind_kills_ref_typing(factory, pool):
+    # a name that WAS a connection and becomes a ref (and vice versa)
+    # is judged per lexical position, never by its last binding
+    r = pool.get_connection()
+    r.send(b"x")
+    r = factory.get_grain(SavingsAccount, 1)
+    await r.deposit(1)
+    r = pool.get_connection()
+    r.send(b"y")

@@ -36,23 +36,42 @@ def _scan(name: str):
 EXPECTED_BAD = {
     "otpu001_bad.py": {("OTPU001", 7), ("OTPU001", 12), ("OTPU001", 20),
                        ("OTPU001", 25)},
+    # interprocedural shapes: helper release (14), alias via identity
+    # helper (24), loop-carried use (29) + its second-iteration double
+    # release (30) — the --intra-only split is asserted separately
+    "otpu001_interproc_bad.py": {("OTPU001", 14), ("OTPU001", 24),
+                                 ("OTPU001", 29), ("OTPU001", 30)},
     "otpu002_bad.py": {("OTPU002", 6), ("OTPU002", 10), ("OTPU002", 14)},
     "otpu003_bad.py": {("OTPU003", 9), ("OTPU003", 14)},
     "otpu004_bad.py": {("OTPU004", 11), ("OTPU004", 14)},
     "otpu005_bad.py": {("OTPU005", 6), ("OTPU005", 10)},
     "otpu006_bad.py": {("OTPU006", 12), ("OTPU006", 13), ("OTPU006", 14),
                        ("OTPU006", 15)},
+    # Thread-target Histogram.observe (25), live registry into a decode
+    # helper (26), shard-loop StatsRegistry.increment (40),
+    # run_in_executor trend note (52)
+    "otpu007_bad.py": {("OTPU007", 25), ("OTPU007", 26), ("OTPU007", 40),
+                       ("OTPU007", 52)},
+    # unfenced-caller propagation (14), entry-point read (22), hits
+    # store (30), unfenced mutual-recursion cycle (37 — a cycle cannot
+    # vouch for itself in the SCC-condensed held fixpoint)
+    "otpu008_bad.py": {("OTPU008", 14), ("OTPU008", 22), ("OTPU008", 30),
+                       ("OTPU008", 37)},
+    "otpu009_bad.py": {("OTPU009", n) for n in range(28, 39)}
+    | {("OTPU009", 40)},
 }
 
 CLEAN = ["otpu001_clean.py", "otpu002_clean.py", "otpu003_clean.py",
          "otpu004_clean.py", "otpu005_clean.py", "otpu006_clean.py",
+         "otpu007_clean.py", "otpu008_clean.py", "otpu009_clean.py",
          "suppressed.py"]
 
 
 def test_every_rule_has_bad_and_clean_fixture():
     rules = {r.id for r in all_rules()}
     assert rules == {"OTPU001", "OTPU002", "OTPU003", "OTPU004",
-                     "OTPU005", "OTPU006"}
+                     "OTPU005", "OTPU006", "OTPU007", "OTPU008",
+                     "OTPU009"}
     for rid in rules:
         assert f"{rid.lower()}_bad.py" in EXPECTED_BAD
         assert f"{rid.lower()}_clean.py" in CLEAN
@@ -242,6 +261,146 @@ def test_syntax_error_is_a_finding_not_a_crash():
 
 
 # ---------------------------------------------------------------------------
+# Interprocedural engine (PR 14): summaries, worker set, fence fixpoint
+# ---------------------------------------------------------------------------
+
+def test_interproc_fixture_split_vs_intra_only():
+    """The helper-release and alias shapes are flagged by the upgraded
+    OTPU001 and provably NOT by the legacy intra-procedural
+    configuration; loop-carried stays intra-detectable."""
+    target = os.path.join(FIXTURES, "otpu001_interproc_bad.py")
+    inter = {(f.rule, f.line) for f in analyze_paths([target])}
+    intra = {(f.rule, f.line)
+             for f in analyze_paths([target], interprocedural=False)}
+    assert {("OTPU001", 14), ("OTPU001", 24)} <= inter
+    assert ("OTPU001", 14) not in intra
+    assert ("OTPU001", 24) not in intra
+    assert ("OTPU001", 29) in inter and ("OTPU001", 29) in intra
+    # the CLI spells the legacy configuration --rules OTPU001 --intra-only
+    assert cli_main([target, "--rules", "OTPU001"]) == 1
+    assert cli_main([target, "--rules", "OTPU001", "--intra-only",
+                     "--format", "json"]) == 1  # loop-carried remains
+    assert cli_main([os.path.join(FIXTURES, "otpu001_clean.py"),
+                     "--rules", "OTPU001", "--intra-only"]) == 0
+
+
+def test_intra_only_disables_program_backed_rules():
+    for fname in ("otpu007_bad.py", "otpu008_bad.py", "otpu009_bad.py"):
+        target = os.path.join(FIXTURES, fname)
+        assert cli_main([target]) == 1, fname
+        assert cli_main([target, "--intra-only"]) == 0, fname
+
+
+def test_release_summaries_and_aliases():
+    from orleans_tpu.analysis.summaries import module_summary
+    src = (
+        "from orleans_tpu.core.message import recycle_message\n"
+        "def helper(m):\n"
+        "    recycle_message(m)\n"
+        "def wrapper(shell):\n"
+        "    helper(shell)\n"
+        "def conditional(m, flag):\n"
+        "    if flag:\n"
+        "        recycle_message(m)\n"
+        "def ident(x):\n"
+        "    return x\n"
+        "def escaper(pool, m):\n"
+        "    pool.append(m)\n")
+    ms = module_summary(src, "m.py")
+    assert ms.functions["helper"].releases == frozenset({0})
+    # transitive: wrapper releases through helper (module-local closure)
+    assert ms.functions["wrapper"].releases == frozenset({0})
+    # conditional release is NOT definite
+    assert ms.functions["conditional"].releases == frozenset()
+    assert ms.functions["ident"].returns_param == 0
+    assert ms.functions["escaper"].returns_param is None
+
+
+def test_worker_set_and_loop_kinds():
+    from orleans_tpu.analysis.summaries import build_program
+    src = (
+        "import asyncio, threading\n"
+        "class Shard(threading.Thread):\n"
+        "    def __init__(self):\n"
+        "        self.loop = asyncio.new_event_loop()\n"
+        "        self.main = asyncio.get_running_loop()\n"
+        "    def run(self):\n"
+        "        self.loop.call_soon(self.pump)\n"
+        "    def pump(self):\n"
+        "        self.decode()\n"
+        "        self.main.call_soon_threadsafe(self.replay)\n"
+        "    def decode(self):\n"
+        "        pass\n"
+        "    def replay(self):\n"
+        "        pass\n")
+    prog = build_program([(src, "shard.py", None)])
+    worker = {q for (_, q) in prog.worker}
+    assert {"Shard.run", "Shard.pump", "Shard.decode"} <= worker
+    # the main-loop callback is an ESCAPE, not worker code
+    assert "Shard.replay" not in worker
+
+
+def test_fence_held_propagation():
+    from orleans_tpu.analysis.summaries import build_program
+    src = (
+        "import threading\n"
+        "class Tbl:\n"
+        "    def __init__(self):\n"
+        "        self.fence = threading.RLock()\n"
+        "        self.state = {}\n"
+        "    def peek(self):\n"
+        "        return self.state\n"
+        "def fenced(t: Tbl):\n"
+        "    with t.fence:\n"
+        "        return t.peek()\n")
+    prog = build_program([(src, "t.py", None)])
+    assert prog.held[("t", "Tbl.peek")] is True
+    src2 = src + "def rogue(t: Tbl):\n    return t.peek()\n"
+    prog2 = build_program([(src2, "t.py", None)])
+    assert prog2.held[("t", "Tbl.peek")] is False
+
+
+def test_otpu005_one_way_drop_recognized_via_tables():
+    src = ("from orleans_tpu.runtime.grain import Grain, one_way\n"
+           "class Pinger(Grain):\n"
+           "    @one_way\n"
+           "    async def ping(self):\n"
+           "        pass\n"
+           "    async def work(self):\n"
+           "        pass\n"
+           "async def go(factory):\n"
+           "    r = factory.get_grain(Pinger, 1)\n"
+           "    r.ping()\n"
+           "    r.work()\n")
+    findings = analyze_source(src, "g.py")
+    assert [(f.rule, f.line) for f in findings] == [("OTPU005", 11)]
+
+
+def test_summary_cache_hits_on_identical_content(tmp_path):
+    from orleans_tpu.analysis import summaries
+    src = "def f(x):\n    return x\n"
+    a = summaries.module_summary(src, "same.py")
+    b = summaries.module_summary(src, "same.py")
+    assert a is b                       # content-hash cache hit
+    c = summaries.module_summary(src + "\n# changed\n", "same.py")
+    assert c is not a
+
+
+def test_self_run_performance_budget():
+    """The tier-1 gate re-runs the analyzer over the full tree; with
+    phase-1 summaries cached per content hash the warm run must stay
+    well under the ~10s budget on this container."""
+    import time
+    pkg = os.path.join(REPO, "orleans_tpu")
+    analyze_paths([pkg])                # warm parse + summary cache
+    t0 = time.perf_counter()
+    analyze_paths([pkg])
+    assert time.perf_counter() - t0 < 10.0
+    from orleans_tpu.analysis.summaries import _CACHE
+    assert _CACHE                       # summaries actually cached
+
+
+# ---------------------------------------------------------------------------
 # Baseline
 # ---------------------------------------------------------------------------
 
@@ -337,9 +496,47 @@ def test_cli_write_baseline_refuses_filters(tmp_path):
                      "--rules", "OTPU001"]) == 2
     assert cli_main([FIXTURES, "--write-baseline", out,
                      "--min-severity", "error"]) == 2
+    assert cli_main([FIXTURES, "--write-baseline", out,
+                     "--intra-only"]) == 2
     assert not os.path.exists(out)
     assert cli_main([FIXTURES, "--write-baseline", out]) == 0
     assert os.path.exists(out)
+
+
+def test_cli_sarif_format(capsys):
+    rc = cli_main([os.path.join(FIXTURES, "otpu007_bad.py"),
+                   "--format", "sarif"])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "orleans-tpu-analysis"
+    assert {r["ruleId"] for r in run["results"]} == {"OTPU007"}
+    loc = run["results"][0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("otpu007_bad.py")
+    assert loc["region"]["startLine"] in {25, 26, 40, 52}
+    ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"OTPU001", "OTPU007", "OTPU008", "OTPU009"} <= ids
+    levels = {r["ruleId"]: r["level"] for r in run["results"]}
+    assert levels["OTPU007"] == "error"
+
+
+def test_cli_sarif_clean_file_emits_empty_results(capsys):
+    rc = cli_main([os.path.join(FIXTURES, "otpu007_clean.py"),
+                   "--format", "sarif"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["runs"][0]["results"] == []
+
+
+def test_cli_explain_prints_rationale_and_fixture_pair(capsys):
+    assert cli_main(["--explain", "otpu007"]) == 0
+    out = capsys.readouterr().out
+    assert "OTPU007" in out and "stamp" in out.lower()
+    assert "otpu007_bad.py" in out and "otpu007_clean.py" in out
+    assert cli_main(["--explain", "OTPU001"]) == 0
+    assert "interprocedural" in capsys.readouterr().out
+    assert cli_main(["--explain", "OTPU999"]) == 2
 
 
 # ---------------------------------------------------------------------------
