@@ -105,7 +105,7 @@ async def run(seconds: float = 2.0, concurrency: int = 32,
               call_batch: bool = False,
               call_batch_size: int = 16,
               egress: bool = True, ingress_loops: int = 1,
-              n_clients: int = 1) -> dict:
+              egress_shards: int = 0, n_clients: int = 1) -> dict:
     """One silo over real TCP, metrics on, mixed host + device traffic;
     returns the stage breakdown in the BENCH extra. ``batched=False``
     flips the silo to the per-frame ingest path, ``offloop=False`` to
@@ -117,7 +117,9 @@ async def run(seconds: float = 2.0, concurrency: int = 32,
     ``ingress_loops>=2`` runs the multi-loop silo (ISSUE 11) with
     ``n_clients`` gateway connections feeding its shards — the
     queue-wait share under multi-loop is this harness's acceptance
-    read."""
+    read. ``egress_shards>=1`` (ISSUE 15) moves outbound senders and
+    shard-owned response encode onto shard loops — the egress stage
+    seconds then include shard-stamped/loop-replayed observations."""
     import numpy as np
 
     from orleans_tpu.dispatch import add_vector_grains
@@ -129,7 +131,8 @@ async def run(seconds: float = 2.0, concurrency: int = 32,
          .add_grains(EchoGrain)
          .with_config(metrics_enabled=True, metrics_sample_period=0.25,
                       batched_ingress=batched, offloop_tick=offloop,
-                      batched_egress=egress, ingress_loops=ingress_loops))
+                      batched_egress=egress, ingress_loops=ingress_loops,
+                      egress_shards=egress_shards))
     add_vector_grains(b, EchoVec, mesh=make_mesh(1),
                       dense={EchoVec: n_keys})
     silo = b.build()
@@ -221,7 +224,8 @@ async def run(seconds: float = 2.0, concurrency: int = 32,
             "seconds": seconds, "concurrency": concurrency,
             "batched": batched, "offloop": offloop,
             "call_batch": call_batch, "egress": egress,
-            "ingress_loops": ingress_loops, "n_clients": n_clients,
+            "ingress_loops": ingress_loops,
+            "egress_shards": egress_shards, "n_clients": n_clients,
             "calls": calls,
             "stage_seconds": {k: round(v, 4)
                               for k, v in stage_seconds.items()},
@@ -392,52 +396,73 @@ async def run_call_batch_ab(seconds: float = 1.5, workers: int = 16,
     luck — while per-message pump cost stays ~flat (the receive side has
     been batch-routed since the PR-7 ingress pipeline). Ratio-based, so
     interpreter/container speed cancels."""
+    import gc
+
     import numpy as np
 
     from orleans_tpu.dispatch import add_vector_grains
     from orleans_tpu.parallel import make_mesh
 
-    EchoVec = _make_vector_grain()
-    fabric = SocketFabric()
-    b = (SiloBuilder().with_name("cb-ab").with_fabric(fabric)
-         .add_grains(EchoGrain))
-    add_vector_grains(b, EchoVec, mesh=make_mesh(1), dense={EchoVec: n_keys})
-    silo = b.build()
-    await silo.start()
-    client = await GatewayClient([silo.silo_address.endpoint]).connect()
+    # the run_egress_ab GC discipline (collect + FREEZE): in a full-suite
+    # run a gen-2 collection can trigger inside ONE side's timed window
+    # and which side draws it shifts with every suite-size change —
+    # park the pre-existing heap so in-measure collections scan only
+    # this bench's young objects. The try/finally brackets the freeze
+    # IMMEDIATELY: a failed silo start/connect must not leave the
+    # process heap permanently frozen for every later floor
+    gc.collect()
+    gc.freeze()
     try:
-        refs = [client.get_grain(EchoVec, k) for k in range(n_keys)]
-        await asyncio.gather(*(v.ping(x=np.int32(0)) for v in refs[:8]))
+        EchoVec = _make_vector_grain()
+        fabric = SocketFabric()
+        b = (SiloBuilder().with_name("cb-ab").with_fabric(fabric)
+             .add_grains(EchoGrain))
+        add_vector_grains(b, EchoVec, mesh=make_mesh(1),
+                          dense={EchoVec: n_keys})
+        silo = b.build()
+        await silo.start()
+        # the silo's own try/finally starts HERE: a connect() failure
+        # must still stop it, or its threads/sockets pollute every
+        # later floor in the process
+        client = None
+        try:
+            client = await GatewayClient(
+                [silo.silo_address.endpoint]).connect()
+            refs = [client.get_grain(EchoVec, k) for k in range(n_keys)]
+            await asyncio.gather(*(v.ping(x=np.int32(0)) for v in refs[:8]))
 
-        async def measure(use_batch: bool) -> float:
-            stop_at = time.perf_counter() + seconds
-            calls = 0
-            cb_count = [0]
+            async def measure(use_batch: bool) -> float:
+                stop_at = time.perf_counter() + seconds
+                calls = 0
+                cb_count = [0]
 
-            async def w_pm(wid: int) -> None:
-                nonlocal calls
-                i = wid
-                while time.perf_counter() < stop_at:
-                    await refs[i % n_keys].ping(x=np.int32(i & 0x7FFF))
-                    i += 1
-                    calls += 1
+                async def w_pm(wid: int) -> None:
+                    nonlocal calls
+                    i = wid
+                    while time.perf_counter() < stop_at:
+                        await refs[i % n_keys].ping(x=np.int32(i & 0x7FFF))
+                        i += 1
+                        calls += 1
 
-            # the shared sender loop (batched_vec_sender): the A/B's
-            # batched side drives the same traffic the attribution
-            # harnesses measure
-            w_cb = batched_vec_sender(client, EchoVec, n_keys, batch,
-                                      stop_at, cb_count)
+                # the shared sender loop (batched_vec_sender): the A/B's
+                # batched side drives the same traffic the attribution
+                # harnesses measure
+                w_cb = batched_vec_sender(client, EchoVec, n_keys, batch,
+                                          stop_at, cb_count)
 
-            t0 = time.perf_counter()
-            await asyncio.gather(*((w_cb if use_batch else w_pm)(w)
-                                   for w in range(workers)))
-            return (calls + cb_count[0]) / (time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                await asyncio.gather(*((w_cb if use_batch else w_pm)(w)
+                                       for w in range(workers)))
+                return (calls + cb_count[0]) / (time.perf_counter() - t0)
 
-        per_msg = await measure(False)
-        batched = await measure(True)
+            per_msg = await measure(False)
+            batched = await measure(True)
+        finally:
+            if client is not None:
+                await client.close_async()
+            await silo.stop()
     finally:
-        await client.close_async()
-        await silo.stop()
+        gc.unfreeze()
     ratio = batched / per_msg if per_msg else 0.0
     return {
         "metric": "call_batch_speedup",
@@ -453,7 +478,9 @@ async def run_call_batch_ab(seconds: float = 1.5, workers: int = 16,
 
 
 async def run_egress_ab(seconds: float = 1.5, workers: int = 16,
-                        n_keys: int = 64, batch: int = 16) -> dict:
+                        n_keys: int = 64, batch: int = 16,
+                        ingress_loops: int = 1,
+                        egress_shards: int = 0) -> dict:
     """Batched vs per-message RESPONSE path, vector-only closed loop over
     real TCP (the ISSUE-10 lever, isolated the same way the call_batch
     A/B isolated the sender side): identical ``call_batch`` senders drive
@@ -463,7 +490,11 @@ async def run_egress_ab(seconds: float = 1.5, workers: int = 16,
     one inbound batch's responses group per origin and ride ONE
     encode_message_batch write (header-prefix template) plus one
     client-side receive_response_batch correlation pass. Ratio-based, so
-    interpreter/container speed cancels."""
+    interpreter/container speed cancels. ``ingress_loops``/
+    ``egress_shards`` apply to BOTH sides (measure the batched-egress
+    lever under multi-loop/sharded-egress configurations; the
+    sharded-egress A/B itself lives in
+    ``loop_attribution.run_egress_shards_ab``)."""
     import numpy as np
 
     from orleans_tpu.dispatch import add_vector_grains
@@ -483,31 +514,43 @@ async def run_egress_ab(seconds: float = 1.5, workers: int = 16,
         # scan only this bench's young objects; unfreeze restores it.
         gc.collect()
         gc.freeze()
-        EchoVec = _make_vector_grain()
-        fabric = SocketFabric()
-        b = (SiloBuilder().with_name("eg-ab").with_fabric(fabric)
-             .add_grains(EchoGrain)
-             .with_config(batched_egress=egress))
-        add_vector_grains(b, EchoVec, mesh=make_mesh(1),
-                          dense={EchoVec: n_keys})
-        silo = b.build()
-        await silo.start()
-        client = await GatewayClient([silo.silo_address.endpoint]).connect()
-        client.batched_egress = egress  # correlation half of the lever
-        try:
-            refs = [client.get_grain(EchoVec, k) for k in range(n_keys)]
-            await asyncio.gather(*(v.ping(x=np.int32(0))
-                                   for v in refs[:8]))
-            stop_at = time.perf_counter() + seconds
-            cb_count = [0]
-            w = batched_vec_sender(client, EchoVec, n_keys, batch,
-                                   stop_at, cb_count)
-            t0 = time.perf_counter()
-            await asyncio.gather(*(w(i) for i in range(workers)))
-            return cb_count[0] / (time.perf_counter() - t0)
+        try:  # freeze bracketed immediately: a failed start/connect
+            # must not leave the process heap permanently frozen
+            EchoVec = _make_vector_grain()
+            fabric = SocketFabric()
+            b = (SiloBuilder().with_name("eg-ab").with_fabric(fabric)
+                 .add_grains(EchoGrain)
+                 .with_config(batched_egress=egress,
+                              ingress_loops=ingress_loops,
+                              egress_shards=egress_shards))
+            add_vector_grains(b, EchoVec, mesh=make_mesh(1),
+                              dense={EchoVec: n_keys})
+            silo = b.build()
+            await silo.start()
+            # silo bracketed from HERE: a connect() failure must still
+            # stop it (threads/sockets otherwise leak into every later
+            # floor in the process)
+            client = None
+            try:
+                client = await GatewayClient(
+                    [silo.silo_address.endpoint]).connect()
+                client.batched_egress = egress  # correlation half
+                refs = [client.get_grain(EchoVec, k)
+                        for k in range(n_keys)]
+                await asyncio.gather(*(v.ping(x=np.int32(0))
+                                       for v in refs[:8]))
+                stop_at = time.perf_counter() + seconds
+                cb_count = [0]
+                w = batched_vec_sender(client, EchoVec, n_keys, batch,
+                                       stop_at, cb_count)
+                t0 = time.perf_counter()
+                await asyncio.gather(*(w(i) for i in range(workers)))
+                return cb_count[0] / (time.perf_counter() - t0)
+            finally:
+                if client is not None:
+                    await client.close_async()
+                await silo.stop()
         finally:
-            await client.close_async()
-            await silo.stop()
             gc.unfreeze()
 
     per_msg = await measure(False)
@@ -523,7 +566,8 @@ async def run_egress_ab(seconds: float = 1.5, workers: int = 16,
             "per_message_msgs_per_sec": round(per_msg, 1),
             "batched_msgs_per_sec": round(batched, 1),
             "workers": workers, "batch": batch, "n_keys": n_keys,
-            "seconds": seconds,
+            "seconds": seconds, "ingress_loops": ingress_loops,
+            "egress_shards": egress_shards,
         },
     }
 
@@ -550,20 +594,28 @@ def main() -> None:
     ap.add_argument("--call-batch", action="store_true",
                     help="vector senders use deliberate client-side "
                          "call_batch groups instead of per-message pings")
+    ap.add_argument("--ingress-loops", type=int, default=1,
+                    help="multi-loop silo: N ingress pump threads")
+    ap.add_argument("--egress-shards", type=int, default=0,
+                    help="sharded egress: N egress shard loops")
     a = ap.parse_args()
     if a.ab:
         print(json.dumps(asyncio.run(run_ab(seconds=a.seconds))))
     elif a.call_batch_ab:
         print(json.dumps(asyncio.run(run_call_batch_ab(seconds=a.seconds))))
     elif a.egress_ab:
-        print(json.dumps(asyncio.run(run_egress_ab(seconds=a.seconds))))
+        print(json.dumps(asyncio.run(run_egress_ab(
+            seconds=a.seconds, ingress_loops=a.ingress_loops,
+            egress_shards=a.egress_shards))))
     else:
         print(json.dumps(asyncio.run(run(
             a.seconds, a.concurrency,
             batched=not a.per_frame,
             offloop=not a.inline_tick,
             call_batch=a.call_batch,
-            egress=not a.per_message_egress))))
+            egress=not a.per_message_egress,
+            ingress_loops=a.ingress_loops,
+            egress_shards=a.egress_shards))))
 
 
 if __name__ == "__main__":

@@ -54,7 +54,7 @@ async def run(seconds: float = 2.0, concurrency: int = 32,
               n_grains: int = 64, n_keys: int = 64,
               offloop: bool = True, call_batch: bool = False,
               call_batch_size: int = 16, ingress_loops: int = 1,
-              n_clients: int = 1) -> dict:
+              egress_shards: int = 0, n_clients: int = 1) -> dict:
     """One silo over real TCP, profiling on, mixed host + device traffic
     at closed-loop saturation; returns the loop-occupancy breakdown.
     ``offloop=False`` restores the loop-inline device tick (the A/B
@@ -63,7 +63,10 @@ async def run(seconds: float = 2.0, concurrency: int = 32,
     ``ingress_loops>=2`` runs the multi-loop silo (sharded ingress pump
     threads — ISSUE 11) and ``n_clients`` controls how many gateway
     connections feed it (each pins to one ingress loop, so the
-    multi-loop A/B drives >= 2 connections on BOTH sides)."""
+    multi-loop A/B drives >= 2 connections on BOTH sides);
+    ``egress_shards>=1`` moves outbound senders + shard-owned response
+    encode/writev onto shard loops (ISSUE 15) — the main loop's
+    "egress" occupancy share is that lever's structural signal."""
     import numpy as np
 
     from orleans_tpu.dispatch import add_vector_grains
@@ -74,14 +77,19 @@ async def run(seconds: float = 2.0, concurrency: int = 32,
     b = (SiloBuilder().with_name("loop-silo").with_fabric(fabric)
          .add_grains(EchoGrain)
          .with_config(profiling_enabled=True, profiling_window=0.25,
-                      offloop_tick=offloop, ingress_loops=ingress_loops))
+                      offloop_tick=offloop, ingress_loops=ingress_loops,
+                      egress_shards=egress_shards))
     add_vector_grains(b, EchoVec, mesh=make_mesh(1),
                       dense={EchoVec: n_keys})
     silo = b.build()
     await silo.start()
-    clients = await connect_clients(silo.silo_address.endpoint, n_clients)
-    client = clients[0]
+    # silo bracketed from HERE: a connect failure must still stop it
+    # (threads/sockets otherwise leak into every later measurement)
+    clients = []
     try:
+        clients = await connect_clients(silo.silo_address.endpoint,
+                                        n_clients)
+        client = clients[0]
         host_refs = [clients[k % len(clients)].get_grain(EchoGrain, k)
                      for k in range(n_grains)]
         vec_refs = [clients[k % len(clients)].get_grain(EchoVec, k)
@@ -173,7 +181,8 @@ async def run(seconds: float = 2.0, concurrency: int = 32,
         "extra": {
             "seconds": seconds, "concurrency": concurrency,
             "offloop": offloop, "call_batch": call_batch,
-            "ingress_loops": ingress_loops, "n_clients": n_clients,
+            "ingress_loops": ingress_loops,
+            "egress_shards": egress_shards, "n_clients": n_clients,
             "ingress_loop_profiles": ingress,
             "calls": calls,
             "calls_per_sec": round(calls / elapsed, 1),
@@ -184,6 +193,8 @@ async def run(seconds: float = 2.0, concurrency: int = 32,
             "device_sync_share": shares.get("tick_sync", 0.0),
             "turns_share": shares.get("turns", 0.0),
             "pump_share": shares.get("pump", 0.0),
+            "egress_share": shares.get("egress", 0.0),
+            "egress_seconds": sec.get("egress", 0.0),
             "client_share": shares.get("client", 0.0),
             "observability_share": shares.get("observability", 0.0),
             "top_callbacks_last_window": top,
@@ -296,6 +307,62 @@ async def run_multiloop_ab(seconds: float = 2.0, concurrency: int = 32,
     }
 
 
+async def run_egress_shards_ab(seconds: float = 2.0,
+                               concurrency: int = 32, shards: int = 2,
+                               n_clients: int = 2) -> dict:
+    """Sharded-egress A/B (the ISSUE 15 acceptance point): identical
+    mixed TCP traffic against two multi-loop silos differing ONLY in
+    ``egress_shards`` — 0 keeps every response encode + sender write on
+    the main loop, N hands shard-owned routes' flush groups across SPSC
+    egress rings so encode + writev run on the shard loops. The
+    structural signal is the main loop's "egress" occupancy share
+    (per-batch encode + transport write, labeled via the profiler's
+    egress category): acceptance is the sharded side's share falling to
+    <= 0.5x of the unsharded baseline. Both sides run
+    ``ingress_loops=shards`` so shard-owned client routes exist and the
+    ONLY delta is the egress lever; the end-to-end msgs/sec ratio is
+    reported but — as with the multi-loop A/B — only meaningful on a
+    genuinely multi-core runner (test_floor_sharded_egress gates it on
+    the same parallelism probe)."""
+    base = await run(seconds, concurrency, ingress_loops=shards,
+                     n_clients=n_clients, egress_shards=0)
+    sharded = await run(seconds, concurrency, ingress_loops=shards,
+                        n_clients=n_clients, egress_shards=shards)
+
+    def rate(r):
+        return r["extra"]["calls_per_sec"]
+
+    def eg(r):
+        return r["extra"]["egress_share"]
+
+    ratio = rate(sharded) / rate(base) if rate(base) else 0.0
+    return {
+        "metric": "sharded_egress_speedup",
+        "value": round(ratio, 3),
+        "unit": f"x (egress_shards={shards} vs 0, same traffic)",
+        "vs_baseline": None,
+        "extra": {
+            "seconds": seconds, "concurrency": concurrency,
+            "shards": shards, "n_clients": n_clients,
+            "unsharded": {"calls_per_sec": rate(base),
+                          "egress_share": eg(base),
+                          "egress_seconds":
+                              base["extra"]["egress_seconds"],
+                          "shares": base["extra"]["shares"]},
+            "sharded": {"calls_per_sec": rate(sharded),
+                        "egress_share": eg(sharded),
+                        "egress_seconds":
+                            sharded["extra"]["egress_seconds"],
+                        "shares": sharded["extra"]["shares"]},
+            # the structural signal: main-loop egress (encode + write)
+            # share sheds onto the shard loops regardless of end-to-end
+            # noise (the ISSUE 15 acceptance read)
+            "main_loop_egress_share_ratio": round(
+                eg(sharded) / eg(base), 3) if eg(base) else 0.0,
+        },
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seconds", type=float, default=3.0)
@@ -306,14 +373,23 @@ def main() -> None:
                     help="vector senders use client-side call_batch")
     ap.add_argument("--ingress-loops", type=int, default=1,
                     help="multi-loop silo: N ingress pump threads")
+    ap.add_argument("--egress-shards", type=int, default=0,
+                    help="sharded egress: N egress shard loops")
     ap.add_argument("--clients", type=int, default=1,
                     help="gateway connections feeding the silo")
     ap.add_argument("--ab", action="store_true",
                     help="run the inline/offloop/call_batch A/B sweep")
     ap.add_argument("--multiloop-ab", action="store_true",
                     help="run the 1-vs-2 ingress-loop A/B (ISSUE 11)")
+    ap.add_argument("--egress-shards-ab", action="store_true",
+                    help="run the egress_shards 0-vs-N A/B (ISSUE 15)")
     a = ap.parse_args()
-    if a.multiloop_ab:
+    if a.egress_shards_ab:
+        print(json.dumps(asyncio.run(run_egress_shards_ab(
+            a.seconds, a.concurrency,
+            shards=a.egress_shards if a.egress_shards > 1 else 2,
+            n_clients=a.clients if a.clients > 1 else 2))))
+    elif a.multiloop_ab:
         print(json.dumps(asyncio.run(run_multiloop_ab(
             a.seconds, a.concurrency,
             loops=a.ingress_loops if a.ingress_loops > 1 else 2,
@@ -324,7 +400,7 @@ def main() -> None:
         print(json.dumps(asyncio.run(run(
             a.seconds, a.concurrency, offloop=not a.inline_tick,
             call_batch=a.call_batch, ingress_loops=a.ingress_loops,
-            n_clients=a.clients))))
+            egress_shards=a.egress_shards, n_clients=a.clients))))
 
 
 if __name__ == "__main__":

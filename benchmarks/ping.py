@@ -59,10 +59,28 @@ async def bench_host_tier(n_grains: int, concurrency: int,
 
     # settled-heap start for every A/B pair built on this harness (the
     # bench_profiling_overhead discipline, hoisted): in a long-lived CI
-    # process (~600 tests of heap by floor time) a gen-2 collection
+    # process (~700 tests of heap by floor time) a gen-2 collection
     # landing inside ONE side's timed window skews the pair's ratio by
-    # 15-30% — far more than any tax the floors guard
+    # 15-30% — far more than any tax the floors guard. collect + FREEZE
+    # (the run_egress_ab discipline, hoisted for the same reason): the
+    # bench allocates hard enough that a gen-2 collection can TRIGGER
+    # inside the timed window regardless of phase, and which side draws
+    # it shifts with every suite-size change — freezing parks the
+    # pre-existing heap in the permanent generation so in-measure
+    # collections scan only this bench's young objects.
     gc.collect()
+    gc.freeze()
+    try:
+        return await _bench_host_tier_frozen(
+            n_grains, concurrency, seconds, trace_sample, hot_lane,
+            tail, metrics, profiling, slo)
+    finally:
+        gc.unfreeze()
+
+
+async def _bench_host_tier_frozen(n_grains, concurrency, seconds,
+                                  trace_sample, hot_lane, tail, metrics,
+                                  profiling, slo) -> dict:
     b = (SiloBuilder().with_name("ping-silo").add_grains(EchoGrain)
          .with_config(hot_lane_enabled=hot_lane))
     if trace_sample is not None:

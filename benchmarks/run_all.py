@@ -90,6 +90,13 @@ def main() -> None:
     # gated in test_floor_multiloop by a parallelism probe)
     print(json.dumps(asyncio.run(loop_attribution.run_multiloop_ab(
         seconds=2.0, concurrency=32))))
+    # sharded egress A/B (ISSUE 15): egress_shards 0 vs 2 on identical
+    # mixed TCP traffic over 2-ingress-loop silos — the main loop's
+    # "egress" occupancy share (response encode + sender/route writes)
+    # sheds onto the shard loops (structural signal, acceptance <=0.5x;
+    # measured ~0.0-0.1x); msgs/sec ratio probe-gated like multiloop
+    print(json.dumps(asyncio.run(loop_attribution.run_egress_shards_ab(
+        seconds=2.0, concurrency=32))))
     # deliberate client-side batching vs per-message senders, vector-only
     # (isolates the sender-side win from the mixed harness's host/vec
     # mix shift; measured ~1.5-1.8x, CI floor 1.2x)

@@ -70,6 +70,14 @@ class MessagingOptions:
     # ingress + SPSC hand-off rings, PING/SYSTEM bypassing the rings);
     # 1 (default) keeps the single-loop in-loop pump bit for bit
     ingress_loops: int = 1
+    # sharded egress (runtime.multiloop.EgressShardPool): N >= 1 moves
+    # silo-peer senders and shard-owned client-route response encode +
+    # writev onto shard loops fed by SPSC egress rings (borrowing the
+    # ingress shards when ingress_loops >= 2 — link-ownership
+    # affinity — else dedicated egress loop threads); PING/SYSTEM
+    # bypasses the rings per-message. 0 (default) keeps every sender
+    # and encode on the main loop bit for bit — the A/B lever
+    egress_shards: int = 0
     # batched response egress (runtime.egress flush accumulator +
     # header-prefix wire template): ``batched_egress=False`` restores
     # the per-message send_response → transmit path — the A/B lever
@@ -92,6 +100,12 @@ class MessagingOptions:
             raise ConfigurationError(
                 f"ingress_loops must be an int in [1, 64], got "
                 f"{self.ingress_loops!r}")
+        if not isinstance(self.egress_shards, int) or \
+                isinstance(self.egress_shards, bool) or \
+                not (0 <= self.egress_shards <= 64):
+            raise ConfigurationError(
+                f"egress_shards must be an int in [0, 64], got "
+                f"{self.egress_shards!r}")
 
 
 @dataclass
@@ -414,6 +428,7 @@ _FLAT_MAP = {
                                     "max_request_processing_time"),
     "batched_ingress": (MessagingOptions, "batched_ingress"),
     "ingress_loops": (MessagingOptions, "ingress_loops"),
+    "egress_shards": (MessagingOptions, "egress_shards"),
     "batched_egress": (MessagingOptions, "batched_egress"),
     "offloop_tick": (MessagingOptions, "offloop_tick"),
     "turn_warning_length": (SchedulingOptions, "turn_warning_length"),

@@ -170,7 +170,19 @@ class Message:
 # re-run on the recycled shell — every field overwritten, so no state leaks
 # between uses). ``recycle_message`` is called ONLY where the envelope's
 # lifecycle provably ends (RuntimeClient.receive_response, after the caller's
-# future resolves): callers guarantee no live reference remains.
+# future resolves; egress shards, after an outbound response's bytes are
+# produced): callers guarantee no live reference remains.
+#
+# Thread-safety contract (sharded egress releases from shard threads):
+# RELEASE is safe from any thread — ``list.append``/``list.pop`` are
+# GIL-atomic, the releasing thread is by contract the shell's LAST
+# holder (so the per-shell field clears race nothing), and the capacity
+# check is per-append (``len < cap`` then append can interleave across
+# threads, overfilling by at most one shell per concurrent releaser —
+# bounded and benign, the cap is a memory bound not an invariant).
+# ACQUIRE (``_fresh_message``) stays effectively loop-side today but is
+# pop-defensive so a concurrent release/acquire interleaving can never
+# raise.
 # ---------------------------------------------------------------------------
 
 _MSG_POOL: list["Message"] = []
@@ -229,10 +241,14 @@ def assert_generation(m: Message, gen: int, where: str) -> None:
 def _fresh_message(*fields) -> Message:
     pool = _MSG_POOL
     if pool:
-        m = pool.pop()
-        m._pool_free = False
-        m.__init__(*fields)
-        return m
+        try:
+            m = pool.pop()
+        except IndexError:  # raced a concurrent acquirer: allocate
+            m = None
+        if m is not None:
+            m._pool_free = False
+            m.__init__(*fields)
+            return m
     m = Message(*fields)
     m._pool_free = False
     m._pool_gen = 0
@@ -244,7 +260,10 @@ def recycle_message(m: Message) -> None:
     is a no-op via ``_pool_free`` — the STATIC double-release check is
     OTPU001's job); drops the shell when the pool is full. Reference-
     carrying fields are cleared so a pooled shell cannot pin user payloads
-    or context dicts alive."""
+    or context dicts alive. Callable from any thread (see the freelist
+    thread-safety contract above): the capacity check is per-append, so
+    concurrent releasers can overfill the pool by at most one shell
+    each — a memory bound, not an invariant."""
     if getattr(m, "_pool_free", False):
         return
     pool_full = len(_MSG_POOL) >= _MSG_POOL_CAP
@@ -272,16 +291,21 @@ def recycle_messages(msgs) -> None:
     envelopes a batched response correlation retires together
     (``RuntimeClient.receive_response_batch``: two envelopes per RPC at
     batch rate, where the per-call function overhead was the point of
-    batching). Semantics are identical per envelope: idempotent via
-    ``_pool_free``, reference-carrying fields cleared, debug-pool
-    generation stamped even when the full pool drops the shell."""
+    batching), and for the egress shards' encode-then-recycle sweep
+    (shard-thread callers — the capacity check below is per-append, not
+    a precomputed room count, so concurrent sweeps stay bounded; see
+    the freelist thread-safety contract above). Semantics are identical
+    per envelope: idempotent via ``_pool_free``, reference-carrying
+    fields cleared, debug-pool generation stamped even when the full
+    pool drops the shell."""
     pool = _MSG_POOL
     debug = _DEBUG_POOL
-    room = _MSG_POOL_CAP - len(pool)
+    cap = _MSG_POOL_CAP
     for m in msgs:
         if getattr(m, "_pool_free", False):
             continue
-        if room <= 0 and not debug:
+        room = len(pool) < cap
+        if not room and not debug:
             continue
         if debug:
             m._pool_gen = pool_generation(m) + 1
@@ -291,9 +315,8 @@ def recycle_messages(msgs) -> None:
         m.transaction_info = None
         m.cache_invalidation = None
         m.call_chain = ()
-        if room > 0:
+        if room:
             pool.append(m)
-            room -= 1
 
 
 def make_request(

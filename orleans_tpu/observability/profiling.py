@@ -103,6 +103,12 @@ LOOP_CATEGORIES = (
     "tick_transfer",  # host arrays -> device operands + kernel dispatch
     "tick_sync",      # host materialize: where device execution is paid
     "pump",           # socket pump + wire decode + batched routing
+    "egress",         # outbound wire: response/request encode + sender
+                      # writes (per-endpoint sender tasks, gateway
+                      # client-route batch writes) — the slice sharded
+                      # egress (SiloConfig.egress_shards) moves onto
+                      # shard loops; its main-loop share is the ISSUE-15
+                      # acceptance A/B
     "client",         # client-side gateway machinery sharing the loop
                       # (GatewayClient pumps/senders/reconnector) — split
                       # out of "other" so harness cost is separately

@@ -108,7 +108,13 @@ INGEST_STATS = {
 #            bursts, the batching-degree signal's latency face)
 #   encode   wire encode of one outbound batch (header-prefix template +
 #            pack_batch on the native build), observed per
-#            encode_message_batch call by metrics-enabled egress writers
+#            encode_message_batch call by metrics-enabled egress writers.
+#            Under sharded egress (SiloConfig.egress_shards) the encode
+#            runs on a shard loop: it is STAMPED shard-side and
+#            REPLAYED loop-side over the shard's stat ring (the PR-9/11
+#            loop-confinement rule) — same series, same semantics, and
+#            dwell then spans accumulator + egress ring + sender queue
+#            (the whole pre-encode wait, stamped at shard encode time)
 #
 #   group    flush-group size (COUNT_BOUNDS histogram — the egress twin
 #            of ingest frame_batch: responses per hand-off unit)
@@ -123,6 +129,11 @@ EGRESS_STATS = {
     "encode": "egress.encode.seconds",
     "group": "egress.flush_group.size",       # COUNT_BOUNDS histogram
     "responses": "egress.responses",          # counter: responses batched
+    # counter: messages dropped at a FULL egress shard ring (bounded
+    # backpressure toward a wedged peer — the only direction possible
+    # for a producer that cannot pause response generation; senders
+    # learn via response timeout exactly like a dead-peer send drop)
+    "ring_drops": "egress.ring_drops",
 }
 
 

@@ -61,7 +61,8 @@ class EgressBatcher:
     ``batched_egress`` is on; the dispatcher's ``send_response`` feeds
     it for every remote-bound response."""
 
-    __slots__ = ("center", "groups", "_armed", "stats", "last_group")
+    __slots__ = ("center", "groups", "_armed", "stats", "last_group",
+                 "_sharded_dest")
 
     def __init__(self, center):
         self.center = center
@@ -71,6 +72,13 @@ class EgressBatcher:
         # metrics_enabled, else None — add/flush pay one None check
         self.stats = center.silo.ingest_stats
         self.last_group = 0          # last flush-group size (sampler gauge)
+        # sharded egress (SocketFabric.sharded_dest): a destination
+        # whose encode runs on an egress shard keeps its dwell stamps
+        # through the hand-off — the SHARD observes dwell at encode
+        # time (accumulator + ring + sender-queue wait, replayed
+        # loop-side), strictly more truthful than flush-time here
+        self._sharded_dest = getattr(
+            getattr(center.silo, "fabric", None), "sharded_dest", None)
 
     def add(self, dest, msg) -> None:
         """Join ``msg`` to the pending group for ``dest`` and arm the
@@ -100,11 +108,14 @@ class EgressBatcher:
             self._armed = True
             loop.call_soon(self.flush)
 
-    def _observe_group(self, msgs: list) -> None:
+    def _observe_group(self, dest, msgs: list) -> None:
         """Shared per-group bookkeeping for both flush paths: group-size
         histogram, responses counter, and per-message dwell (observed and
         cleared BEFORE the hand-off — encode/transport time belongs to
-        the ``encode`` stage, not here)."""
+        the ``encode`` stage, not here). A sharded destination keeps its
+        dwell stamps: the egress shard observes them at encode time
+        (dwell then spans accumulator + ring + sender queue) and replays
+        loop-side."""
         st = self.stats
         n = len(msgs)
         self.last_group = n
@@ -112,6 +123,9 @@ class EgressBatcher:
             return
         st.histogram_with(_GROUP, COUNT_BOUNDS).observe(n)
         st.increment(_RESPONSES, n)
+        sd = self._sharded_dest
+        if sd is not None and sd(dest):
+            return  # dwell observed (and cleared) shard-side
         now = time.monotonic()
         for m in msgs:
             if m.received_at is not None:
@@ -138,8 +152,8 @@ class EgressBatcher:
         # stays non-overlapping (encode times itself in the wire layer,
         # transport write is not an egress stage)
         t0 = time.perf_counter()
-        for msgs in groups.values():
-            self._observe_group(msgs)
+        for dest, msgs in groups.items():
+            self._observe_group(dest, msgs)
         st.observe(_BUILD, time.perf_counter() - t0)
         for dest, msgs in groups.items():
             center.send_batch(dest, msgs)
@@ -150,5 +164,5 @@ class EgressBatcher:
         msgs = self.groups.pop(dest, None)
         if not msgs:
             return
-        self._observe_group(msgs)
+        self._observe_group(dest, msgs)
         self.center.send_batch(dest, msgs)

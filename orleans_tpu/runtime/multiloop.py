@@ -49,6 +49,65 @@ This module is the asyncio re-design of that split:
   ``hotwire.sock_writev`` (one writev per flush group, no ``b"".join``
   copy) with a buffered Python fallback.
 
+**Sharded egress** (``SiloConfig.egress_shards = N``, ISSUE 15) is the
+structural twin of the ingress split for the OUTBOUND half — the PR-11
+residue was that every ``encode_message_batch`` call and every
+per-endpoint sender write still ran on the main loop:
+
+* :class:`EgressShard` — the egress half of one shard loop: an SPSC
+  ring fed FROM the main loop (reverse direction of the ingress rings,
+  same coalesced-wakeup/single-writer-counter discipline), draining
+  into per-endpoint silo-peer senders and shard-bound client-route
+  writers that live ON the shard loop. Encode runs shard-side against
+  a per-shard bounded header-template cache (same key/cap as the
+  main-loop cache in ``wire.py``), writes ride ``sock_writev``, and
+  outbound RESPONSE envelopes are recycled shard-side in one sweep the
+  moment their bytes are produced (the freelist release is
+  thread-safe — see ``core.message``).
+* **Placement** mirrors link ownership (the Mapple mapping philosophy:
+  where work runs is a policy over ownership, not an accident of which
+  loop created the socket): a silo-peer sender colocates with the
+  ingress shard that owns the INBOUND half of the same peering (the
+  handshake records ``peer endpoint -> shard``); connect-side links
+  with no inbound half round-robin onto shards. With an ingress pool
+  the egress shards BORROW the first N ingress loops; without one
+  (``ingress_loops=1``) the pool spawns N dedicated egress loop
+  threads (client routes then keep the main-loop path — only
+  shard-owned routes move).
+* **QoS by construction**: PING/SYSTEM messages never enter an egress
+  ring (nor the flush accumulator — the PR-10 invariant): they hand
+  off per-message via ``call_soon_threadsafe`` straight to the shard's
+  sender, so a probe response can never sit behind ring backpressure
+  or be dropped by it — the exact mirror of the ingress bypass. Past
+  the hand-off it shares the sender's wire FIFO with application
+  traffic exactly like the classic path does, but the application
+  backlog ahead of it is bounded by the per-endpoint backpressure cap
+  below (the classic queue is unbounded — sharding makes the worst
+  case strictly tighter, not looser).
+* **Backpressure** is bounded in the only direction possible for a
+  producer that cannot pause response generation: when ring backlog
+  PLUS the destination endpoint's OWN sender-queue occupancy pass
+  capacity (a wedged peer blocks its sender mid-write and the queue
+  grows behind it), new application messages toward that endpoint DROP
+  (counted, ``egress.ring_drops``) — the same
+  learn-via-response-timeout semantics as a dead-peer send drop; the
+  bound is per-endpoint, so a wedged peer never drops traffic toward
+  healthy peers sharing its shard. QoS bypass traffic is never
+  dropped; client routes buffer in the shard-bound writer exactly like
+  the main-loop transport path does today.
+* **Stats discipline**: dwell/encode are STAMPED shard-side into plain
+  lists and REPLAYED loop-side over a per-shard stat ring (the
+  PR-9/PR-11 loop-confinement rule; the registries are loop-confined,
+  so OTPU007 stays clean with zero suppressions).
+* **Clean shutdown** mirrors the ingress rings: the pool closes (new
+  sends fall back to the classic main-loop path), each shard drains
+  its ring inline on its own loop, senders flush their queues
+  best-effort, then standalone threads join — pushed == drained.
+
+``egress_shards = 0`` (the default) constructs NONE of this: senders,
+encode, and client-route writes stay on the main loop bit for bit (the
+A/B lever, symmetric with ``batched_egress``/``ingress_loops``).
+
 ``SiloConfig.ingress_loops = 1`` (the default) constructs NONE of this:
 the silo keeps today's in-loop ``asyncio.start_server`` pump bit for
 bit. ``ingress_loops = N >= 2`` spawns N shard threads. In-process
@@ -65,6 +124,7 @@ and on free-threaded builds the same structure scales further.
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import logging
 import threading
 import time
@@ -72,8 +132,9 @@ from collections import deque
 from typing import TYPE_CHECKING, Any
 
 from ..core import serialization as _ser
-from ..core.message import Category, Message
-from ..observability.stats import COUNT_BOUNDS, INGEST_STATS, SIZE_BOUNDS
+from ..core.message import Category, Direction, Message, recycle_messages
+from ..observability.stats import (COUNT_BOUNDS, EGRESS_STATS, INGEST_STATS,
+                                   SIZE_BOUNDS)
 from .wire import (
     _LEN,
     MAX_FRAME_SEGMENT,
@@ -81,8 +142,10 @@ from .wire import (
     decode_frames,
     decode_handshake,
     encode_handshake,
+    encode_message_batch,
     finish_batch_entries,
     leads_hostile_frame,
+    writev_leftover,
 )
 
 if TYPE_CHECKING:
@@ -91,12 +154,18 @@ if TYPE_CHECKING:
 
 log = logging.getLogger("orleans.multiloop")
 
-__all__ = ["IngressLoopPool", "IngressShard", "SpscRing", "ShardWriter"]
+__all__ = ["IngressLoopPool", "IngressShard", "SpscRing", "ShardWriter",
+           "EgressShard", "EgressShardPool", "EgressLoopThread"]
 
 # ring capacity in MESSAGES before the shard pauses its socket reads
 # (kernel buffers then backpressure the peer); drained in one consumer
 # callback, so this bounds main-loop burst size too
 _RING_CAPACITY = 16384
+# egress ring capacity in MESSAGES before the main loop starts dropping
+# application traffic toward that shard (bounded backpressure — the
+# producer is response generation, which cannot pause; see module
+# docstring). QoS bypass traffic never counts against (or waits on) it.
+_EGRESS_RING_CAPACITY = 16384
 _READ_SIZE = 1 << 16
 # native vectored entry points (Linux/macOS builds; absent on Windows
 # or under ORLEANS_TPU_NATIVE=0 — the Python pump is the fallback)
@@ -113,13 +182,23 @@ class SpscRing:
     either lands in the current sweep or re-arms — never lost)."""
 
     __slots__ = ("_items", "_consumer_loop", "_drain_cb", "_armed",
-                 "pushed_msgs", "drained_msgs", "drained_batches")
+                 "_context", "pushed_msgs", "drained_msgs",
+                 "drained_batches")
 
-    def __init__(self, consumer_loop, drain_cb):
+    def __init__(self, consumer_loop, drain_cb, context=None):
         self._items: deque = deque()
         self._consumer_loop = consumer_loop
         self._drain_cb = drain_cb
         self._armed = False
+        # optional contextvars.Context for the drain callback: asyncio
+        # copies the PUSHING thread's context into the Handle, so a
+        # ring whose producer runs under an unrelated LOOP_CATEGORY
+        # (the egress rings: main loop pushes, shard drains) passes a
+        # pre-built context here to keep the consumer-side profiler
+        # attribution honest (the profiling pump_ctx idiom). The
+        # ingress rings pass none — their shard-thread producer already
+        # runs marked "pump", which is exactly the right label.
+        self._context = context
         # backlog = pushed - drained: each counter has exactly ONE
         # writer (producer / consumer), so no read-modify-write ever
         # races; the other side only reads (torn-free under the GIL)
@@ -133,7 +212,11 @@ class SpscRing:
         self.pushed_msgs += n_msgs
         if not self._armed:
             self._armed = True
-            self._consumer_loop.call_soon_threadsafe(self._drain)
+            if self._context is not None:
+                self._consumer_loop.call_soon_threadsafe(
+                    self._drain, context=self._context)
+            else:
+                self._consumer_loop.call_soon_threadsafe(self._drain)
 
     def _drain(self) -> None:
         """Consumer side (main loop only)."""
@@ -156,6 +239,24 @@ class SpscRing:
         whatever the armed callback never got to runs inline so no
         decoded message is dropped — the clean-shutdown drain."""
         self._drain()
+
+    def discard(self, on_item) -> None:
+        """Teardown sweep for a DEAD consumer loop: pop every item
+        under the normal counter discipline (pushed == drained still
+        holds) but hand it to ``on_item`` instead of the drain
+        callback, which must not run in the caller's context."""
+        items = self._items
+        while True:
+            try:
+                item = items.popleft()
+            except IndexError:
+                return
+            self.drained_msgs += item[0]
+            self.drained_batches += 1
+            try:
+                on_item(item)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                log.exception("ring discard failed")
 
     def backlog(self) -> int:
         return self.pushed_msgs - self.drained_msgs
@@ -184,10 +285,13 @@ async def _read_handshake_frame(loop, sock) -> tuple[bytes, bytes]:
 
 class ShardWriter:
     """Writer for the client route of a shard-owned connection, bound
-    to the silo's MAIN loop over a dup'd fd: the shard thread owns the
-    READ half of the socket; responses are encoded AND written on the
-    main loop (where the fabric's client-route paths already run), so
-    the response path pays ZERO cross-thread hand-offs. The dup keeps
+    to ONE loop over a dup'd fd: the silo's MAIN loop by default (the
+    shard thread owns the READ half; responses encode AND write where
+    the fabric's client-route paths already run, so the response path
+    pays ZERO cross-thread hand-offs), or — under sharded egress — the
+    connection's OWN shard loop (``egress_shard`` set; the fabric then
+    hands whole Message flush groups across the egress ring and the
+    shard encodes + writes them here). The dup keeps
     the write fd safe against kernel fd-number reuse after the shard
     closes its half; writes to a peer-closed socket surface as EPIPE
     and drop the route exactly like the StreamWriter path. Egress is
@@ -197,10 +301,14 @@ class ShardWriter:
     (``write``/``close``/``is_closing``)."""
 
     __slots__ = ("_loop", "_sock", "_chunks", "_sending", "_task",
-                 "_closed", "on_error")
+                 "_closed", "on_error", "egress_shard")
 
     def __init__(self, main_loop, sock):
         self._loop = main_loop
+        # set by the shard handler when sharded egress owns this route:
+        # the fabric then feeds Message lists to that shard's ring (and
+        # the writer binds to the SHARD loop instead of the main loop)
+        self.egress_shard = None
         # portable duplicate of the WRITE half: socket.dup() (not
         # os.dup on the raw fd — fds aren't WinSock handles on Windows)
         self._sock = sock.dup()
@@ -272,7 +380,7 @@ class ShardWriter:
                         sent = _HW.sock_writev(self._sock.fileno(), chunks)
                     except BlockingIOError:
                         sent = 0
-                    rest = _leftover(chunks, sent)
+                    rest = writev_leftover(chunks, sent)
                     if rest:
                         await loop.sock_sendall(self._sock, rest)
                 else:
@@ -285,20 +393,6 @@ class ShardWriter:
                 hook()
         finally:
             self._sending = False
-
-
-def _leftover(chunks: list, sent: int) -> bytes:
-    """The unsent suffix of a chunk list after a (possibly partial)
-    vectored write."""
-    total = 0
-    for i, c in enumerate(chunks):
-        nxt = total + len(c)
-        if sent < nxt:
-            rest = [c[sent - total:]]
-            rest.extend(chunks[i + 1:])
-            return b"".join(rest)
-        total = nxt
-    return b""
 
 
 class IngressShard(threading.Thread):
@@ -429,10 +523,44 @@ class IngressShard(threading.Thread):
                 # route) before it. One confirmation round trip per
                 # connection setup buys the ordering for every delivery
                 # path — ring, QoS-direct, and bounce alike.
-                writer = ShardWriter(self.main_loop, sock)
+                #
+                # Sharded egress: when the egress pool rides the ingress
+                # shards and covers this one, the write half binds to
+                # THIS shard's loop instead — the fabric then hands
+                # whole Message flush groups across the shard's egress
+                # ring (one coalesced hop per group) and encode + writev
+                # run here, off the main loop.
+                eshard = None
+                epool = getattr(fabric, "egress_pool", None)
+                if epool is not None and epool.on_ingress and \
+                        not epool.closed and \
+                        self.index < len(epool.shards) and \
+                        epool.shards[self.index].loop is self.loop:
+                    # loop identity, not index alone: the fabric-wide
+                    # pool borrows the FIRST registered silo's ingress
+                    # loops — a co-hosted silo's shard at the same index
+                    # runs on a different thread, and binding its writer
+                    # there would make write_many a cross-thread call
+                    eshard = epool.shards[self.index]
+                writer = ShardWriter(
+                    self.loop if eshard is not None else self.main_loop,
+                    sock)
+                writer.egress_shard = eshard
 
-                def _on_err(w=writer, f=fabric, a=peer_addr):
-                    f._drop_client_route(a)
+                def _on_err(w=writer, f=fabric, a=peer_addr,
+                            ml=self.main_loop):
+                    # route-dict mutation MARSHALS to the main loop with
+                    # the is-ours identity check (same rule as _cleanup
+                    # below): under sharded egress this hook fires on
+                    # the SHARD loop, and a reconnected client may have
+                    # registered a NEW route meanwhile
+                    def _drop():
+                        if f.client_routes.get(a) is w:
+                            f._drop_client_route(a)
+                    try:
+                        ml.call_soon_threadsafe(_drop)
+                    except RuntimeError:
+                        pass  # main loop gone: process teardown
                     w._do_close()
 
                 writer.on_error = _on_err
@@ -453,6 +581,17 @@ class IngressShard(threading.Thread):
 
                 self.main_loop.call_soon_threadsafe(_register)
                 await registered
+            else:
+                # silo peer: record which shard owns the inbound half of
+                # this peering so the egress pool colocates the OUTBOUND
+                # sender with it (link-ownership affinity; marshalled —
+                # the map is main-loop state like the route tables)
+                try:
+                    self.main_loop.call_soon_threadsafe(
+                        fabric._record_peer_shard, peer_addr.endpoint,
+                        self.index)
+                except RuntimeError:
+                    pass  # main loop gone: process teardown
             await self._pump(fabric, silo, sock, bytearray(tail))
         except (ConnectionError, asyncio.IncompleteReadError):
             pass  # clean EOF / peer died
@@ -478,6 +617,13 @@ class IngressShard(threading.Thread):
 
                 try:
                     self.main_loop.call_soon_threadsafe(_cleanup)
+                except RuntimeError:
+                    pass  # main loop gone: process teardown
+            elif not is_client and peer_addr is not None:
+                try:
+                    self.main_loop.call_soon_threadsafe(
+                        fabric._forget_peer_shard, peer_addr.endpoint,
+                        self.index)
                 except RuntimeError:
                     pass  # main loop gone: process teardown
             if writer is not None:
@@ -783,3 +929,424 @@ class IngressLoopPool:
             prof["ring_batches"] = s.batches
             out.append(prof)
         return out
+
+
+# ---------------------------------------------------------------------------
+# Sharded egress (ISSUE 15): the outbound twin of the ingress shards
+# ---------------------------------------------------------------------------
+
+class EgressLoopThread(threading.Thread):
+    """A dedicated egress shard loop for silos WITHOUT an ingress pool
+    (``egress_shards > 0`` with ``ingress_loops = 1``): thread + event
+    loop + optional per-loop profiler, nothing else — the pump half of
+    :class:`IngressShard` never exists here. With an ingress pool the
+    egress shards borrow its loops instead (link-ownership affinity)."""
+
+    def __init__(self, name: str, profiling_cfg=None):
+        super().__init__(name=name, daemon=True)
+        self.loop = asyncio.new_event_loop()
+        self.profiler = None
+        self._profiling_cfg = profiling_cfg
+        self._ready = threading.Event()
+
+    def run(self) -> None:
+        asyncio.set_event_loop(self.loop)
+        cfg = self._profiling_cfg
+        if cfg is not None:
+            try:  # best-effort, like the ingress shards
+                from ..observability.profiling import (
+                    install_loop_profiler, mark_loop_category)
+                self.profiler = install_loop_profiler(
+                    self.loop, window=cfg.profiling_window,
+                    ring=cfg.profiling_ring, top_k=cfg.profiling_top_k,
+                    trigger_interval=cfg.profiling_trigger_interval)
+                mark_loop_category("egress")
+            except Exception:  # noqa: BLE001
+                log.exception("egress-loop profiler install failed; "
+                              "shard runs unprofiled")
+        self._ready.set()
+        try:
+            self.loop.run_forever()
+        finally:
+            self.loop.close()
+
+    def stop(self) -> None:
+        try:
+            self.loop.call_soon_threadsafe(self.loop.stop)
+        except RuntimeError:
+            pass
+
+
+# egress ring entry kinds (item[0] is the message count the SpscRing
+# counters track; QoS bypass traffic never rides the ring)
+_EG_PEER = 0     # (n, _EG_PEER, endpoint, Message | [Message])
+_EG_CLIENT = 1   # (n, _EG_CLIENT, (addr, writer, native), [Message])
+
+_EGRESS_ENCODE_STAT = EGRESS_STATS["encode"]
+_EGRESS_DWELL_STAT = EGRESS_STATS["dwell"]
+
+
+class EgressShard:
+    """The egress half of ONE shard loop: an SPSC ring fed from the main
+    loop draining into per-endpoint silo-peer senders and shard-bound
+    client-route writers that live on this loop; shard-side
+    ``encode_message_batch`` against a per-shard template cache;
+    encode-then-recycle for outbound responses; dwell/encode stamped
+    here and replayed loop-side over ``stat_ring`` (the loop-confinement
+    rule). Feed methods (``feed_*``/``*_direct``) run on the MAIN loop
+    only (single producer); ``_drain``/``_*_now`` run on the shard loop
+    only (single consumer)."""
+
+    def __init__(self, pool: "EgressShardPool", index: int, loop):
+        self.pool = pool
+        self.fabric = pool.fabric
+        self.index = index
+        self.loop = loop
+        self.main_loop = pool.main_loop
+        # drain in a pre-built "egress" context: the PRODUCER is the
+        # main loop (running under "turns"/whatever category scheduled
+        # the flush) and call_soon_threadsafe would copy that context
+        # into the shard-side drain — mislabeling the moved encode +
+        # write work on the shard's own profiler (the ingress rings
+        # don't need this: their shard-thread producer is marked
+        # "pump", the right label for main-loop routing)
+        from ..observability.profiling import LOOP_CATEGORY
+        ctx = contextvars.Context()
+        ctx.run(LOOP_CATEGORY.set, "egress")
+        self._egress_ctx = ctx
+        self.ring = SpscRing(loop, self._drain, context=ctx)
+        # shard -> main-loop stat replay (consumer = MAIN loop): entries
+        # are (0, [(series_name, value), ...]) observe stamps — replayed
+        # under "observability", the registry-work label, not whatever
+        # category the shard thread happened to be in at push time
+        obs_ctx = contextvars.Context()
+        obs_ctx.run(LOOP_CATEGORY.set, "observability")
+        self.stat_ring = SpscRing(pool.main_loop, pool._apply_stats,
+                                  context=obs_ctx)
+        # per-shard bounded header-template cache (same key/cap rules as
+        # wire.py's main-loop cache — wire._frame_template enforces them)
+        self.tmpl_cache: dict = {}
+        self._senders: dict = {}   # endpoint -> _Sender (shard-confined)
+        # counters: single-writer discipline like the ingress shards —
+        # qos_direct/encoded/recycled written by the shard thread only,
+        # drops by the main loop only
+        self.qos_direct = 0
+        self.encoded = 0      # wire batches encoded shard-side
+        self.recycled = 0     # response envelopes recycled shard-side
+        self.drops = 0        # ring-full drops (main-loop writer)
+        # application messages sitting in shard SENDER queues, PER
+        # endpoint (shard thread is the only writer: _drain increments,
+        # the sender's batch pop decrements, _close_endpoint drops the
+        # key). feed_peer bounds on ring backlog + the ENDPOINT's own
+        # entry — without it the ring drains instantly into the
+        # unbounded sender queue and the advertised wedged-peer
+        # backpressure would never engage (only a stalled shard loop
+        # would); per-endpoint, not shard-wide, so one wedged peer's
+        # backlog never drops traffic toward healthy peers sharing the
+        # shard (the classic path isolates per-endpoint too)
+        self.pending: dict = {}
+
+    # -- main-loop (producer) side ---------------------------------------
+    def feed_peer(self, endpoint: str, payload, n: int) -> bool:
+        """One application message or one flush group toward a silo
+        peer. False = backlog over capacity, payload dropped (bounded
+        backpressure; the caller counts/recycles). The bound covers the
+        ring AND this ENDPOINT's shard sender queue (``pending``): a
+        wedged peer blocks its sender in ``drain()`` while the queue
+        behind it grows — that queue, not the instantly-drained ring,
+        is where a peer stall accumulates, and it is per-endpoint so a
+        wedged peer never drops traffic toward its shard-mates."""
+        if self.ring.backlog() + self.pending.get(endpoint, 0) > \
+                _EGRESS_RING_CAPACITY:
+            self.drops += n
+            return False
+        self.ring.push((n, _EG_PEER, endpoint, payload), n)
+        return True
+
+    def feed_client(self, addr, writer, native: bool, msgs: list) -> None:
+        """One response flush group toward a shard-owned client route
+        (the Message list crosses the ring; encode happens shard-side).
+        Never drops: client responses buffer — in the ring, then the
+        shard-bound writer — exactly like the classic path buffers them
+        in the transport (the module contract); the peer-side drop
+        policy exists for senders whose backlog a wedged PEER grows,
+        which a client route, drained by its own shard loop, cannot."""
+        n = len(msgs)
+        self.ring.push((n, _EG_CLIENT, (addr, writer, native), msgs), n)
+
+    def peer_direct(self, endpoint: str, msg) -> None:
+        """QoS bypass (PING/SYSTEM): per-message hand-off straight to
+        the shard sender's queue — never through the ring, so a probe
+        response cannot sit behind ring backpressure or be dropped by
+        the bounded-backpressure check (the egress mirror of the
+        ingress QoS split). It shares the sender's wire FIFO from
+        there, like the classic path — with the application backlog
+        ahead of it capped by the per-endpoint ``feed_peer`` bound."""
+        self.loop.call_soon_threadsafe(self._peer_now, endpoint, msg,
+                                       context=self._egress_ctx)
+
+    def client_direct(self, addr, writer, native: bool, msg) -> None:
+        """QoS bypass for a shard-owned client route: per-message
+        encode + write marshalled to the shard, ring-free."""
+        self.loop.call_soon_threadsafe(self._client_now, addr, writer,
+                                       native, msg,
+                                       context=self._egress_ctx)
+
+    # -- shard-loop (consumer) side --------------------------------------
+    def _sender(self, endpoint: str):
+        s = self._senders.get(endpoint)
+        if s is None:
+            from .socket_fabric import _Sender
+            s = self._senders[endpoint] = _Sender(self.fabric, endpoint,
+                                                  shard=self)
+        return s
+
+    def _peer_now(self, endpoint: str, msg) -> None:
+        self.qos_direct += 1
+        self._sender(endpoint).queue.put_nowait(msg)
+
+    def _drain(self, item) -> None:
+        kind = item[1]
+        if kind == _EG_PEER:
+            ep = item[2]
+            q = self._sender(ep).queue
+            payload = item[3]
+            self.pending[ep] = self.pending.get(ep, 0) + item[0]
+            if type(payload) is list:
+                for m in payload:
+                    q.put_nowait(m)
+            else:
+                q.put_nowait(payload)
+        else:
+            addr, writer, native = item[2]
+            self._write_client(addr, writer, native, item[3])
+
+    def _client_now(self, addr, writer, native: bool, msg) -> None:
+        self._write_client(addr, writer, native, [msg])
+
+    def _write_client(self, addr, writer, native: bool,
+                      msgs: list) -> None:
+        """Shard-side client-route flush: dwell stamp → one
+        ``encode_message_batch`` against the per-shard template cache →
+        one ``write_many`` (→ ``sock_writev``) → one recycle sweep for
+        the now-dead outbound response envelopes. Registry writes are
+        forbidden here — stamps replay loop-side."""
+        stamps = self._dwell_stamps(msgs)
+        fabric = self.fabric
+        t0 = time.monotonic()
+        chunks = encode_message_batch(
+            msgs,
+            lambda m, e: fabric._client_encode_error(addr, writer, m, e,
+                                                     native),
+            native=native, stats=None, templates=fabric.response_templates,
+            tmpl_cache=self.tmpl_cache)
+        if chunks:
+            if stamps is not None:
+                stamps.append((_EGRESS_ENCODE_STAT,
+                               time.monotonic() - t0))
+            self.encoded += 1
+            try:
+                writer.write_many(chunks)
+            except Exception:  # noqa: BLE001 — client gone mid-write
+                log.info("dropping shard batch to disconnected client %s",
+                         addr)
+
+                def _drop(f=fabric, a=addr, w=writer):
+                    # is-ours identity check (same rule as _on_err): by
+                    # the time this runs on the main loop a reconnected
+                    # client may have registered a NEW route under addr
+                    if f.client_routes.get(a) is w:
+                        f._drop_client_route(a)
+                try:
+                    self.main_loop.call_soon_threadsafe(_drop)
+                except RuntimeError:
+                    pass
+        self._recycle_responses(msgs)
+        if stamps:
+            self.stat_ring.push((0, stamps), 0)
+
+    def _dwell_stamps(self, msgs: list):
+        """Dwell = accumulator add → shard encode (covers accumulator +
+        egress ring transit — strictly MORE truthful than the main-loop
+        flush-time observation it replaces for sharded destinations).
+        Returns a stamp list when metrics are on, else None; clears the
+        send-side ``received_at`` either way."""
+        if self.fabric.egress_stats is None:
+            for m in msgs:
+                m.received_at = None
+            return None
+        stamps: list = []
+        now = time.monotonic()
+        for m in msgs:
+            if m.received_at is not None:
+                stamps.append((_EGRESS_DWELL_STAT, now - m.received_at))
+                m.received_at = None
+        return stamps
+
+    def _recycle_responses(self, msgs: list) -> None:
+        """Encode-then-recycle: an outbound RESPONSE envelope is dead
+        the moment its bytes exist — nothing silo-side holds it past
+        the wire (requests stay out: the sender's callback machinery
+        owns them until correlation). One sweep per batch, shard-side
+        (the freelist release is thread-safe; see core.message)."""
+        dead = [m for m in msgs if m.direction is Direction.RESPONSE]
+        if dead:
+            recycle_messages(dead)
+            self.recycled += len(dead)
+
+    def _close_endpoint(self, endpoint: str) -> None:
+        s = self._senders.pop(endpoint, None)
+        if s is not None:
+            # the backpressure entry dies with the sender: whatever it
+            # never drained must not count against a re-dialed sender
+            # to the same endpoint (the in-flight batch's decrement
+            # no-ops on the missing key — see _Sender._run)
+            self.pending.pop(endpoint, None)
+            s.close()
+
+    def _discard_ring(self) -> None:
+        """Teardown fallback for a DEAD shard loop (callable from the
+        main loop): sweep the ring WITHOUT running ``_drain`` — peer
+        items would lazily build senders on the calling loop (dialing
+        peers mid-shutdown, their tasks registered nowhere) and client
+        items would ``create_task`` on the dead loop. Recycle the dead
+        response envelopes instead; pushed == drained still holds."""
+        def _recycle(item):
+            payload = item[3]
+            self._recycle_responses(
+                payload if type(payload) is list else [payload])
+        self.ring.discard(_recycle)
+
+    async def flush_and_close(self) -> None:
+        """Clean-shutdown drain, ON the shard loop: sweep the ring
+        (consumer side — pushed == drained afterwards, the producers
+        already stopped), let each sender flush its queue best-effort,
+        then close the links."""
+        self.ring.drain_now()
+        for s in list(self._senders.values()):
+            try:
+                await s.drain_idle(2.0)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+            s.close()
+        self._senders.clear()
+
+
+class EgressShardPool:
+    """N egress shards for one fabric + the link-affinity assigner.
+    Constructed by ``SocketFabric.register_silo`` when a local silo has
+    ``egress_shards >= 1``: borrows the first N ingress shard loops when
+    the silo runs multi-loop ingress (so a peer's outbound sender lives
+    with the shard that owns the inbound half of the peering), else
+    spawns N dedicated :class:`EgressLoopThread`\\ s. ``Silo.stop``
+    closes it BEFORE the ingress pool and the message center so every
+    accepted response still flushes — the clean-shutdown drain."""
+
+    def __init__(self, fabric, silo: "Silo", n: int, ingress_pool=None):
+        self.fabric = fabric
+        self.owner = silo
+        self.main_loop = asyncio.get_running_loop()
+        self.closed = False
+        self._rr = 0
+        self._assigned: dict = {}   # endpoint -> shard index (stable)
+        self._threads: list[EgressLoopThread] = []
+        if ingress_pool is not None:
+            self.on_ingress = True
+            loops = [s.loop for s in
+                     ingress_pool.shards[:max(1, min(n, len(
+                         ingress_pool.shards)))]]
+            if len(loops) < n:
+                log.warning(
+                    "egress_shards=%d capped at %d: egress shards "
+                    "borrow the ingress loops (ingress_loops=%d) — "
+                    "raise ingress_loops to get more egress shards",
+                    n, len(loops), len(ingress_pool.shards))
+        else:
+            self.on_ingress = False
+            cfg = silo.config
+            pcfg = cfg if cfg.profiling_enabled else None
+            self._threads = [
+                EgressLoopThread(f"{cfg.name}-egress-{i}", pcfg)
+                for i in range(n)]
+            for t in self._threads:
+                t.start()
+            for t in self._threads:
+                t._ready.wait(5.0)
+            loops = [t.loop for t in self._threads]
+        self.shards = [EgressShard(self, i, lp)
+                       for i, lp in enumerate(loops)]
+
+    # -- main-loop surface ----------------------------------------------
+    def shard_for(self, endpoint: str) -> EgressShard:
+        """Stable shard assignment for one peer endpoint: the ingress
+        shard owning the inbound half of the peering when known (the
+        handshake records it), else round-robin — and sticky either
+        way, so one endpoint's traffic keeps per-target FIFO."""
+        idx = self._assigned.get(endpoint)
+        if idx is None:
+            idx = None if not self.on_ingress else \
+                self.fabric._peer_shard.get(endpoint)
+            if idx is None or idx >= len(self.shards):
+                idx = self._rr
+                self._rr = (self._rr + 1) % len(self.shards)
+            self._assigned[endpoint] = idx
+        return self.shards[idx]
+
+    def _apply_stats(self, item) -> None:
+        """Stat-ring drain (MAIN loop — the only thread the registry
+        tolerates): replay the shard-stamped dwell/encode observations."""
+        est = self.fabric.egress_stats
+        if est is None:
+            return
+        for name, value in item[1]:
+            est.observe(name, value)
+
+    # -- lifecycle -------------------------------------------------------
+    async def aclose(self) -> None:
+        """Close + drain: new sends fall back to the classic main-loop
+        path the moment ``closed`` flips (checked by every feed), the
+        fabric detaches its shard sender handles, then each shard
+        flushes on its own loop (ring swept, sender queues drained
+        best-effort) and standalone threads join.
+
+        Teardown ordering caveat (deliberate): a send issued DURING the
+        bounded (5s) shard flush builds a fresh classic sender whose
+        write can overtake messages the shard sender still holds —
+        per-target FIFO is relaxed for that stop window only. The
+        alternative (route feeds through each shard sender until it
+        quiesces) cannot terminate under sustained load, which is
+        exactly when ``Silo.stop`` runs this drain; responses are
+        correlation-matched so the RPC layer is order-insensitive, and
+        the window is bounded by the flush timeout."""
+        if self.closed:
+            return
+        self.closed = True
+        self.fabric._detach_shard_senders()
+        loop = asyncio.get_running_loop()
+
+        async def _flush(shard) -> None:
+            alive = (self.on_ingress or
+                     self._threads[shard.index].is_alive())
+            if not alive:
+                # loop dead: recycle the ring's envelopes — running the
+                # drain here would build senders on THIS loop and write
+                # on the dead one (see _discard_ring)
+                shard._discard_ring()
+                return
+            try:
+                await asyncio.wait_for(
+                    asyncio.wrap_future(asyncio.run_coroutine_threadsafe(
+                        shard.flush_and_close(), shard.loop)), 5.0)
+            except Exception:  # noqa: BLE001 — wedged shard: say so
+                log.warning("egress shard %d did not flush within 5s",
+                            shard.index)
+
+        # concurrent: the flushes are independent (each on its own
+        # loop), so the whole drain is bounded by ONE flush timeout,
+        # not shards x timeout
+        await asyncio.gather(*(_flush(s) for s in self.shards))
+        for t in self._threads:
+            t.stop()
+        for t in self._threads:
+            if t.is_alive():
+                await loop.run_in_executor(None, t.join, 5.0)

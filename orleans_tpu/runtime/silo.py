@@ -127,6 +127,17 @@ class SiloConfig:
     # single-loop in-loop pump bit for bit; in-proc fabrics have no
     # sockets and ignore the knob.
     ingress_loops: int = 1
+    # sharded egress (runtime.multiloop.EgressShardPool, ISSUE 15): the
+    # outbound twin of ingress_loops. N >= 1 moves silo-peer senders
+    # (dial + encode + write) and shard-owned client-route response
+    # encode+writev onto shard loops, fed over SPSC egress rings from
+    # this loop — borrowing the ingress shard that owns the inbound
+    # half of the same peering when ingress_loops >= 2 (link-ownership
+    # affinity), else spawning N dedicated egress loop threads.
+    # PING/SYSTEM traffic bypasses the rings per-message (QoS).
+    # Default 0 = today's main-loop senders/encode bit for bit (the
+    # A/B lever); in-proc fabrics have no sockets and ignore the knob.
+    egress_shards: int = 0
     # batched egress (the response-path twin of batched_ingress):
     # responses resolved from one inbound batch group per origin in a
     # per-destination flush accumulator (runtime.egress.EgressBatcher)
@@ -1043,6 +1054,18 @@ class Silo:
         if self.metrics_server is not None:
             await self.metrics_server.aclose()
             self.metrics_server = None
+        egress_pool = getattr(self.fabric, "egress_pool", None)
+        if egress_pool is not None and not egress_pool.closed and \
+                (egress_pool.owner is self or len(self.fabric.silos) <= 1):
+            # sharded-egress shutdown — BEFORE the ingress pool (whose
+            # loops the egress shards may be borrowing) and the message
+            # center: new sends fall back to the main-loop path, each
+            # shard sweeps its ring and flushes its senders on its own
+            # loop, standalone threads join (the clean-shutdown drain;
+            # pushed == drained afterwards). Runs when the pool's owner
+            # silo stops or when we are the last local silo.
+            await egress_pool.aclose()
+            self.fabric.egress_pool = None
         if self.ingress_pool is not None:
             # multi-loop shutdown: stop accepts + pump threads (joined),
             # then drain every SPSC ring on this loop — BEFORE the
@@ -1057,7 +1080,8 @@ class Silo:
             # close so resolved ticks still reach their callers.
             self.vector.shutdown_worker()
         if self.loop_prof is not None:
-            from ..observability.profiling import uninstall_loop_profiler
+            from ..observability.profiling import (loop_profiler,
+                                                   uninstall_loop_profiler)
             if self._flight_hook is not None:
                 try:
                     self.loop_prof.trigger_hooks.remove(self._flight_hook)
@@ -1068,6 +1092,13 @@ class Silo:
             self.loop_prof = None
             self.dispatcher._loop_prof = None
             self.storage_manager.loop_prof = None
+            if hasattr(self.fabric, "loop_prof"):
+                # co-hosted silos share ONE refcounted profiler per
+                # loop: hand the fabric whatever is still installed
+                # (None after the LAST uninstall) instead of clearing a
+                # hook a surviving silo's egress attribution still needs
+                self.fabric.loop_prof = loop_profiler(
+                    asyncio.get_running_loop())
             if self.vector is not None:
                 self.vector.loop_prof = None
         self.message_center.stop()
@@ -1122,6 +1153,10 @@ class Silo:
         # cached refs so the hot paths pay one attribute load
         self.dispatcher._loop_prof = lp
         self.storage_manager.loop_prof = lp
+        if hasattr(self.fabric, "loop_prof"):
+            # socket fabric: the inline client-route encode+write books
+            # its slice under "egress" (the sharded-egress A/B signal)
+            self.fabric.loop_prof = lp
         if self.vector is not None:
             self.vector.loop_prof = lp
         for cat in LOOP_CATEGORIES:

@@ -39,10 +39,12 @@ import socket
 import time
 from typing import TYPE_CHECKING, Any
 
+from ..core import serialization as _ser
 from ..core.asyncs import ExponentialBackoff, retry
 from ..core.errors import SiloUnavailableError
 from ..core.ids import SiloAddress
-from ..core.message import Direction, Message
+from ..core.message import Category, Direction, Message, recycle_messages
+from ..observability.stats import EGRESS_STATS
 from .references import GrainFactory
 from .runtime_client import RuntimeClient
 from .wire import (
@@ -58,6 +60,7 @@ from .wire import (
     frame_stream,
     leads_hostile_frame,
     read_frame,
+    writev_leftover,
 )
 
 if TYPE_CHECKING:
@@ -72,6 +75,45 @@ _CONNECT_BACKOFF = 0.2
 # greedy sender batching: everything queued when the writer wakes rides
 # one socket write (bounded so one slow peer cannot hold a huge buffer)
 _SEND_BATCH_MAX = 256
+
+# native vectored egress (hotwire.sock_writev) for the StreamWriter-
+# backed sender drains — mirrors the multiloop pump's capability probe
+_HW = _ser._hotwire
+_HW_WRITEV = _HW is not None and hasattr(_HW, "sock_writev")
+
+_EG_ENCODE = EGRESS_STATS["encode"]
+_EG_RING_DROPS = EGRESS_STATS["ring_drops"]
+
+
+def _writev_stream(writer: asyncio.StreamWriter, chunks: list) -> None:
+    """Vectored drain for a StreamWriter-backed sender (the silo-peer
+    path previously joined + wrote through the transport; only the
+    ShardWriter and gateway client-route paths were vectored). When the
+    transport's buffer is empty — the steady state for a sender that
+    awaits ``drain()`` per batch — the chunk list rides ONE ``writev``
+    syscall on the raw socket, no ``b"".join`` copy; the unsent
+    remainder (kernel buffer full), transport-buffered states, and
+    non-native builds fall back to the buffered write. Ordering is
+    safe: the transport has nothing queued and this sender task is the
+    connection's only writer."""
+    if _HW_WRITEV:
+        transport = writer.transport
+        sock = writer.get_extra_info("socket")
+        if sock is not None and transport.get_write_buffer_size() == 0:
+            try:
+                sent = _HW.sock_writev(sock.fileno(), chunks)
+            except (BlockingIOError, InterruptedError):
+                sent = 0
+            except OSError:
+                # surface the failure through the transport so the
+                # sender's close/reconnect semantics stay identical
+                writer.write(b"".join(chunks))
+                return
+            rest = writev_leftover(chunks, sent)
+            if rest:
+                writer.write(rest)
+            return
+    writer.write(b"".join(chunks))
 
 
 def _drain_batch(queue: "asyncio.Queue[Message]", first: Message) -> list:
@@ -153,17 +195,34 @@ def _fresh_generation() -> int:
 
 class _Sender:
     """Per-endpoint outbound queue + writer task (the SiloMessageSender
-    analog — per-target FIFO, lazy dial, bounded reconnect)."""
+    analog — per-target FIFO, lazy dial, bounded reconnect). Runs on
+    whichever loop constructed it: the main loop (classic path), or an
+    egress shard's loop (``shard`` set — ``EgressShard._sender``
+    constructs it there; encode then uses the per-shard template cache,
+    stage timings are STAMPED and replayed loop-side, and outbound
+    response envelopes recycle shard-side after their bytes exist)."""
 
-    def __init__(self, fabric: "SocketFabric", endpoint: str):
+    def __init__(self, fabric: "SocketFabric", endpoint: str, shard=None):
         self.fabric = fabric
         self.endpoint = endpoint
+        self.shard = shard      # multiloop.EgressShard | None
         self.queue: asyncio.Queue[Message] = asyncio.Queue()
         self.task = asyncio.get_running_loop().create_task(self._run())
         self.writer: asyncio.StreamWriter | None = None
         # negotiated per-link codec: True only once the acceptor's
         # handshake reply advertises hotwire support
         self.peer_native = False
+        self._busy = False      # mid-batch flag (drain_idle)
+
+    # -- main-loop feed surface (classic senders; a shard-owned sender
+    # -- is fed by its shard instead) ------------------------------------
+    def feed(self, msg: Message) -> None:
+        self.queue.put_nowait(msg)
+
+    def feed_group(self, msgs: list) -> None:
+        q = self.queue
+        for m in msgs:
+            q.put_nowait(m)
 
     async def _connect(self) -> asyncio.StreamWriter:
         host, port = self.endpoint.rsplit(":", 1)
@@ -194,45 +253,201 @@ class _Sender:
                 f"cannot connect to {self.endpoint}: {e}") from e
 
     async def _run(self) -> None:
+        # loop attribution: everything this task does — wire encode and
+        # the transport write — is outbound work; "egress" is the slice
+        # the sharded-egress A/B moves off the main loop (a shard-owned
+        # sender books it on the shard loop's own profiler instead)
+        from ..observability.profiling import mark_loop_category
+        mark_loop_category("egress")
+        shard = self.shard
         while True:
             msg = await self.queue.get()
             batch = _drain_batch(self.queue, msg)
+            if shard is not None:
+                # backpressure accounting (EgressShard.pending, keyed
+                # by endpoint): these leave the sender queue NOW — at
+                # most one in-flight batch (<= _SEND_BATCH_MAX) goes
+                # uncounted while a wedged peer blocks the write below;
+                # the queue refilling behind it is what the feed bound
+                # reads. Missing key = _close_endpoint already
+                # reconciled this sender: no-op, a re-dialed sender's
+                # fresh entry must not go negative.
+                if self.endpoint in shard.pending:
+                    shard.pending[self.endpoint] -= sum(
+                        1 for m in batch
+                        if m.category is Category.APPLICATION)
             if self.fabric.is_endpoint_dead(self.endpoint):
-                continue  # dead-silo drop (MessageCenter SiloDeadOracle)
+                # dead-silo drop (MessageCenter SiloDeadOracle): the
+                # shard-owned batch's dead RESPONSE shells still go
+                # back to the pool — every drop path recycles (the
+                # ring-full path does via _egress_dropped)
+                if shard is not None:
+                    shard._recycle_responses(batch)
+                continue
+            self._busy = True
+            bounced: list = []
             try:
                 if self.writer is None or self.writer.is_closing():
                     self.writer = await self._connect()
                 # encode AFTER the (re)connect: peer_native is per-link.
-                # egress.encode is the RESPONSE-path stage: only batches
-                # carrying responses observe it (a pure request drain
-                # booking into it would inflate the response-path share
-                # the attribution harness reports; responses co-batched
-                # with requests share one write, so the whole encode is
-                # honestly theirs-or-shared)
-                est = self.fabric.egress_stats
-                if est is not None and not any(
-                        m.direction == Direction.RESPONSE for m in batch):
-                    est = None
-                chunks = encode_message_batch(
-                    batch, self.fabric.bounce_unencodable,
-                    native=self.peer_native, stats=est,
-                    templates=self.fabric.response_templates)
-                if not chunks:
-                    continue
-                self.writer.write(b"".join(chunks))
-                await self.writer.drain()
+                if shard is None:
+                    await self._send_batch_loopside(batch)
+                else:
+                    await self._send_batch_sharded(shard, batch, bounced)
             except (SiloUnavailableError, OSError, FrameError) as e:
                 log.warning("send to %s failed: %s", self.endpoint, e)
                 if self.writer is not None:
                     self.writer.close()
                     self.writer = None
-                # dropped: senders learn via response timeout / membership
+                # dropped: senders learn via response timeout /
+                # membership — the now-dead outbound responses of a
+                # shard-owned batch still recycle (finally below)
+            finally:
+                if shard is not None:
+                    # encode-then-recycle, every path: success, encode
+                    # bounce, and send failure all end these envelopes'
+                    # lifecycles (requests stay out — correlation owns
+                    # them sender-side). BOUNCED envelopes stay out
+                    # too: their bounce is marshalled to the main loop
+                    # and still in flight — recycling here would let
+                    # the pool re-issue the shell before the callback
+                    # reads it (identity filter: Message.__eq__ is
+                    # field-comparing).
+                    if bounced:
+                        skip = set(map(id, bounced))
+                        shard._recycle_responses(
+                            [m for m in batch if id(m) not in skip])
+                    else:
+                        shard._recycle_responses(batch)
+                self._busy = False
+
+    async def _send_batch_loopside(self, batch: list) -> None:
+        """The classic main-loop drain: encode against the shared
+        template cache, stats straight into the registry (we ARE the
+        loop), one vectored write."""
+        # egress.encode is the RESPONSE-path stage: only batches
+        # carrying responses observe it (a pure request drain booking
+        # into it would inflate the response-path share the attribution
+        # harness reports; responses co-batched with requests share one
+        # write, so the whole encode is honestly theirs-or-shared)
+        est = self.fabric.egress_stats
+        if est is not None and not any(
+                m.direction == Direction.RESPONSE for m in batch):
+            est = None
+        chunks = encode_message_batch(
+            batch, self.fabric.bounce_unencodable,
+            native=self.peer_native, stats=est,
+            templates=self.fabric.response_templates)
+        if not chunks:
+            return
+        _writev_stream(self.writer, chunks)
+        await self.writer.drain()
+
+    async def _send_batch_sharded(self, shard, batch: list,
+                                  bounced: list) -> None:
+        """The shard-loop drain: per-shard template cache, encode bounce
+        MARSHALLED to the main loop (``bounce_unencodable`` routes
+        through main-loop state; the bounced envelope joins ``bounced``
+        so the caller's recycle sweep leaves it for the in-flight
+        callback to own), dwell/encode STAMPED here and replayed
+        loop-side over the shard's stat ring — the registries are
+        loop-confined, so no live registry ever crosses into this
+        context (the OTPU007 contract)."""
+        fab = self.fabric
+        main = shard.main_loop
+
+        def _bounce(m, e):
+            bounced.append(m)
+            try:
+                main.call_soon_threadsafe(fab.bounce_unencodable, m, e)
+            except RuntimeError:
+                # main loop gone (process teardown): the bounce is
+                # moot, but raising here would escape _run's except
+                # tuple and kill the sender task
+                pass
+
+        stamps = shard._dwell_stamps(batch)
+        t0 = time.monotonic()
+        chunks = encode_message_batch(
+            batch, _bounce,
+            native=self.peer_native, stats=None,
+            templates=fab.response_templates,
+            tmpl_cache=shard.tmpl_cache)
+        if chunks and stamps is not None and any(
+                m.direction == Direction.RESPONSE for m in batch):
+            stamps.append((_EG_ENCODE, time.monotonic() - t0))
+        if stamps:
+            shard.stat_ring.push((0, stamps), 0)
+        if not chunks:
+            return
+        shard.encoded += 1
+        _writev_stream(self.writer, chunks)
+        await self.writer.drain()
+
+    async def drain_idle(self, timeout: float) -> None:
+        """Best-effort queue flush (clean-shutdown drain): wait until
+        the queue is empty and the writer task is parked back on
+        ``queue.get`` — bounded, a dead peer's reconnect backoff must
+        not hold shutdown hostage."""
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        while (self.queue.qsize() or self._busy) and \
+                loop.time() < deadline:
+            await asyncio.sleep(0.01)
 
     def close(self) -> None:
         self.task.cancel()
         if self.writer is not None:
             self.writer.close()
             self.writer = None
+
+
+class _ShardSenderHandle:
+    """Main-loop face of a shard-owned silo-peer sender (sharded
+    egress): application traffic — flush groups and per-message sends
+    alike — crosses the shard's SPSC egress ring (ring FIFO keeps
+    per-message sends ordered behind the groups ``flush_dest`` drained
+    first), while PING/SYSTEM bypasses the ring per-message so a probe
+    response can never sit behind ring backpressure (the QoS split).
+    The actual :class:`_Sender` (queue + dial + encode + writev) lives
+    on the shard loop — ``EgressShard._sender`` constructs it there."""
+
+    __slots__ = ("fabric", "shard", "endpoint")
+
+    def __init__(self, fabric: "SocketFabric", shard, endpoint: str):
+        self.fabric = fabric
+        self.shard = shard
+        self.endpoint = endpoint
+
+    def feed(self, msg: Message) -> None:
+        shard = self.shard
+        if shard.pool.closed:
+            self.fabric._classic_sender(self.endpoint).feed(msg)
+            return
+        # clear the local arrival stamp before the hand-off: on a
+        # relayed envelope it is INGRESS time — shard-side dwell must
+        # only ever see the egress accumulator's send-side stamps
+        # (feed_group), and the slot is wire-excluded dead weight here
+        msg.received_at = None
+        if msg.category is not Category.APPLICATION:
+            shard.peer_direct(self.endpoint, msg)
+        elif not shard.feed_peer(self.endpoint, msg, 1):
+            self.fabric._egress_dropped(shard, [msg])
+
+    def feed_group(self, msgs: list) -> None:
+        shard = self.shard
+        if shard.pool.closed:
+            self.fabric._classic_sender(self.endpoint).feed_group(msgs)
+            return
+        if not shard.feed_peer(self.endpoint, msgs, len(msgs)):
+            self.fabric._egress_dropped(shard, msgs)
+
+    def close(self) -> None:
+        try:
+            self.shard.loop.call_soon_threadsafe(
+                self.shard._close_endpoint, self.endpoint)
+        except RuntimeError:
+            pass  # shard loop gone: its senders died with it
 
 
 class _PoolAcceptor:
@@ -281,6 +496,18 @@ class SocketFabric:
         # restores the per-frame header encode (bytes are identical
         # either way — this only flips WHICH encoder produced them)
         self.response_templates = True
+        # sharded egress (runtime.multiloop.EgressShardPool): constructed
+        # by register_silo when a local silo has egress_shards >= 1;
+        # None = every sender/encode/write stays on the main loop
+        self.egress_pool = None
+        # peer endpoint -> ingress shard index owning the INBOUND half of
+        # that peering (recorded at the shard handshake, marshalled here:
+        # main-loop state) — the egress pool's link-affinity source
+        self._peer_shard: dict[str, int] = {}
+        # main-loop occupancy profiler (set by the silo when profiling is
+        # on): the inline client-route encode+write paths book their
+        # slice under "egress" so the sharded-egress A/B is measurable
+        self.loop_prof = None
 
     # -- address allocation ---------------------------------------------
     def allocate_address(self, name: str) -> SiloAddress:
@@ -326,6 +553,17 @@ class SocketFabric:
             silo.ingress_pool = IngressLoopPool(
                 silo, silo.config.ingress_loops)
             silo.ingress_pool.start()
+        if silo.config.egress_shards > 0 and self.egress_pool is None:
+            # sharded egress (runtime.multiloop): silo-peer senders and
+            # shard-owned client-route writes move onto shard loops, fed
+            # over SPSC egress rings from this loop. Borrows the ingress
+            # shards when the silo runs multi-loop (link-ownership
+            # affinity), else spawns dedicated egress loop threads.
+            # egress_shards=0 (default) constructs none of this.
+            from .multiloop import EgressShardPool
+            self.egress_pool = EgressShardPool(
+                self, silo, silo.config.egress_shards,
+                ingress_pool=silo.ingress_pool)
         loop = asyncio.get_running_loop()
         t = loop.create_task(self._serve(silo, sock))
         self._conn_tasks.add(t)
@@ -451,11 +689,87 @@ class SocketFabric:
             return
         if target in self.dead:
             return
-        sender = self._senders.get(target.endpoint)
+        self._sender_for(target.endpoint).feed(msg)
+
+    # -- outbound sender placement (sharded egress) -----------------------
+    def _sender_for(self, endpoint: str):
+        """The outbound sender (or shard handle) for one endpoint. With
+        an egress pool, new links go to the shard that owns the inbound
+        half of the peering (round-robin when connect-side only) and the
+        main loop keeps only the ring feed; without one, the classic
+        main-loop ``_Sender``."""
+        sender = self._senders.get(endpoint)
         if sender is None:
-            sender = self._senders[target.endpoint] = _Sender(
-                self, target.endpoint)
-        sender.queue.put_nowait(msg)
+            pool = self.egress_pool
+            if pool is not None and not pool.closed:
+                sender = _ShardSenderHandle(
+                    self, pool.shard_for(endpoint), endpoint)
+            else:
+                sender = _Sender(self, endpoint)
+            self._senders[endpoint] = sender
+        return sender
+
+    def _classic_sender(self, endpoint: str) -> _Sender:
+        """Force a main-loop ``_Sender`` for one endpoint (egress-pool
+        teardown: shard handles detach and late sends fall back here)."""
+        s = self._senders.get(endpoint)
+        if not isinstance(s, _Sender):
+            s = self._senders[endpoint] = _Sender(self, endpoint)
+        return s
+
+    def _detach_shard_senders(self) -> None:
+        """Egress-pool close: drop the shard handles so later sends
+        build classic senders (the shards flush what they already
+        hold — the clean-shutdown drain)."""
+        for ep, s in list(self._senders.items()):
+            if isinstance(s, _ShardSenderHandle):
+                del self._senders[ep]
+
+    def _record_peer_shard(self, endpoint: str, index: int) -> None:
+        self._peer_shard[endpoint] = index
+
+    def _forget_peer_shard(self, endpoint: str, index: int) -> None:
+        if self._peer_shard.get(endpoint) == index:
+            self._peer_shard.pop(endpoint, None)
+
+    def _egress_dropped(self, shard, msgs: list) -> None:
+        """Bounded backpressure hit: an egress ring past capacity
+        dropped application traffic toward a slow/wedged consumer.
+        Count it, say so once per shard, and recycle the now-dead
+        response envelopes (senders learn via response timeout — the
+        dead-peer drop semantics)."""
+        est = self.egress_stats
+        if est is not None:
+            est.increment(_EG_RING_DROPS, len(msgs))
+        if shard.drops == len(msgs):  # first drop on this shard
+            log.warning("egress ring full (shard %d): dropping "
+                        "application messages toward a slow consumer",
+                        shard.index)
+        dead = [m for m in msgs if m.direction == Direction.RESPONSE]
+        if dead:
+            recycle_messages(dead)
+
+    def sharded_dest(self, dest) -> bool:
+        """True when responses to ``dest`` will encode shard-side (the
+        egress batcher then leaves its dwell stamps for the shard to
+        observe — dwell spans accumulator + ring + sender queue).
+        Derived from the sender/route actually INSTALLED, not from
+        topology: a classic main-loop sender cached from before the
+        pool existed keeps observing dwell loop-side."""
+        pool = self.egress_pool
+        if pool is None or pool.closed or dest is None:
+            return False
+        if dest in self.silos:
+            return False  # in-proc loopback: never leaves the loop
+        w = self.client_routes.get(dest)
+        if w is not None:
+            return getattr(w, "egress_shard", None) is not None
+        if dest in self.dead:
+            return False  # send_batch drops these before any sender
+        s = self._senders.get(dest.endpoint)
+        if s is not None:
+            return isinstance(s, _ShardSenderHandle)
+        return True  # no sender yet: _sender_for builds a shard handle
 
     def _client_encode_error(self, addr: SiloAddress,
                              writer: asyncio.StreamWriter, msg: Message,
@@ -484,19 +798,56 @@ class SocketFabric:
         self._route_owner.pop(addr, None)
         self._client_native.pop(addr, None)
 
+    @staticmethod
+    def _marshal_client_write(writer, data: bytes) -> None:
+        """Egress-pool-teardown fallback for a shard-bound route: the
+        writer's ops are loop-bound, so bytes encoded here marshal to
+        its loop (a dead shard loop means the route is dying anyway)."""
+        try:
+            writer._loop.call_soon_threadsafe(writer.write, data)
+        except RuntimeError:
+            pass
+
     def _write_to_client(self, addr: SiloAddress,
                          writer: asyncio.StreamWriter, msg: Message) -> None:
+        es = getattr(writer, "egress_shard", None)
         native = self._client_native.get(addr, False)
-        try:
-            data = encode_message(msg, native=native)
-        except Exception as e:  # noqa: BLE001 — per-payload, not the route
-            self._client_encode_error(addr, writer, msg, e, native)
+        if es is not None:
+            # shard-owned route: encode + write happen on the shard.
+            # Clear the local arrival stamp first — on a forwarded
+            # envelope it is INGRESS time, not egress dwell (see
+            # _ShardSenderHandle.feed)
+            msg.received_at = None
+            if not es.pool.closed:
+                if msg.category is not Category.APPLICATION:
+                    es.client_direct(addr, writer, native, msg)
+                else:
+                    es.feed_client(addr, writer, native, [msg])
+                return
+            try:  # pool torn down, route still shard-bound: marshal
+                data = encode_message(msg, native=native)
+            except Exception as e:  # noqa: BLE001
+                log.warning("unencodable message to client %s during "
+                            "egress teardown: %s", addr, e)
+                return
+            self._marshal_client_write(writer, data)
             return
+        lp = self.loop_prof
+        tok = lp.enter("egress") if lp is not None else None
         try:
-            writer.write(data)
-        except Exception:  # noqa: BLE001 — client gone mid-write
-            log.info("dropping message to disconnected client %s", addr)
-            self._drop_client_route(addr)
+            try:
+                data = encode_message(msg, native=native)
+            except Exception as e:  # noqa: BLE001 — per-payload, not the route
+                self._client_encode_error(addr, writer, msg, e, native)
+                return
+            try:
+                writer.write(data)
+            except Exception:  # noqa: BLE001 — client gone mid-write
+                log.info("dropping message to disconnected client %s", addr)
+                self._drop_client_route(addr)
+        finally:
+            if tok is not None:
+                lp.exit(tok)
 
     def _write_client_batch(self, addr: SiloAddress,
                             writer: asyncio.StreamWriter,
@@ -506,27 +857,50 @@ class SocketFabric:
         for a whole response group — the per-message path encoded and
         wrote each response alone, the exact N-hops-per-inbound-batch
         residue batched egress removes. Encode failures scope to one
-        message via the shared error-response fallback."""
+        message via the shared error-response fallback. Sharded egress:
+        a shard-owned route takes the whole Message list across the
+        shard's egress ring instead — encode (per-shard template
+        cache) + writev + the response recycle sweep all run on the
+        shard loop, and only the ring push stays here."""
         native = self._client_native.get(addr, False)
-        chunks = encode_message_batch(
-            msgs,
-            lambda m, e: self._client_encode_error(addr, writer, m, e,
-                                                   native),
-            native=native, stats=self.egress_stats,
-            templates=self.response_templates)
-        if not chunks:
+        es = getattr(writer, "egress_shard", None)
+        if es is not None:
+            if not es.pool.closed:
+                es.feed_client(addr, writer, native, msgs)
+                return
+            chunks = encode_message_batch(  # teardown fallback: marshal
+                msgs, lambda m, e: log.warning(
+                    "unencodable message to client %s during egress "
+                    "teardown: %s", addr, e),
+                native=native, templates=self.response_templates)
+            if chunks:
+                self._marshal_client_write(writer, b"".join(chunks))
             return
+        lp = self.loop_prof
+        tok = lp.enter("egress") if lp is not None else None
         try:
-            # shard-owned routes (multiloop.ShardWriter) take the chunk
-            # list whole — it rides one writev, no join copy
-            write_many = getattr(writer, "write_many", None)
-            if write_many is not None:
-                write_many(chunks)
-            else:
-                writer.write(b"".join(chunks))
-        except Exception:  # noqa: BLE001 — client gone mid-write
-            log.info("dropping batch to disconnected client %s", addr)
-            self._drop_client_route(addr)
+            chunks = encode_message_batch(
+                msgs,
+                lambda m, e: self._client_encode_error(addr, writer, m, e,
+                                                       native),
+                native=native, stats=self.egress_stats,
+                templates=self.response_templates)
+            if not chunks:
+                return
+            try:
+                # shard-owned routes (multiloop.ShardWriter) take the
+                # chunk list whole — it rides one writev, no join copy
+                write_many = getattr(writer, "write_many", None)
+                if write_many is not None:
+                    write_many(chunks)
+                else:
+                    writer.write(b"".join(chunks))
+            except Exception:  # noqa: BLE001 — client gone mid-write
+                log.info("dropping batch to disconnected client %s", addr)
+                self._drop_client_route(addr)
+        finally:
+            if tok is not None:
+                lp.exit(tok)
 
     def deliver_group(self, target: SiloAddress, msgs: list) -> None:
         """Batched outbound hand-off for ONE destination
@@ -554,13 +928,7 @@ class SocketFabric:
             return
         if target in self.dead:
             return
-        sender = self._senders.get(target.endpoint)
-        if sender is None:
-            sender = self._senders[target.endpoint] = _Sender(
-                self, target.endpoint)
-        q = sender.queue
-        for m in msgs:
-            q.put_nowait(m)
+        self._sender_for(target.endpoint).feed_group(msgs)
 
     # -- inbound connections ----------------------------------------------
     async def _handle_conn(self, silo: "Silo", reader: asyncio.StreamReader,

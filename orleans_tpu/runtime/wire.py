@@ -51,6 +51,7 @@ __all__ = [
     "encode_frame", "read_frame", "frame_stream",
     "encode_message", "decode_message",
     "encode_message_batch", "decode_frames", "finish_batch_entries",
+    "writev_leftover",
     "encode_handshake", "decode_handshake",
 ]
 
@@ -216,12 +217,16 @@ _TMPL_VAR_IDX = tuple(i for i, s in enumerate(_HEADER_SLOTS)
 # direction and the invariant flags (see _frame_template; chain-carrying
 # envelopes peel, so chains never enter the key space). Bounded: a
 # cluster only ever sees O(silos + clients) keys, but a pathological key
-# churn (client generations) must not grow it forever.
+# churn (client generations) must not grow it forever. This dict is the
+# MAIN-loop cache; egress shards (runtime.multiloop.EgressShard) pass
+# their own per-shard dict through ``encode_message_batch(tmpl_cache=)``
+# so shard-side encode never touches (or contends on) this one — the
+# key space and cap are identical either way.
 _TMPL_CACHE: dict = {}
 _TMPL_CACHE_CAP = 512
 
 
-def _frame_template(m: Message):
+def _frame_template(m: Message, cache: dict | None = None):
     """The cached header-prefix template for ``m``, or None when the
     message must take the per-frame encoder (carrying headers the
     template's invariant runs can't represent).
@@ -238,7 +243,15 @@ def _frame_template(m: Message):
     the key, and chain cardinality scales with active calling grains —
     keying on it would thrash the bounded cache and evict the hot
     response templates; client senders (the call_batch target) carry
-    empty chains and template fully."""
+    empty chains and template fully.
+
+    ``cache`` (default: the module-level main-loop cache): the bounded
+    template dict to consult — egress shards pass their own so two
+    loops never share one dict (the pre-encoded chunk tuples themselves
+    are immutable and the C entry points hold the GIL throughout, so
+    the only shared state to confine was the cache)."""
+    if cache is None:
+        cache = _TMPL_CACHE
     d = m.direction
     if (m.rejection_type is not None or m.rejection_info is not None
             or m.forward_count or m.resend_count or m.is_unordered
@@ -254,12 +267,12 @@ def _frame_template(m: Message):
         # group, so they ride the template keyed, not peeled
         key = (m.sending_silo, m.target_silo, m.category, d,
                m.is_always_interleave, m.immutable)
-    t = _TMPL_CACHE.get(key)
+    t = cache.get(key)
     if t is None:
-        if len(_TMPL_CACHE) >= _TMPL_CACHE_CAP:
-            _TMPL_CACHE.clear()
+        if len(cache) >= _TMPL_CACHE_CAP:
+            cache.clear()
         try:
-            t = _TMPL_CACHE[key] = _ser._hotwire.make_header_template(
+            t = cache[key] = _ser._hotwire.make_header_template(
                 m, _TMPL_VAR_IDX)
         except Exception:  # noqa: BLE001 — unencodable invariant field:
             return None    # the per-frame path owns the error semantics
@@ -380,7 +393,8 @@ class _BodyDecodeError(WireDecodeError):
 # ---------------------------------------------------------------------------
 
 def encode_message_batch(msgs: list, bounce, native: bool = True,
-                         stats=None, templates: bool = True) -> list:
+                         stats=None, templates: bool = True,
+                         tmpl_cache: dict | None = None) -> list:
     """Encode a send batch into wire chunks: contiguous frame-batch
     buffers (``pack_batch`` C calls) on the native path, else one chunk
     per message. Per-message encode failures route to ``bounce`` (scoped
@@ -398,7 +412,11 @@ def encode_message_batch(msgs: list, bounce, native: bool = True,
     native-sender half (keyed per sender link, method
     identity varying — see :func:`_frame_template`). ``stats``
     (metrics-enabled egress writers): the whole batch encode is timed as
-    one ``egress.encode.seconds`` observation.
+    one ``egress.encode.seconds`` observation — MAIN-loop callers only;
+    shard-side egress writers pass ``stats=None`` and stamp the encode
+    themselves for loop-side replay (the registries are loop-confined).
+    ``tmpl_cache``: the per-loop template dict (see
+    :func:`_frame_template`; None = the main-loop cache).
     """
     hw = _ser._hotwire if native else None
     if hw is not None and _HW_BATCH:
@@ -420,7 +438,7 @@ def encode_message_batch(msgs: list, bounce, native: bool = True,
                 if m.expires_at is not None:
                     ttl = max(0.0, m.expires_at - now)
                 body = serialize(m.body)
-                tmpl = _frame_template(m) if use_tmpl else None
+                tmpl = _frame_template(m, tmpl_cache) if use_tmpl else None
             except Exception as e:  # noqa: BLE001 — per-message body failure
                 bounce(m, e)
                 continue
@@ -567,6 +585,21 @@ def decode_frames(buf, stats=None) -> tuple[int, list, list]:
 # ---------------------------------------------------------------------------
 # Handshake
 # ---------------------------------------------------------------------------
+
+def writev_leftover(chunks: list, sent: int) -> bytes:
+    """The unsent suffix of a chunk list after a (possibly partial)
+    vectored ``sock_writev`` — shared by every vectored egress drain
+    (ShardWriter, the silo-peer sender)."""
+    total = 0
+    for i, c in enumerate(chunks):
+        nxt = total + len(c)
+        if sent < nxt:
+            rest = [c[sent - total:]]
+            rest.extend(chunks[i + 1:])
+            return b"".join(rest)
+        total = nxt
+    return b""
+
 
 def leads_hostile_frame(buf) -> bool:
     """True when the buffer's leading length prefix announces an

@@ -50,3 +50,30 @@ class Flusher:
 
     def _flush_sync(self):
         self.trend.note(0.2)
+
+
+def encode_chunks(batch, stats):
+    # egress encode helper with a bare-parameter registry write: judged
+    # at each worker-context call site
+    if stats is not None:
+        stats.observe("egress.encode", 0.01)
+    return [b"" for _ in batch]
+
+
+class EgressDrain(threading.Thread):
+    """A shard egress drain doing it WRONG both ways: the live registry
+    rides into the encode helper, and dwell is written directly from
+    the shard context instead of stamped and replayed."""
+
+    def __init__(self, registry):
+        super().__init__(daemon=True)
+        self.loop = asyncio.new_event_loop()
+        self.registry: StatsRegistry = registry
+
+    def run(self):
+        self.loop.call_soon(self._drain, [object()])
+        self.loop.run_forever()
+
+    def _drain(self, batch):
+        encode_chunks(batch, self.registry)
+        self.registry.observe("egress.dwell", 0.5)

@@ -41,3 +41,38 @@ class TickWorker:
         for key, value in stamps:
             self.stats.observe(key, value)
         self.hist.observe(0.5)
+
+
+def encode_chunks(batch, stats=None):
+    # egress encode helper: worker callers inject None and time the
+    # call themselves (the sharded-egress discipline)
+    if stats is not None:
+        stats.observe("egress.encode", 0.01)
+    return [b"" for _ in batch]
+
+
+class EgressDrain(threading.Thread):
+    """The sharded-egress shape done RIGHT: encode gets a None sink,
+    dwell/encode are stamped into a plain list on the shard and
+    replayed by a main-loop callback (the stat-ring hand-off)."""
+
+    def __init__(self, registry):
+        super().__init__(daemon=True)
+        self.loop = asyncio.new_event_loop()
+        self.main_loop = asyncio.get_running_loop()
+        self.registry = registry
+
+    def run(self):
+        self.loop.call_soon(self._drain, [object()])
+        self.loop.run_forever()
+
+    def _drain(self, batch):
+        stamps = []
+        stamps.append(("egress.dwell", 0.5))
+        encode_chunks(batch, None)
+        stamps.append(("egress.encode", 0.01))
+        self.main_loop.call_soon_threadsafe(self._replay, stamps)
+
+    def _replay(self, stamps):
+        for key, value in stamps:
+            self.registry.observe(key, value)

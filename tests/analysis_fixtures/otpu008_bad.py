@@ -40,3 +40,9 @@ def ping_state(tbl: MiniTable, n: int):
 
 def pong_state(tbl: MiniTable, n: int):
     return ping_state(tbl, n - 1)
+
+
+def egress_snapshot(tbl: MiniTable):
+    # shard-side egress encode serializing donated rows with NO fence:
+    # the worker's kernel dispatch may hold them mid-donation
+    return [str(v) for v in tbl.state.values()]

@@ -41,3 +41,10 @@ def _walk(tbl: FencedTable, n: int):
     if n <= 0:
         return tbl.state
     return _walk(tbl, n - 1)
+
+
+def egress_snapshot(tbl: FencedTable):
+    # the sharded-egress shape done right: donated rows only serialize
+    # under the tick fence
+    with tbl.fence:
+        return [str(v) for v in tbl.state.values()]

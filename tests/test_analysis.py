@@ -49,14 +49,17 @@ EXPECTED_BAD = {
                        ("OTPU006", 15)},
     # Thread-target Histogram.observe (25), live registry into a decode
     # helper (26), shard-loop StatsRegistry.increment (40),
-    # run_in_executor trend note (52)
+    # run_in_executor trend note (52), egress-shard drain handing the
+    # live registry into the encode helper (78) and writing dwell
+    # directly from the shard context (79) — the sharded-egress shapes
     "otpu007_bad.py": {("OTPU007", 25), ("OTPU007", 26), ("OTPU007", 40),
-                       ("OTPU007", 52)},
+                       ("OTPU007", 52), ("OTPU007", 78), ("OTPU007", 79)},
     # unfenced-caller propagation (14), entry-point read (22), hits
     # store (30), unfenced mutual-recursion cycle (37 — a cycle cannot
-    # vouch for itself in the SCC-condensed held fixpoint)
+    # vouch for itself in the SCC-condensed held fixpoint), unfenced
+    # shard-side egress snapshot of donated rows (48)
     "otpu008_bad.py": {("OTPU008", 14), ("OTPU008", 22), ("OTPU008", 30),
-                       ("OTPU008", 37)},
+                       ("OTPU008", 37), ("OTPU008", 48)},
     "otpu009_bad.py": {("OTPU009", n) for n in range(28, 39)}
     | {("OTPU009", 40)},
 }
