@@ -379,6 +379,137 @@ async def run_ab(n_followers: int = 64, n_chirpers: int = 8,
     }
 
 
+# ---------------------------------------------------------------------------
+# Device-stream-vs-per-subscriber A/B (ISSUE 16): celebrity post fan-out
+# through a STREAM namespace — one RPC per (event, subscriber) vs the
+# DeviceStreamProvider's compiled edge-list delivery. Identical edge
+# traffic both sides; measures publish -> all-delivered wall clock.
+# ---------------------------------------------------------------------------
+
+async def run_ab_device(n_subscribers: int = 64, n_events: int = 16,
+                        batch: int = 4, repeats: int = 2) -> dict:
+    """Stream fan-out on IDENTICAL edge traffic: per-subscriber
+    ``TimelineVec.recv`` RPCs per published event (the per-consumer
+    delivery shape of the host-tier providers) vs DeviceStreamProvider
+    publishes whose delivery compiles onto ``stream_fanout`` edge
+    exchanges. ``n_events`` events publish in groups of ``batch`` items
+    (each cached batch is one stacked dispatch); fan-out per event is
+    ``n_subscribers`` (the >=64 acceptance regime). Best-of-``repeats``
+    per side with per-side ``gc.collect()`` + ``gc.freeze()`` over the
+    timed window (the ping-floor A/B discipline)."""
+    import asyncio
+    import gc
+
+    import jax.numpy as jnp
+    from orleans_tpu.dispatch import (VectorGrain, actor_method,
+                                      add_vector_grains)
+    from orleans_tpu.runtime import ClusterClient, SiloBuilder
+    from orleans_tpu.streams import StreamId, add_device_streams
+
+    class TimelineVec(VectorGrain):
+        STATE = {"received": (jnp.int32, ()), "last": (jnp.int32, ())}
+
+        @staticmethod
+        def initial_state(key_hash):
+            return {"received": jnp.int32(0), "last": jnp.int32(0)}
+
+        @actor_method(args={"chirp": (jnp.int32, ())})
+        def recv(state, args):
+            new = {"received": state["received"] + 1,
+                   "last": args["chirp"]}
+            return new, new["received"]
+
+        @actor_method(read_only=True)
+        def count(state, args):
+            return state, state["received"]
+
+    rng = np.random.default_rng(23)
+    chirps = rng.integers(1, 1 << 30, n_events).astype(np.int32)
+    n_edges = n_events * n_subscribers
+
+    async def side(device: bool) -> tuple[float, int]:
+        b = SiloBuilder().with_name("chirp-ds")
+        add_vector_grains(b, TimelineVec, mesh=make_mesh(1),
+                          capacity_per_shard=max(64, n_subscribers),
+                          dense={TimelineVec: n_subscribers})
+        add_device_streams(b, "device")
+        silo = b.build()
+        await silo.start()
+        client = await ClusterClient(silo.fabric).connect()
+        provider = silo.stream_providers["device"]
+        if device:
+            await provider.subscribe_keys("celebrity", TimelineVec,
+                                          np.arange(n_subscribers),
+                                          method="recv")
+        stream = StreamId("device", "celebrity", "post")
+        keys = np.arange(n_subscribers)
+
+        async def drive() -> None:
+            if device:
+                base = silo.stats.get("streams.device.delivered")
+                for off in range(0, n_events, batch):
+                    await provider.produce(stream, [
+                        {"chirp": c} for c in chirps[off:off + batch]])
+                target = base + n_edges
+                while silo.stats.get("streams.device.delivered") < target:
+                    await asyncio.sleep(0)
+                return
+            for c in chirps:
+                for off in range(0, n_subscribers, 256):
+                    await asyncio.gather(*(
+                        client.get_grain(TimelineVec, int(k)).recv(
+                            chirp=np.int32(c))
+                        for k in keys[off:off + 256]))
+
+        try:
+            # SYMMETRIC warmup (see run_ab): one identical drive per
+            # side amortizes jit compiles / row activation equally
+            await drive()
+            gc.collect()
+            gc.freeze()
+            try:
+                t0 = time.perf_counter()
+                await drive()
+                wall = time.perf_counter() - t0
+            finally:
+                gc.unfreeze()
+            total = int(await client.reduce_actors(TimelineVec, "count"))
+            assert total == n_edges * 2, (total, n_edges * 2)
+            grp = (provider.stream_delivery_group() if device else 0)
+            return wall, int(grp)
+        finally:
+            await client.close_async()
+            await silo.stop()
+
+    best_edge = best_dev = float("inf")
+    group = 0
+    for _ in range(repeats):
+        w, _ = await side(device=False)
+        best_edge = min(best_edge, w)
+        w, g = await side(device=True)
+        if w < best_dev:
+            best_dev, group = w, g
+    ratio = best_edge / best_dev
+    return {
+        "metric": "chirper_device_stream_vs_per_subscriber_ratio",
+        "value": round(ratio, 2),
+        "unit": "x",
+        "vs_baseline": None,
+        "extra": {
+            "n_edges": n_edges,
+            "fan_out": n_subscribers,
+            "n_events": n_events,
+            "items_per_publish": batch,
+            "per_subscriber_wall_s": round(best_edge, 4),
+            "device_wall_s": round(best_dev, 4),
+            "per_subscriber_deliveries_per_sec":
+                round(n_edges / best_edge, 1),
+            "device_deliveries_per_sec": round(n_edges / best_dev, 1),
+            "last_delivery_group": group,
+        },
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--accounts", type=int, default=65536)
@@ -387,10 +518,16 @@ def main() -> None:
     ap.add_argument("--seconds", type=float, default=8.0)
     ap.add_argument("--ab", action="store_true",
                     help="run the host-tier bulk-vs-per-edge A/B")
+    ap.add_argument("--ab-device", action="store_true",
+                    help="run the device-stream-vs-per-subscriber A/B")
     a = ap.parse_args()
     if a.ab:
         import asyncio
         print(json.dumps(asyncio.run(run_ab())))
+        return
+    if a.ab_device:
+        import asyncio
+        print(json.dumps(asyncio.run(run_ab_device())))
         return
     print(json.dumps(run(a.accounts, a.followers, a.chirps,
                          seconds=a.seconds)))

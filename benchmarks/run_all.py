@@ -130,6 +130,11 @@ def main() -> None:
     # (symmetric warmup: steady-state dispatch, compile excluded)
     print(json.dumps(asyncio.run(mapreduce.run_ab())))
     print(json.dumps(asyncio.run(chirper_fanout.run_ab())))
+    # Device-stream A/B (ISSUE 16): per-subscriber delivery RPCs vs the
+    # DeviceStreamProvider's compiled edge-list fan-out on identical
+    # edge traffic — CI floor 3x at fan-out >= 64 in
+    # test_floor_device_streams, measured ~8-10x in-proc
+    print(json.dumps(asyncio.run(chirper_fanout.run_ab_device())))
     for r in serialization.run():
         print(json.dumps(r))
     print(json.dumps(asyncio.run(transactions.run(seconds=3.0))))

@@ -26,6 +26,7 @@ __all__ = [
     "GrainCollectionOptions", "MembershipOptions", "DirectoryOptions",
     "LoadSheddingOptions", "DispatchOptions", "RebalanceOptions",
     "TracingOptions", "MetricsOptions", "ProfilingOptions", "SloOptions",
+    "StreamOptions",
     "flatten", "apply_options", "validate_options", "log_options",
 ]
 
@@ -382,22 +383,47 @@ class SloOptions:
     probe_target: float = 0.99
     error_target: float = 0.999
     shed_target: float = 0.99
+    # stream delivery latency (publish -> consumer-turn; fed from the
+    # streams.delivery.seconds histogram the device provider observes)
+    stream_target: float = 0.99
+    stream_threshold: float = 0.25
 
     def validate(self) -> None:
         _positive(self, "period", "fast_window", "slow_window",
-                  "burn_threshold", "min_events", "latency_threshold")
+                  "burn_threshold", "min_events", "latency_threshold",
+                  "stream_threshold")
         if self.fast_window >= self.slow_window:
             raise ConfigurationError(
                 f"slo fast_window must be < slow_window "
                 f"({self.fast_window} >= {self.slow_window}) — the slow "
                 "window exists to CONFIRM what the fast window catches")
         for n in ("latency_target", "probe_target", "error_target",
-                  "shed_target"):
+                  "shed_target", "stream_target"):
             v = getattr(self, n)
             if not (0.0 < v < 1.0):
                 raise ConfigurationError(
                     f"slo {n} must be in (0, 1), got {v!r} — a target of "
                     "1.0 leaves zero error budget")
+
+
+@dataclass
+class StreamOptions:
+    """Device-tier streams (streams.device — the namespace fan-out
+    compiled onto the bulk collectives): ``device_fanout`` arms the
+    stream_fanout delivery lever on the persistent providers' vector
+    path — dense bulk items ride broadcast edge exchanges instead of
+    per-consumer call_batch ticks. OFF (default) keeps the per-consumer
+    path bit for bit: the A/B lever, symmetric with ``batched_ingress``.
+    ``device_cache_capacity`` bounds each device namespace's
+    :class:`~orleans_tpu.streams.cache.PooledQueueCache` in batches
+    (producers backpressure at 75% occupancy through the queue-wait-
+    trend shed signal)."""
+
+    device_fanout: bool = False
+    device_cache_capacity: int = 1024
+
+    def validate(self) -> None:
+        _positive(self, "device_cache_capacity")
 
 
 @dataclass
@@ -487,6 +513,11 @@ _FLAT_MAP = {
     "slo_probe_target": (SloOptions, "probe_target"),
     "slo_error_target": (SloOptions, "error_target"),
     "slo_shed_target": (SloOptions, "shed_target"),
+    "slo_stream_target": (SloOptions, "stream_target"),
+    "slo_stream_threshold": (SloOptions, "stream_threshold"),
+    "stream_device_fanout": (StreamOptions, "device_fanout"),
+    "stream_device_cache_capacity": (StreamOptions,
+                                     "device_cache_capacity"),
     "profiling_enabled": (ProfilingOptions, "enabled"),
     "profiling_window": (ProfilingOptions, "window"),
     "profiling_ring": (ProfilingOptions, "ring"),

@@ -1884,6 +1884,16 @@ class VectorRuntime:
             else:
                 pending = (tg[:0], {f: a[:0] for f, a in fa.items()})
             for off in range(0, tg.shape[0], chunk):
+                if off:
+                    # loop fairness between chunk dispatches: a
+                    # celebrity-sized edge list is dozens of chunks and
+                    # each is a synchronous device call — without a
+                    # yield the whole pass blocks the loop past the
+                    # membership probe timeout (the gauntlet QoS
+                    # failure). One chunk stays the atomic quantum;
+                    # chunks execute in order, so stacked item-major
+                    # stream batches keep per-key token order.
+                    await asyncio.sleep(0)
                 ce = tg[off:off + chunk]
                 ca = {f: a[off:off + chunk] for f, a in fa.items()}
                 delivered += self._broadcast_chunk(grain_class, method,
@@ -1892,6 +1902,28 @@ class VectorRuntime:
                 return delivered
             await self._bulk_yield()
         return delivered
+
+    async def stream_fanout(self, grain_class: type, method: str,
+                            targets: np.ndarray,
+                            args: dict | None = None,
+                            chunk: int = 16384) -> int:
+        """Device-tier stream delivery entry (streams.device): one
+        publish batch's per-subscriber fan-out rides the broadcast
+        machinery unchanged — ``_bulk_activate`` fresh-init scatter,
+        ``route`` edge exchange, ``apply_received`` dedup rounds, all
+        under the tick fence (so grow/migration/checkpoint serialize
+        with every delivery round exactly like PR-13 bulk ticks). The
+        caller stacks a batch's items item-major, so the dedup rounds'
+        first-occurrence-wins lane order IS per-key token order — the
+        per-consumer event-order invariant. Returns edge-events
+        delivered."""
+        targets = np.asarray(targets, dtype=np.int64).reshape(-1)
+        d = await self.broadcast_actors(grain_class, method, targets,
+                                        args, chunk=chunk)
+        self.last_stream_group = int(targets.size)
+        if self.stats is not None:
+            self.stats.increment("streams.device.fanout_rounds")
+        return d
 
     def _broadcast_chunk(self, cls: type, method: str,
                          targets: np.ndarray, args: dict) -> int:

@@ -169,6 +169,8 @@ class MetricsSampler:
                             lambda: silo.message_center.egress.last_group)
         if silo.vector is not None:
             self._install_vector_sources()
+        if silo.stream_providers:
+            self._install_stream_sources()
 
     def _install_vector_sources(self) -> None:
         silo = self.silo
@@ -180,6 +182,39 @@ class MetricsSampler:
                         lambda: silo.vector.staging_lanes())
         self.add_source("vector.staging_fill",
                         lambda: silo.vector.staging_fill)
+
+    def _install_stream_sources(self) -> None:
+        """Stream-provider health gauges, summed over every installed
+        provider that exposes the probes (the device provider does; SMS
+        and persistent providers simply contribute zero):
+
+        - ``streams.backlog`` — cached-but-unpurged batches across all
+          namespaces (rises when consumers or the pump fall behind the
+          publishers);
+        - ``streams.cursor_lag`` — worst cursor distance from the write
+          head in batches (a stuck rewound consumer shows here long
+          before the backlog gauge moves, because its cursor pins the
+          purge floor);
+        - ``streams.delivery_group`` — rows in the last compiled delivery
+          batch (edges x items): the hand-off-unit twin of
+          ``vector.egress_group`` — a sustained 1 means fan-out is not
+          batching and the device path pays its overhead for nothing."""
+        providers = self.silo.stream_providers
+
+        def _sum(probe: str) -> float:
+            total = 0.0
+            for p in providers.values():
+                fn = getattr(p, probe, None)
+                if fn is not None:
+                    total += float(fn())
+            return total
+
+        self.add_source("streams.backlog",
+                        lambda: _sum("stream_backlog"))
+        self.add_source("streams.cursor_lag",
+                        lambda: _sum("stream_cursor_lag"))
+        self.add_source("streams.delivery_group",
+                        lambda: _sum("stream_delivery_group"))
 
     def _has_journaled_grains(self) -> bool:
         from ..eventsourcing.journaled import JournaledGrain
@@ -211,6 +246,11 @@ class MetricsSampler:
                 "vector.queue_depth" not in self._sources:
             # the device tier may have been installed after construction
             self._install_vector_sources()
+        if self.silo.stream_providers and \
+                "streams.backlog" not in self._sources:
+            # stream providers install via lifecycle stages that run
+            # after the sampler is constructed
+            self._install_stream_sources()
         self._task = asyncio.get_running_loop().create_task(self._loop())
 
     def stop(self) -> None:

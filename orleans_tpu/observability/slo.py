@@ -182,6 +182,16 @@ def default_specs(config) -> list[SloSpec]:
                 bad_source="messaging.gateway.shed",
                 total_source=("ingest.turns", "ingest.messages"),
                 labels={"route": "gateway"}, **common),
+        # device-tier stream delivery: publish -> consumer-turn hand-off
+        # (streams.delivery.seconds is observed by the device provider's
+        # pump when the compiled fan-out round lands). Zero observations
+        # when no stream provider is installed -> never burns.
+        SloSpec("stream_latency", kind="latency",
+                target=config.slo_stream_target,
+                threshold=config.slo_stream_threshold,
+                source="streams.delivery.seconds",
+                labels={"route": "streams.device", "qos": "APPLICATION"},
+                **common),
     ]
 
 

@@ -75,7 +75,19 @@ BULK_METHODS = {
     "__bulk_map__": "map",
     "__bulk_reduce__": "reduce",
     "__bulk_broadcast__": "broadcast",
+    # device-tier stream delivery (streams.device): one publish batch's
+    # pre-stacked edge slice — broadcast semantics through stream_fanout
+    "__stream_deliver__": "stream",
+    # server-armed join_when watch: the anchor runs the poll reduction
+    # locally for the lease and answers once (readiness met or lease
+    # expiry) instead of the client emitting one envelope per poll
+    "__bulk_join__": "join",
 }
+
+# op -> wire method name for anchor-fanned peer legs. NOT always the
+# inbound msg.method_name: a join watch's nested reductions must reach
+# peers as __bulk_reduce__, or every peer would arm its own watch.
+_BULK_WIRE = {v: k for k, v in BULK_METHODS.items()}
 
 
 class Dispatcher:
@@ -517,7 +529,10 @@ class Dispatcher:
                                spec: dict) -> None:
         op = BULK_METHODS[msg.method_name]
         try:
-            if spec.get("local"):
+            if op == "join":
+                # always anchored: the watch IS the anchor-side loop
+                result = await self._vector_bulk_join(vcls, msg, spec)
+            elif spec.get("local"):
                 result = await self._vector_bulk_local(vcls, op, spec)
             else:
                 result = await self._vector_bulk_anchor(vcls, msg, op,
@@ -599,6 +614,15 @@ class Dispatcher:
             st.increment("vector.bulk.applied", count)
             return {"value": value, "count": count}
         targets = np.asarray(spec["targets"], dtype=np.int64)
+        if op == "stream":
+            # device-tier stream delivery: same broadcast machinery via
+            # the engine's stream entry (delivery-group bookkeeping +
+            # streams.* stats ride along)
+            d = await rt.stream_fanout(vcls, method, targets,
+                                       spec.get("args") or {},
+                                       chunk=spec.get("chunk", 16384))
+            st.increment("streams.device.bulk_delivered", d)
+            return d
         d = await rt.broadcast_actors(vcls, method, targets,
                                       spec.get("args") or {},
                                       chunk=spec.get("chunk", 16384))
@@ -617,7 +641,9 @@ class Dispatcher:
         combine = spec.get("combine", "sum")
         rc = self.silo.runtime_client
         work = []
-        if op == "broadcast" and peers:
+        if op in ("broadcast", "stream") and peers:
+            # stream deliveries partition exactly like broadcast edges:
+            # targets + per-edge payload rows travel to their ring owner
             slices = self._partition_broadcast(vcls, spec, peers)
             local_spec = slices.pop(me, None)
             if local_spec is not None:
@@ -631,7 +657,10 @@ class Dispatcher:
             work.append(rc.send_request(
                 target_grain=msg.target_grain, grain_class=vcls,
                 interface_name=msg.interface_name,
-                method_name=msg.method_name, args=(),
+                # the op's OWN wire name, not msg.method_name: a join
+                # watch's nested reductions must arrive as
+                # __bulk_reduce__ at the peers (_BULK_WIRE)
+                method_name=_BULK_WIRE[op], args=(),
                 kwargs={"spec": pspec}, target_silo=peer,
                 # the caller's budget rides the spec: without it a
                 # 120s-budget collective would die at the peer leg's
@@ -648,6 +677,50 @@ class Dispatcher:
         if op == "reduce":
             return self._finalize_reduce(parts, combine)
         return int(sum(parts))
+
+    async def _vector_bulk_join(self, vcls: type, msg: Message,
+                                spec: dict) -> dict:
+        """Server-armed ``join_when`` watch (the long-poll half of the
+        join-calculus readiness step): the anchor runs the poll
+        reduction loop LOCALLY for up to ``spec['lease']`` seconds —
+        each poll is one cluster reduce through the normal anchor
+        fan-out — and answers once, either readiness-met or an honest
+        lease expiry carrying the last observed count. The client
+        re-arms until its own deadline, so a K-poll wait costs
+        ceil(wait/lease) client envelopes instead of K."""
+        import jax
+
+        from ..dispatch.engine import join_poll
+        need = int(spec.get("need", 0))
+        poll = float(spec.get("poll", 0.02))
+        lease = spec.get("lease")
+        lease = None if lease is None else float(lease)
+        rspec: dict = {"method": spec["method"],
+                       "kwargs": spec.get("kwargs") or {},
+                       "combine": "sum"}
+        if spec.get("keys") is not None:
+            rspec["keys"] = spec["keys"]
+        if spec.get("timeout") is not None:
+            rspec["timeout"] = spec["timeout"]
+        self.silo.stats.increment("vector.join.watches")
+        last = {"ready": 0}
+
+        async def reduce_once():
+            r = await self._vector_bulk_anchor(vcls, msg, "reduce", rspec)
+            val = r["value"]
+            leaves = jax.tree_util.tree_leaves(val) \
+                if val is not None else []
+            last["ready"] = int(leaves[0]) if leaves else 0
+            return val
+
+        try:
+            ready = await join_poll(reduce_once, need, lease, poll)
+            return {"ready": ready, "met": True}
+        except asyncio.TimeoutError:
+            # lease expiry is a normal answer, not an error: the client
+            # decides (re-arm vs its own deadline) — a marshalled
+            # TimeoutError could not carry the observed count
+            return {"ready": last["ready"], "met": False}
 
     def _partition_broadcast(self, vcls: type, spec: dict,
                              peers: list) -> dict:

@@ -156,12 +156,15 @@ class GrainFactory:
 
     def join_when(self, grain_class: type, keys, k: int | None = None, *,
                   method: str, kwargs: dict | None = None,
-                  timeout: float | None = None, poll: float = 0.02):
-        """Readiness-mask join over a key set
+                  timeout: float | None = None, poll: float = 0.02,
+                  server: bool = True):
+        """Readiness-mask join over a key set: server-armed watch by
+        default, ``server=False`` for the per-poll client loop
         (``RuntimeClient.join_when``)."""
         return self._client.join_when(grain_class, keys, k,
                                       method=method, kwargs=kwargs,
-                                      timeout=timeout, poll=poll)
+                                      timeout=timeout, poll=poll,
+                                      server=server)
 
     def get_system_target(self, grain_class: type, grain_id: GrainId) -> GrainRef:
         ref = GrainRef(grain_class, grain_id, self._client)

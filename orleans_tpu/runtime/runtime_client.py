@@ -440,26 +440,57 @@ class RuntimeClient:
         return await self._bulk_request(grain_class, "__bulk_broadcast__",
                                         spec, timeout)
 
+    # server-armed join lease: the anchor polls locally this long per
+    # watch envelope. Capped WELL under the 30s response timeout so a
+    # watch answer (met or honest expiry) always beats the RPC deadline
+    _JOIN_LEASE = 10.0
+
     async def join_when(self, grain_class: type, keys, k: int | None = None,
                         *, method: str, kwargs: dict | None = None,
                         timeout: float | None = None,
-                        poll: float = 0.02) -> int:
+                        poll: float = 0.02, server: bool = True) -> int:
         """Readiness-mask join (join-calculus style): resolve when at
         least ``k`` of ``keys`` (default: all) report ready through
-        ``method`` — a read-only actor method returning 0/1. Each poll
-        is ONE reduce_actors collective (one envelope per silo, one
-        device reduction each) — scatter-gather aggregations never fan K
-        host futures through the loop. Returns the ready count."""
-        # the poll driver is the engine's (ONE readiness semantics for
-        # both surfaces); imported lazily — only vector-facing callers
-        # pull the dispatch/jax stack into a client process
-        from ..dispatch.engine import join_poll
+        ``method`` — a read-only actor method returning 0/1.
+
+        Default (``server=True``): the client registers a readiness
+        WATCH — one ``__bulk_join__`` envelope arms the anchor's poll
+        reduction for a lease and the answer comes back once (met, or
+        an honest lease expiry the client re-arms after). A K-poll wait
+        costs ceil(wait/lease) client envelopes instead of K — the
+        long-poll of the ROADMAP carry-over. ``server=False`` restores
+        the per-poll client loop (one reduce_actors envelope per poll).
+        Returns the ready count."""
         keys = list(keys)
         need = len(keys) if k is None else int(k)
-        return await join_poll(
-            lambda: self.reduce_actors(grain_class, method, kwargs,
-                                       keys=keys, combine="sum"),
-            need, timeout, poll)
+        if not server:
+            # the poll driver is the engine's (ONE readiness semantics
+            # for both surfaces); imported lazily — only vector-facing
+            # callers pull the dispatch/jax stack into a client process
+            from ..dispatch.engine import join_poll
+            return await join_poll(
+                lambda: self.reduce_actors(grain_class, method, kwargs,
+                                           keys=keys, combine="sum"),
+                need, timeout, poll)
+        loop = asyncio.get_running_loop()
+        deadline = None if timeout is None else loop.time() + timeout
+        ready = 0
+        while True:
+            remaining = None if deadline is None \
+                else deadline - loop.time()
+            if remaining is not None and remaining <= 0:
+                raise asyncio.TimeoutError(
+                    f"join_when: {ready}/{need} ready after {timeout}s")
+            lease = self._JOIN_LEASE if remaining is None \
+                else max(0.05, min(self._JOIN_LEASE, remaining))
+            spec: dict = {"method": method, "kwargs": kwargs or {},
+                          "keys": keys, "need": need, "poll": poll,
+                          "lease": lease}
+            r = await self._bulk_request(grain_class, "__bulk_join__",
+                                         spec, timeout=lease + 15.0)
+            ready = int(r.get("ready", 0))
+            if r.get("met"):
+                return ready
 
     # -- request path (SendRequest) --------------------------------------
     def send_request(self, *, target_grain: GrainId, grain_class: type,

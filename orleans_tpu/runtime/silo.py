@@ -268,6 +268,19 @@ class SiloConfig:
     slo_probe_target: float = 0.99
     slo_error_target: float = 0.999
     slo_shed_target: float = 0.99
+    # stream delivery latency objective (publish -> consumer-turn, fed
+    # from the streams.delivery.seconds histogram; metrics-gated like
+    # app_latency — zero observations never burn)
+    slo_stream_target: float = 0.99
+    slo_stream_threshold: float = 0.25
+    # device-tier streams (streams.device / config.StreamOptions):
+    # device_fanout arms the bulk-collective delivery lever on the
+    # persistent providers' vector path (stream_fanout edge exchanges
+    # for dense bulk items); OFF keeps the per-consumer call_batch path
+    # bit for bit — the A/B lever. cache_capacity bounds each device
+    # namespace's PooledQueueCache (batches; pressure at 75%).
+    stream_device_fanout: bool = False
+    stream_device_cache_capacity: int = 1024
     profiling_enabled: bool = False
     profiling_window: float = 1.0          # seconds per occupancy slice
     profiling_ring: int = 120              # slices retained (flight data)

@@ -17,6 +17,11 @@ from .durable import (
 )
 from .core import (StreamId, StreamProvider, StreamRef, StreamSignal,
                    SubscriptionHandle, batch_consumer)
+from .device import (
+    DeviceStreamProvider,
+    DeviceSubscription,
+    add_device_streams,
+)
 from .persistent import (
     GeneratorQueueAdapter,
     MemoryQueueAdapter,
@@ -41,4 +46,5 @@ __all__ = [
     "QueueBalancer", "DeploymentBasedBalancer", "BestFitBalancer",
     "LeaseBasedBalancer", "MemoryLeaseProvider",
     "PooledQueueCache", "QueueCacheCursor",
+    "DeviceStreamProvider", "DeviceSubscription", "add_device_streams",
 ]

@@ -71,6 +71,13 @@ class PooledQueueCache:
     def count(self) -> int:
         return len(self._items)
 
+    @property
+    def write_token(self) -> int:
+        """The token the NEXT added batch will take — the write head a
+        cursor-lag gauge measures against (tokens are contiguous, so
+        ``write_token - cursor.next_token`` is the lag in batches)."""
+        return self._next_token
+
     def cached_streams(self) -> set:
         """Distinct stream ids with batches still cached."""
         return {cb.batch.stream for cb in self._items}

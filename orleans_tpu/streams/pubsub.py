@@ -308,6 +308,29 @@ def _run_bulk_local(rt, vcls: type, method: str, item: dict) -> int:
     return _bulk_events(item)
 
 
+async def _run_bulk_local_via(silo: "Silo", rt, vcls: type, method: str,
+                              item: dict) -> int:
+    """The device-fan-out lever (``StreamOptions.device_fanout``): when
+    armed, ``{"keys", "args"}`` items whose keys are all dense-regime
+    ride the engine's ``stream_fanout`` (broadcast edge exchanges +
+    apply_received dedup — tolerates duplicate keys, which call_batch
+    lanes cannot) instead of a call_batch tick. Default OFF keeps the
+    per-consumer path bit for bit; rounds items and hashed-key items
+    always take the existing path."""
+    import numpy as np
+
+    if getattr(silo.config, "stream_device_fanout", False) and \
+            "args_rounds" not in item:
+        keys = np.asarray(item["keys"])
+        if keys.dtype.kind in "iu" and keys.size:
+            tbl = rt.table(vcls)
+            if keys.min() >= 0 and keys.max() < tbl.dense_n:
+                return await rt.stream_fanout(
+                    vcls, method, keys.astype(np.int64),
+                    item.get("args", {}))
+    return _run_bulk_local(rt, vcls, method, item)
+
+
 async def _deliver_bulk_item(silo: "Silo", rt, vcls: type, method: str,
                              item: dict) -> int:
     """Run one bulk item, respecting single-owner routing: in a
@@ -322,7 +345,7 @@ async def _deliver_bulk_item(silo: "Silo", rt, vcls: type, method: str,
     me = silo.silo_address
     alive = getattr(silo.locator, "alive_list", None) or [me]
     if len(alive) <= 1:
-        return _run_bulk_local(rt, vcls, method, item)
+        return await _run_bulk_local_via(silo, rt, vcls, method, item)
 
     keys = np.asarray(item["keys"])
     cls_type = GrainType.of(vcls.__name__)
@@ -335,7 +358,7 @@ async def _deliver_bulk_item(silo: "Silo", rt, vcls: type, method: str,
     for owner, idxs in groups.items():
         sub = _slice_bulk_item(item, keys, idxs)
         if owner == me:
-            total += _run_bulk_local(rt, vcls, method, sub)
+            total += await _run_bulk_local_via(silo, rt, vcls, method, sub)
         else:
             from ..core.ids import type_code_of
             from ..core.message import Category
@@ -381,7 +404,8 @@ class VectorStreamDeliverTarget:
         if vcls is None or self.silo.vector is None:
             raise LookupError(
                 f"no vector interface {class_name!r} on this silo")
-        return _run_bulk_local(self.silo.vector, vcls, method, item)
+        return await _run_bulk_local_via(self.silo, self.silo.vector,
+                                         vcls, method, item)
 
 
 def install_vector_stream_target(silo) -> None:

@@ -1,0 +1,401 @@
+"""Device-tier stream provider (ISSUE 16): namespace fan-out compiled
+onto the bulk collectives — fused edge-list delivery through
+``stream_fanout``, PooledQueueCache sequence tokens + exactly-from-token
+rewind, fence-interlocked delivery racing grow/migration, the
+``stream_device_fanout`` A/B lever (bit-for-bit off path), the
+APPLICATION-only QoS rule, and the server-armed ``join_when`` watch."""
+
+import asyncio
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orleans_tpu.dispatch import VectorGrain, actor_method, add_vector_grains
+from orleans_tpu.parallel import make_mesh
+from orleans_tpu.runtime import ClusterClient, InProcFabric, SiloBuilder
+from orleans_tpu.streams import StreamId, add_device_streams
+
+
+class FeedVec(VectorGrain):
+    """Stream consumer row: counts events, sums payloads, and checks the
+    per-key order contract (every delivered ``v`` must exceed the last —
+    publishers send strictly increasing values, so ``ok`` flips to 0 the
+    moment delivery reorders)."""
+
+    STATE = {"events": (jnp.int32, ()), "total": (jnp.float32, ()),
+             "last": (jnp.float32, ()), "ok": (jnp.int32, ())}
+
+    @staticmethod
+    def initial_state(key_hash):
+        return {"events": jnp.int32(0), "total": jnp.float32(0),
+                "last": jnp.float32(-1), "ok": jnp.int32(1)}
+
+    @actor_method(args={"v": (jnp.float32, ())})
+    def on_next(state, args):
+        good = (args["v"] > state["last"]).astype(jnp.int32)
+        new = {"events": state["events"] + 1,
+               "total": state["total"] + args["v"],
+               "last": args["v"],
+               "ok": state["ok"] * good}
+        return new, new["events"]
+
+    @actor_method(read_only=True)
+    def ready(state, args):
+        return state, (state["events"] >= 3).astype(jnp.int32)
+
+
+def _build_silos(n, n_dense=64, fabric=None, **cfg):
+    fabric = fabric or InProcFabric()
+    silos = []
+    for i in range(n):
+        b = (SiloBuilder().with_name(f"ds{i}").with_fabric(fabric)
+             .with_config(response_timeout=5.0, **cfg))
+        add_vector_grains(b, FeedVec, mesh=make_mesh(1),
+                          capacity_per_shard=max(64, n_dense),
+                          dense={FeedVec: n_dense})
+        add_device_streams(b, "device")
+        silos.append(b.build())
+    return fabric, silos
+
+
+async def _poll(check, timeout=6.0, step=0.02):
+    for _ in range(int(timeout / step)):
+        if check():
+            return True
+        await asyncio.sleep(step)
+    return check()
+
+
+def _events(silos, k):
+    total = 0
+    for s in silos:
+        tbl = s.vector.table(FeedVec)
+        if tbl.dense_active[k]:
+            total += int(tbl.read_row(k)["events"])
+    return total
+
+
+# ---------------------------------------------------------------------------
+# Fused fan-out basics
+# ---------------------------------------------------------------------------
+
+async def test_publish_fans_out_through_bulk_path():
+    fabric, (silo,) = _build_silos(1, n_dense=32)
+    await silo.start()
+    client = await ClusterClient(fabric).connect()
+    try:
+        provider = silo.stream_providers["device"]
+        sub = await provider.subscribe_keys("ticks", FeedVec,
+                                            np.arange(32))
+        assert sub.live  # no rewind token -> live immediately
+        stream = StreamId("device", "ticks", "c1")
+        tok = await provider.produce(stream, [{"v": np.float32(0.0)},
+                                              {"v": np.float32(1.0)}])
+        assert tok == 0
+        assert await provider.produce(
+            stream, [{"v": np.float32(2.0)}]) == 2  # item-cumulative
+        tbl = silo.vector.table(FeedVec)
+        assert await _poll(
+            lambda: tbl.dense_active[31]
+            and int(tbl.read_row(31)["events"]) == 3)
+        for k in (0, 13, 31):
+            row = tbl.read_row(k)
+            assert int(row["events"]) == 3
+            assert float(row["total"]) == 3.0
+            assert int(row["ok"]) == 1
+        # every delivery rode the fused bulk path, one stacked dispatch
+        # per cached batch — not one envelope (or call) per subscriber
+        assert silo.stats.get("streams.device.delivered") == 3 * 32
+        assert provider.stream_delivery_group() >= 32
+        assert provider.stream_backlog() >= 0
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_rewind_replays_exactly_from_token():
+    """A rewound subscription replays exactly-from-token through the
+    SAME bulk path (solo catch-up cursor, partial batch trimmed at the
+    token edge) and then merges into the fused edge list."""
+    fabric, (silo,) = _build_silos(1, n_dense=32)
+    await silo.start()
+    client = await ClusterClient(fabric).connect()
+    try:
+        provider = silo.stream_providers["device"]
+        live = await provider.subscribe_keys("feed", FeedVec,
+                                             np.arange(0, 8))
+        # armed BEFORE the backlog exists: token 6 lands mid-batch-2
+        rew = await provider.subscribe_keys("feed", FeedVec,
+                                            np.arange(8, 16),
+                                            from_token=6)
+        assert not rew.live
+        stream = StreamId("device", "feed", "s")
+        for base in (0, 4, 8):
+            await provider.produce(stream, [
+                {"v": np.float32(base + i)} for i in range(4)])
+        tbl = silo.vector.table(FeedVec)
+        assert await _poll(lambda: tbl.dense_active[8]
+                           and int(tbl.read_row(8)["events"]) == 6)
+        # live rows heard all 12 events; rewound rows exactly 6..11
+        assert int(tbl.read_row(0)["events"]) == 12
+        assert float(tbl.read_row(0)["total"]) == float(sum(range(12)))
+        for k in (8, 15):
+            row = tbl.read_row(k)
+            assert int(row["events"]) == 6
+            assert float(row["total"]) == float(sum(range(6, 12)))
+            assert int(row["ok"]) == 1  # replay kept token order
+        # caught up -> promoted into the fused list at a batch boundary
+        assert await _poll(lambda: rew.live)
+        await provider.produce(stream, [{"v": np.float32(50.0)}])
+        assert await _poll(
+            lambda: int(tbl.read_row(15)["events"]) == 7)
+        assert int(tbl.read_row(0)["events"]) == 13
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_order_preserved_across_grow_and_migration_racing_delivery():
+    """The fence interlock: elastic table growth and a live row
+    migration land MID-STORM between delivery rounds — every consumer
+    still hears its events in token order (``ok`` stays 1)."""
+    fabric, (silo,) = _build_silos(1, n_dense=32)
+    await silo.start()
+    client = await ClusterClient(fabric).connect()
+    try:
+        rt = silo.vector
+        tbl = rt.table(FeedVec)
+        # hashed-regime residents of the SAME class: their live
+        # migration swaps state rows under the tick fence the stream
+        # deliveries also take
+        hashed = [10**12 + i * 104729 for i in range(6)]
+        for k in hashed:
+            rt.call(FeedVec, k, "on_next", v=np.float32(0.0))
+        await rt.flush()
+        provider = silo.stream_providers["device"]
+        await provider.subscribe_keys("race", FeedVec, np.arange(32))
+        stream = StreamId("device", "race", "r")
+        n_events = 24
+        for t in range(n_events):
+            await provider.produce(stream, [{"v": np.float32(t + 1)}])
+            if t == 6:
+                tbl.grow(tbl.capacity * 2)  # elastic reshard, fenced
+            if t == 12:
+                dests = [(tbl.key_to_slot[k][0] + 1) % tbl.n_shards
+                         for k in hashed]
+                tbl.move_rows(hashed, dests)  # live migration, fenced
+            await asyncio.sleep(0)
+        assert await _poll(
+            lambda: int(tbl.read_row(31)["events"]) == n_events,
+            timeout=10.0)
+        for k in range(32):
+            row = tbl.read_row(k)
+            assert int(row["events"]) == n_events, k
+            assert int(row["ok"]) == 1, f"key {k} saw reordered events"
+            assert float(row["last"]) == float(n_events)
+        # the migrated hashed rows kept their state across the move
+        for k in hashed:
+            assert int(tbl.read_row(k)["events"]) == 1
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+# ---------------------------------------------------------------------------
+# The A/B lever: device_fanout=False restores the per-consumer path
+# ---------------------------------------------------------------------------
+
+async def _persistent_run(device_fanout: bool):
+    """Drive identical bulk items through the PERSISTENT provider with
+    the lever on/off; return every row's full state."""
+    from orleans_tpu.streams import MemoryQueueAdapter, add_persistent_streams
+    from orleans_tpu.streams.pubsub import implicit_stream_subscription
+
+    @implicit_stream_subscription("lever")
+    class LeverVec(VectorGrain):
+        STATE = {"events": (jnp.int32, ()), "total": (jnp.float32, ())}
+
+        @staticmethod
+        def initial_state(key_hash):
+            return {"events": jnp.int32(0), "total": jnp.float32(0)}
+
+        @actor_method(args={"v": (jnp.float32, ())})
+        def on_next(state, args):
+            return {"events": state["events"] + 1,
+                    "total": state["total"] + args["v"]}, state["events"]
+
+    fabric = InProcFabric()
+    b = (SiloBuilder().with_name("lv").with_fabric(fabric)
+         .with_config(response_timeout=5.0,
+                      stream_device_fanout=device_fanout))
+    add_vector_grains(b, LeverVec, mesh=make_mesh(1),
+                      capacity_per_shard=64, dense={LeverVec: 32})
+    add_persistent_streams(b, "queue", MemoryQueueAdapter(n_queues=1),
+                           pull_period=0.02)
+    silo = b.build()
+    await silo.start()
+    try:
+        provider = silo.stream_providers["queue"]
+        stream = StreamId("queue", "lever", "s")
+        keys = np.arange(32)
+        await provider.produce(stream, [
+            {"keys": keys, "args": {"v": np.arange(32, dtype=np.float32)}},
+            {"keys": keys, "args": {"v": np.ones(32, np.float32)}}])
+        tbl = silo.vector.table(LeverVec)
+        assert await _poll(lambda: tbl.dense_active[31]
+                           and int(tbl.read_row(31)["events"]) == 2)
+        rows = {k: {f: np.asarray(v).tobytes()
+                    for f, v in tbl.read_row(k).items()}
+                for k in range(32)}
+        routed_device = getattr(silo.vector, "last_stream_group", 0) > 0
+        return rows, routed_device
+    finally:
+        await silo.stop()
+
+
+async def test_device_fanout_lever_off_is_bit_for_bit():
+    on_rows, on_device = await _persistent_run(True)
+    off_rows, off_device = await _persistent_run(False)
+    assert on_device and not off_device  # the lever actually switched
+    assert on_rows == off_rows  # byte-identical state either way
+
+
+# ---------------------------------------------------------------------------
+# Pool discipline + QoS across the wire
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def debug_pool():
+    from orleans_tpu.core.message import set_debug_pool
+    set_debug_pool(True)
+    yield
+    set_debug_pool(False)
+
+
+async def test_debug_pool_full_publish_broadcast_consume_path(debug_pool):
+    """ORLEANS_TPU_DEBUG_POOL through the whole pipeline: a recycled
+    envelope touched after release anywhere in publish -> peer
+    __stream_deliver__ -> broadcast -> consumer raises immediately."""
+    fabric, silos = _build_silos(2, n_dense=64)
+    for s in silos:
+        await s.start()
+    client = await ClusterClient(fabric).connect()
+    try:
+        provider = silos[0].stream_providers["device"]
+        await provider.subscribe_keys("pool", FeedVec, np.arange(64))
+        stream = StreamId("device", "pool", "p")
+        for t in range(3):
+            await provider.produce(stream, [{"v": np.float32(t)}])
+        assert await _poll(
+            lambda: all(_events(silos, k) == 3 for k in (0, 31, 63)),
+            timeout=10.0)
+        assert all(_events(silos, k) == 3 for k in range(64))
+    finally:
+        await client.close_async()
+        for s in silos:
+            await s.stop()
+
+
+async def test_stream_delivery_rides_application_category_only():
+    """The QoS invariant: every cross-silo stream delivery envelope is
+    APPLICATION — PING/SYSTEM lanes never carry a delivery batch."""
+    from orleans_tpu.core.message import Category
+    fabric, silos = _build_silos(2, n_dense=64)
+    seen = []
+    real_deliver, real_group = fabric.deliver, fabric.deliver_group
+
+    def spy_deliver(msg):
+        seen.append((msg.category, msg.method_name))
+        return real_deliver(msg)
+
+    def spy_group(target, msgs):
+        for m in msgs:
+            seen.append((m.category, m.method_name))
+        return real_group(target, msgs)
+
+    fabric.deliver, fabric.deliver_group = spy_deliver, spy_group
+    for s in silos:
+        await s.start()
+    client = await ClusterClient(fabric).connect()
+    try:
+        provider = silos[0].stream_providers["device"]
+        await provider.subscribe_keys("qos", FeedVec, np.arange(64))
+        stream = StreamId("device", "qos", "q")
+        await provider.produce(stream, [{"v": np.float32(1.0)}])
+        assert await _poll(
+            lambda: all(_events(silos, k) == 1 for k in range(64)),
+            timeout=10.0)
+        deliveries = [(cat, m) for cat, m in seen
+                      if m == "__stream_deliver__"]
+        assert deliveries  # 64 ring-split keys -> a remote slice exists
+        assert all(cat == Category.APPLICATION for cat, _ in deliveries)
+        # and the protected lanes stayed clean of stream payloads
+        assert not any("stream" in str(m)
+                       for cat, m in seen
+                       if cat in (Category.PING, Category.SYSTEM))
+    finally:
+        await client.close_async()
+        for s in silos:
+            await s.stop()
+
+
+# ---------------------------------------------------------------------------
+# Server-armed join_when
+# ---------------------------------------------------------------------------
+
+async def test_join_when_server_armed_watch():
+    """One ``__bulk_join__`` envelope arms the anchor's poll loop: the
+    met answer returns in O(1) client envelopes (not one per poll), and
+    lease expiry surfaces as an honest client-side TimeoutError."""
+    fabric, (silo,) = _build_silos(1, n_dense=16)
+    await silo.start()
+    client = await ClusterClient(fabric).connect()
+    try:
+        provider = silo.stream_providers["device"]
+        await provider.subscribe_keys("join", FeedVec, np.arange(16))
+        stream = StreamId("device", "join", "j")
+
+        # not ready yet -> the watch expires its (timeout-clamped)
+        # lease, answers met=False, and the client raises at deadline
+        with pytest.raises(asyncio.TimeoutError):
+            await client.join_when(FeedVec, list(range(16)),
+                                   method="ready", timeout=0.4)
+        assert silo.stats.get("vector.join.watches") >= 1
+
+        base = silo.stats.get("messaging.received.application")
+        task = asyncio.ensure_future(
+            client.join_when(FeedVec, list(range(16)), method="ready",
+                             timeout=10.0))
+        await asyncio.sleep(0.1)  # the watch is armed and polling
+        for t in range(3):  # readiness: events >= 3
+            await provider.produce(stream, [{"v": np.float32(t)}])
+        assert await asyncio.wait_for(task, 10.0) == 16
+        # the wait spanned dozens of server-side polls but cost O(1)
+        # client envelopes (arm + answer), not one per poll
+        assert silo.stats.get("messaging.received.application") \
+            - base <= 3
+    finally:
+        await client.close_async()
+        await silo.stop()
+
+
+async def test_join_when_client_loop_still_available():
+    """``server=False`` restores the per-poll client loop (the legacy
+    surface the server-armed watch replaced as default)."""
+    fabric, (silo,) = _build_silos(1, n_dense=8)
+    await silo.start()
+    client = await ClusterClient(fabric).connect()
+    try:
+        provider = silo.stream_providers["device"]
+        await provider.subscribe_keys("joinc", FeedVec, np.arange(8))
+        stream = StreamId("device", "joinc", "j")
+        for t in range(3):
+            await provider.produce(stream, [{"v": np.float32(t)}])
+        got = await client.join_when(FeedVec, list(range(8)),
+                                     method="ready", timeout=5.0,
+                                     server=False)
+        assert got == 8
+    finally:
+        await client.close_async()
+        await silo.stop()

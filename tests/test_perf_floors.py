@@ -591,3 +591,32 @@ async def test_floor_map_actors():
     assert ratio >= MAP_ACTORS_FLOOR, \
         f"bulk fan-out only {ratio:.2f}x of message-per-edge at " \
         f"fan-out 64 (floor {MAP_ACTORS_FLOOR}x)"
+
+
+# Device-stream fan-out A/B ratio floor (ISSUE 16 acceptance): the
+# DeviceStreamProvider's compiled edge-list delivery vs one RPC per
+# (event, subscriber) on identical edge traffic at fan-out >= 64.
+# Measured ~8-10x in-proc (BENCH_r16); 3x is the acceptance criterion
+# with a wide noise band — a regression that turns the provider back
+# into per-subscriber delivery (a lost fused edge list, per-item
+# dispatch, per-subscriber envelopes) collapses it.
+DEVICE_STREAM_FLOOR = 3.0
+
+
+async def test_floor_device_streams():
+    from benchmarks.chirper_fanout import run_ab_device
+
+    async def once():
+        # run_ab_device is itself best-of-two per side with per-side
+        # gc.collect()+freeze() (the ping-floor A/B discipline lives in
+        # the bench)
+        r = await run_ab_device(n_subscribers=64, n_events=16, batch=4,
+                                repeats=2)
+        assert r["extra"]["fan_out"] >= 64
+        return r["value"]
+    ratio = await once()
+    if ratio < DEVICE_STREAM_FLOOR * 1.5:
+        ratio = max(ratio, await once())  # noise guard: best of two
+    assert ratio >= DEVICE_STREAM_FLOOR, \
+        f"device stream fan-out only {ratio:.2f}x of per-subscriber " \
+        f"delivery at fan-out 64 (floor {DEVICE_STREAM_FLOOR}x)"

@@ -229,7 +229,7 @@ def test_default_specs_without_metrics_is_probe_only():
     assert [s.name for s in default_specs(SiloConfig())] == ["probe_rtt"]
     names = [s.name for s in default_specs(SiloConfig(metrics_enabled=True))]
     assert names == ["app_latency", "probe_rtt", "turn_errors",
-                     "shed_rate"]
+                     "shed_rate", "stream_latency"]
 
 
 def test_slo_spec_and_options_validation():
