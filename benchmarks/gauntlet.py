@@ -39,6 +39,7 @@ if __package__ in (None, ""):
     import sys
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+from orleans_tpu.management import ManagementGrain, add_management
 from orleans_tpu.membership import InMemoryMembershipTable, join_cluster
 from orleans_tpu.observability.stats import SLO_STATS, Histogram
 from orleans_tpu.runtime import Grain, SiloBuilder
@@ -87,9 +88,12 @@ _FAST_LIVENESS = dict(
 )
 
 
-async def _start_silo(name: str, fabric, grains, table=None, **cfg):
+async def _start_silo(name: str, fabric, grains, table=None,
+                      management=False, **cfg):
     b = (SiloBuilder().with_name(name).with_fabric(fabric)
          .add_grains(*grains).with_config(**cfg))
+    if management:
+        add_management(b)
     silo = b.build()
     if table is not None:
         join_cluster(silo, table)
@@ -349,29 +353,56 @@ async def flash_crowd(seconds: float = 4.0, base_workers: int = 4,
     }
 
 
+def _hk_tenant(label: str) -> str | None:
+    """The hot-key scenario's tenancy model: grain key → tenant ring of
+    4 (the ``ledger_tenant_of`` hook a real deployment would point at
+    its tenant directory)."""
+    try:
+        return f"tenant-{int(label.rsplit('/', 1)[1]) % 4}"
+    except (ValueError, IndexError):
+        return None
+
+
 async def hot_key(seconds: float = 3.0, workers: int = 16,
                   n_grains: int = 64, zipf_a: float = 1.2,
                   short: bool = False,
                   threshold: float = 0.02) -> dict:
-    """Zipf hot-key skew over a grain population with a real per-call
-    cost: the hot key's mailbox serializes and its queue-wait burns the
-    latency budget while aggregate throughput stays healthy. Expected:
-    app_latency breached, and the call-site table names the victim."""
+    """Zipf hot-key skew against a 2-silo membership cluster with the
+    cost ledger armed: the hot key's mailbox serializes and its
+    queue-wait burns the latency budget while aggregate throughput
+    stays healthy. Expected: app_latency breached, and the BREACH
+    DRILL-DOWN NAMES the burner — ``get_cluster_ledger``'s
+    deterministic sketch merge surfaces the hot key and its tenant
+    (``worst_burner`` / ``worst_tenant``) — while the QoS invariant
+    holds (probe SLI ≥ 0.9, zero false suspicion votes, membership
+    stable)."""
     if short:
-        seconds = min(seconds, 1.8)
+        # the drive must OUTLAST the slow burn window (2s): the breach
+        # transition needs both windows saturated, so a shorter drive
+        # races the final evaluation tick
+        seconds = min(seconds, 2.6)
         workers = min(workers, 12)
     import numpy as np
 
     fabric = SocketFabric()
-    silo = await _start_silo("gnt-hk", fabric, (WorkGrain,),
-                             **_slo_cfg(threshold=threshold),
-                             response_timeout=10.0)
+    table = InMemoryMembershipTable()
+    cfg = dict(_FAST_LIVENESS, **_slo_cfg(threshold=threshold),
+               response_timeout=10.0, ledger_enabled=True,
+               ledger_top_k=16, ledger_tenant_of=_hk_tenant)
+    s1 = await _start_silo("gnt-hk1", fabric, (WorkGrain,), table,
+                           management=True, **cfg)
+    s2 = await _start_silo("gnt-hk2", fabric, (WorkGrain,), table,
+                           management=True, **cfg)
     client = await GatewayClient(
-        [silo.silo_address.endpoint], response_timeout=10.0).connect()
+        [s1.silo_address.endpoint], response_timeout=10.0).connect()
     calls = 0
     try:
         refs = [client.get_grain(WorkGrain, k) for k in range(n_grains)]
-        await asyncio.gather(*(refs[k].work(0) for k in range(n_grains)))
+        # chunked warmup (flash_crowd discipline): activation placement
+        # fans across both silos, so the ledger merge below genuinely
+        # folds two per-silo sketches
+        for i in range(0, n_grains, 16):
+            await asyncio.gather(*(g.work(0) for g in refs[i:i + 16]))
         # Zipf-ranked key distribution: p(k) ∝ 1/(k+1)^a, rank 0 hottest
         p = 1.0 / np.power(np.arange(1, n_grains + 1, dtype=np.float64),
                            zipf_a)
@@ -393,14 +424,27 @@ async def hot_key(seconds: float = 3.0, workers: int = 16,
 
         await asyncio.gather(*(worker(w) for w in range(workers)))
         elapsed = time.perf_counter() - t0
-        verdicts = _verdicts((silo,), overload_start=time.monotonic() -
+        verdicts = _verdicts((s1, s2), overload_start=time.monotonic() -
                              elapsed)
-        top_sites = (silo.call_sites.top(3)
-                     if silo.call_sites is not None else [])
+        top_sites = (s1.call_sites.top(3)
+                     if s1.call_sites is not None else [])
         app = verdicts.get("app_latency", {})
+        # the drill-down: cluster-merged cost ledger names WHO burned
+        mgmt = client.get_grain(ManagementGrain, 0)
+        ledger = await mgmt.get_cluster_ledger(10)
+        worst = ledger.get("worst_burner") or {}
+        worst_tenant = ledger.get("worst_tenant") or {}
+        # QoS invariant (the flash_crowd gate, under skew instead of
+        # a step): probes bounded, no false suspicions, both active
+        probe_bound = cfg["membership_probe_timeout"]
+        probe_p99, probe_fast_frac = _probe_rtt((s1, s2), probe_bound)
+        votes = await _suspicion_votes(table)
+        both_active = all(
+            len(s.membership.active) == 2 for s in (s1, s2))
     finally:
         await client.close_async()
-        await silo.stop()
+        await s2.stop()
+        await s1.stop()
     return {
         "metric": "gauntlet_hot_key_burn",
         "value": app.get("burn_fast", 0.0),
@@ -414,6 +458,20 @@ async def hot_key(seconds: float = 3.0, workers: int = 16,
             "app_slo_breached": bool(app.get("breached")),
             "time_to_detect": app.get("time_to_detect"),
             "top_call_sites": top_sites,
+            "ledger_worst_burner": worst,
+            "ledger_worst_tenant": worst_tenant,
+            "ledger_names_hot_key": worst.get("key") == "WorkGrain/0",
+            "ledger_names_tenant":
+                worst_tenant.get("tenant") == _hk_tenant("WorkGrain/0"),
+            "probe_rtt_p99_s": probe_p99,
+            "probe_rtt_fast_fraction": probe_fast_frac,
+            "probe_rtt_bound_s": probe_bound,
+            "false_suspicions": votes,
+            "membership_stable": both_active,
+            "qos_invariant_held": bool(
+                both_active and votes == 0
+                and probe_fast_frac is not None
+                and probe_fast_frac >= 0.9),
         },
     }
 

@@ -42,7 +42,8 @@ async def bench_host_tier(n_grains: int, concurrency: int,
                           tail: bool = False,
                           metrics: bool = False,
                           profiling: bool = False,
-                          slo: bool = False) -> dict:
+                          slo: bool = False,
+                          ledger: bool = False) -> dict:
     """``trace_sample``: None runs untraced (no collector installed);
     a float enables distributed tracing at that head-sampling rate — the
     overhead-tracking variant wired into run_all and the perf floor.
@@ -54,7 +55,10 @@ async def bench_host_tier(n_grains: int, concurrency: int,
     enables the live metrics pipeline — ingest stage instrumentation on
     every message plus the queue/backpressure sampler loop (fast period
     so it actually ticks during the run) — the A/B lever for the
-    metrics-overhead floor."""
+    metrics-overhead floor. ``ledger=True`` enables the cost-attribution
+    ledger alone (no metrics registry sampling) — the A/B lever for the
+    ledger-overhead floor: every turn pays the charge_turn upsert +
+    sketch add."""
     import gc
 
     # settled-heap start for every A/B pair built on this harness (the
@@ -73,14 +77,14 @@ async def bench_host_tier(n_grains: int, concurrency: int,
     try:
         return await _bench_host_tier_frozen(
             n_grains, concurrency, seconds, trace_sample, hot_lane,
-            tail, metrics, profiling, slo)
+            tail, metrics, profiling, slo, ledger)
     finally:
         gc.unfreeze()
 
 
 async def _bench_host_tier_frozen(n_grains, concurrency, seconds,
                                   trace_sample, hot_lane, tail, metrics,
-                                  profiling, slo) -> dict:
+                                  profiling, slo, ledger=False) -> dict:
     b = (SiloBuilder().with_name("ping-silo").add_grains(EchoGrain)
          .with_config(hot_lane_enabled=hot_lane))
     if trace_sample is not None:
@@ -97,6 +101,8 @@ async def _bench_host_tier_frozen(n_grains, concurrency, seconds,
                           slo_fast_window=0.5, slo_slow_window=2.0)
     if profiling:
         b = b.with_config(profiling_enabled=True, profiling_window=0.25)
+    if ledger:
+        b = b.with_config(ledger_enabled=True, ledger_top_k=32)
     silo = b.build()
     await silo.start()
     client = await ClusterClient(silo.fabric).connect()
@@ -136,6 +142,7 @@ async def _bench_host_tier_frozen(n_grains, concurrency, seconds,
     return {
         "metric": ("ping_host_profiled_calls_per_sec" if profiling
                    else "ping_host_slo_calls_per_sec" if slo
+                   else "ping_host_ledgered_calls_per_sec" if ledger
                    else "ping_host_metered_calls_per_sec" if metrics
                    else "ping_host_calls_per_sec" if trace_sample is None
                    else "ping_host_tail_traced_calls_per_sec" if tail
@@ -263,6 +270,33 @@ async def bench_metrics_overhead(n_grains: int = 128, concurrency: int = 50,
         "extra": {
             "bare_calls_per_sec": base["value"],
             "metered_calls_per_sec": metered["value"],
+            "n_grains": n_grains, "concurrency": concurrency,
+        },
+    }
+
+
+async def bench_ledger_overhead(n_grains: int = 128, concurrency: int = 50,
+                                seconds: float = 1.5) -> dict:
+    """ledger_overhead: the cost-attribution ledger (per-turn
+    charge_turn — one dict upsert + two bounded sketch adds — with the
+    metrics registry OFF, its production shape) vs a bare silo, as a
+    ratio. Floor companion:
+    tests/test_perf_floors.py::test_floor_ledger_overhead (>= 0.85).
+
+    Both sides run with the hot lane off, like the metrics floor: the
+    dispatcher epilogue the charge rides must actually execute."""
+    base = await bench_host_tier(n_grains, concurrency, seconds,
+                                 hot_lane=False)
+    ledgered = await bench_host_tier(n_grains, concurrency, seconds,
+                                     hot_lane=False, ledger=True)
+    return {
+        "metric": "ledger_overhead",
+        "value": round(ledgered["value"] / base["value"], 3),
+        "unit": "ratio (ledgered / bare)",
+        "vs_baseline": None,
+        "extra": {
+            "bare_calls_per_sec": base["value"],
+            "ledgered_calls_per_sec": ledgered["value"],
             "n_grains": n_grains, "concurrency": concurrency,
         },
     }

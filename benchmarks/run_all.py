@@ -18,6 +18,7 @@ from benchmarks import (
     gauntlet,
     gpstracker_stream,
     ingest_attribution,
+    ledger_attribution,
     loop_attribution,
     mxu_handler,
     mapreduce,
@@ -115,6 +116,16 @@ def main() -> None:
     # burn-rate evaluation rides snapshot diffs; CI floor 0.85)
     print(json.dumps(asyncio.run(ping.bench_slo_overhead(
         n_grains=128, concurrency=50, seconds=1.5))))
+    # cost-ledger overhead as a ratio vs a bare silo (ISSUE 17:
+    # per-turn charge + sketch update on every message; CI floor 0.85)
+    print(json.dumps(asyncio.run(ping.bench_ledger_overhead(
+        n_grains=128, concurrency=50, seconds=1.5))))
+    # cost-attribution accuracy (ISSUE 17): Zipf-skewed 2-silo drive
+    # scored against client-side ground truth — does the merged cluster
+    # ledger name the hot key / hot tenant, and what fraction of the
+    # host bill do the bounded top-k burners explain?
+    print(json.dumps(asyncio.run(ledger_attribution.run(
+        seconds=2.0, concurrency=32))))
     # traffic-shape gauntlet (ISSUE 12): flash crowd / hot-key Zipf /
     # diurnal ramp / churn storm over real TCP, each emitting SLO
     # VERDICTS (objective met/breached, burn rates, budget burned,

@@ -95,10 +95,12 @@ RELEASERS = {
 
 # loop-confined observability classes and their mutating surface
 REGISTRY_CLASSES = {"StatsRegistry", "Histogram", "QueueWaitTrend",
-                    "SpanCollector", "CallSiteStats"}
+                    "SpanCollector", "CallSiteStats", "CostLedger"}
 # distinctive enough to flag on ANY receiver (these names are only used
 # as registry writes in this tree); see also _TYPED_WRITES
-UNTYPED_WRITES = {"observe", "increment", "set_gauge", "exemplar", "note"}
+UNTYPED_WRITES = {"observe", "increment", "set_gauge", "exemplar", "note",
+                  "charge_turn", "charge_tick", "charge_wire",
+                  "charge_stream"}
 # generic names: flagged only when the receiver's class is inferred
 TYPED_WRITES = {"record", "histogram", "histogram_with", "force_retain",
                 "mark_remote", "presampled", "pull", "merge"}
@@ -109,7 +111,7 @@ _LOOP_CB_APIS = {"call_soon_threadsafe": 0, "call_soon": 0, "call_at": 1,
                  "run_until_complete": 0}
 
 # donated device state on fence-owning receivers (the PR-9 protocol)
-PROTECTED_ATTRS = {"state", "hits"}
+PROTECTED_ATTRS = {"state", "hits", "cost"}
 
 # Grain base-class methods that are NOT remote interface (mirrors
 # runtime.grain._GRAIN_BASE_METHODS without importing the runtime)

@@ -26,7 +26,7 @@ __all__ = [
     "GrainCollectionOptions", "MembershipOptions", "DirectoryOptions",
     "LoadSheddingOptions", "DispatchOptions", "RebalanceOptions",
     "TracingOptions", "MetricsOptions", "ProfilingOptions", "SloOptions",
-    "StreamOptions",
+    "StreamOptions", "LedgerOptions",
     "flatten", "apply_options", "validate_options", "log_options",
 ]
 
@@ -224,6 +224,11 @@ class RebalanceOptions:
     period: float = 0.0            # seconds between rounds; 0 disables
     budget: int = 8                # max migrations per round (both tiers)
     imbalance_ratio: float = 1.2   # rebalance only when hot > ratio * mean
+    # consume the cost ledger's host-tier hot-actor candidates (ISSUE 17):
+    # a grain whose charged seconds run hot against the per-key mean gets
+    # a migration plan even when activation COUNTS are balanced — the
+    # load signal counts alone cannot see. Requires ledger_enabled.
+    use_ledger: bool = False
 
     def validate(self) -> None:
         _positive(self, "budget")
@@ -427,6 +432,33 @@ class StreamOptions:
 
 
 @dataclass
+class LedgerOptions:
+    """Cost-attribution ledger (observability.ledger — ISSUE 17): when
+    ``enabled`` the silo charges every unit of work to (grain_class,
+    method) × hashed-key × tenant — host-turn exec/queue seconds, device
+    row-seconds, wire bytes per route, stream deliveries — with the
+    per-key and per-tenant dimensions bounded by ``top_k`` space-saving
+    sketches (exact class totals + overflow counter, deterministic
+    cluster merge via ``ManagementGrain.get_cluster_ledger``).
+    ``tenant_of`` maps a charge label ("Class/key") to its tenant;
+    host-turn charges also read the caller's ``orleans.tenant``
+    RequestContext baggage. OFF (default): ``silo.ledger`` is None and
+    every charge site pays one attribute check — the A/B lever
+    ``ping.bench_ledger_overhead`` floors."""
+
+    enabled: bool = False
+    top_k: int = 32
+    tenant_of: object = None   # Callable[[str], str | None] | None
+
+    def validate(self) -> None:
+        _positive(self, "top_k")
+        if self.tenant_of is not None and not callable(self.tenant_of):
+            raise ConfigurationError(
+                f"ledger tenant_of must be callable or None, got "
+                f"{self.tenant_of!r}")
+
+
+@dataclass
 class DispatchOptions:
     """TPU vector-dispatch tier (no reference analog — the batched engine's
     knobs): per-shard slot-pool capacity and exchange lane capacity."""
@@ -483,6 +515,7 @@ _FLAT_MAP = {
     "rebalance_period": (RebalanceOptions, "period"),
     "rebalance_budget": (RebalanceOptions, "budget"),
     "rebalance_imbalance_ratio": (RebalanceOptions, "imbalance_ratio"),
+    "rebalance_use_ledger": (RebalanceOptions, "use_ledger"),
     "trace_enabled": (TracingOptions, "enabled"),
     "trace_sample_rate": (TracingOptions, "sample_rate"),
     "trace_buffer_size": (TracingOptions, "buffer_size"),
@@ -518,6 +551,9 @@ _FLAT_MAP = {
     "stream_device_fanout": (StreamOptions, "device_fanout"),
     "stream_device_cache_capacity": (StreamOptions,
                                      "device_cache_capacity"),
+    "ledger_enabled": (LedgerOptions, "enabled"),
+    "ledger_top_k": (LedgerOptions, "top_k"),
+    "ledger_tenant_of": (LedgerOptions, "tenant_of"),
     "profiling_enabled": (ProfilingOptions, "enabled"),
     "profiling_window": (ProfilingOptions, "window"),
     "profiling_ring": (ProfilingOptions, "ring"),

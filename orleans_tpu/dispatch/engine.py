@@ -49,6 +49,10 @@ _STAGING = _INGEST["staging"]
 _TRANSFER = _INGEST["transfer"]
 _TICK = _INGEST["tick"]
 _MESSAGES = _INGEST["messages"]
+# worker-side ledger stamp (cost attribution, observability.ledger): the
+# payload rides the job's deferred-stats list and replays loop-side in
+# _complete_job — the CostLedger is loop-confined like the registries
+_LEDGER = object()
 
 log = logging.getLogger("orleans.vector")
 
@@ -338,6 +342,13 @@ class VectorRuntime:
         # device execution + host materialize) histograms — the device
         # half of the socket->tick ingest attribution
         self.stats = None
+        # cost-attribution ledger (observability.ledger), set by
+        # dispatch.hosting when the owning silo runs ledger_enabled: the
+        # batch epilogue charges rows × tick wall to the (class, method)
+        # row and the per-key sketch; track_cost mirrors track_load for
+        # the on-device per-slot cost twin (table.record_cost)
+        self.ledger = None
+        self.track_cost = False
         # host-loop occupancy profiler (observability.profiling), set by
         # the owning silo when profiling_enabled: each tick callback is
         # segmented into tick_schedule / tick_staging / tick_transfer /
@@ -449,6 +460,8 @@ class VectorRuntime:
                 self.tables[cls].fence = self._fence
                 if self.track_load:
                     self.tables[cls].enable_hit_tracking()
+                if self.track_cost:
+                    self.tables[cls].enable_cost_tracking()
 
     def table(self, cls: type) -> ShardedActorTable:
         if cls not in self.tables:
@@ -573,6 +586,12 @@ class VectorRuntime:
         self.track_load = True
         for tbl in self.tables.values():
             tbl.enable_hit_tracking()
+
+    # -- per-slot cost telemetry (consumed by observability.ledger) ------
+    def enable_cost_tracking(self) -> None:
+        self.track_cost = True
+        for tbl in self.tables.values():
+            tbl.enable_cost_tracking()
 
     def queue_depth(self) -> int:
         """Invocations queued for future ticks (incl. conflict-deferred
@@ -784,6 +803,12 @@ class VectorRuntime:
                     if key is None:
                         if trend is not None:
                             trend.note(val)
+                    elif key is _LEDGER:
+                        # NOT metrics-gated: the ledger runs with the
+                        # stats registry off (sanctioned replay — the
+                        # worker stamped, the loop charges)
+                        if self.ledger is not None:
+                            self.ledger.charge_tick(val)
                     elif st is None:
                         continue
                     elif key is _MESSAGES:
@@ -929,6 +954,7 @@ class VectorRuntime:
         ``span_timing`` is ``(name, wall_start, duration)`` for a sampled
         tick (recorded by the caller on the loop) or None."""
         st = self.stats
+        led = self.ledger
         if lp is not None:
             # loop occupancy: staging-fill from here; the label tuple
             # names this batch in the flight recorder's top-K and is only
@@ -1016,6 +1042,8 @@ class VectorRuntime:
             if st is not None:
                 t_tick = time.perf_counter()
                 _emit(sink, st, _TRANSFER, t_tick - t_xfer)
+            elif led is not None:
+                t_tick = time.perf_counter()  # ledger-only tick wall start
             if trace_roll:
                 span_name = f"tick {cls.__name__}.{method}"
                 span_start = time.time()
@@ -1082,6 +1110,23 @@ class VectorRuntime:
                 sink.append((_MESSAGES, len(ready)))
             else:
                 st.increment(_MESSAGES, len(ready))
+        if led is not None:
+            # cost-attribution epilogue: every resident row is charged
+            # this tick's wall (row-seconds = rows × wall); the per-slot
+            # device twin folds the same batch via record_cost (the
+            # _accumulate_hits scatter with the µs charge as scale).
+            # Worker path stamps the payload for loop-side replay —
+            # same discipline as the stage observations above.
+            tick_s = max(0.0, time.perf_counter() - t_tick)
+            payload = (cls.__name__, method, len(ready), tick_s,
+                       tuple(f"{cls.__name__}#{p.key_hash}"
+                             for p in ready))
+            if sink is not None:
+                sink.append((_LEDGER, payload))
+            else:
+                led.charge_tick(payload)
+            if self.track_cost:
+                tbl.record_cost(slots, valid, int(tick_s * 1e6))
         span = None
         if trace_roll and span_name is not None:
             # duration closes AFTER the host transfer: closing at kernel
@@ -1172,6 +1217,8 @@ class VectorRuntime:
                 jnp.asarray(plan.pack(np.asarray(args[fname]), dtype, shape)))
         kern = self._kernel(grain_class, method, plan.B,
                             contiguous=self._plan_contiguous(tbl, plan))
+        led = self.ledger
+        t_led = time.perf_counter() if led is not None else 0.0
         # tick fence: the bulk path is its own tick on the CALLER's
         # thread — it must not read (or commit over) tbl.state while an
         # off-loop worker batch has it donated mid-dispatch
@@ -1183,6 +1230,15 @@ class VectorRuntime:
                 self._mark_dirty(grain_class, plan.keys)
         if self.track_load:
             tbl.record_hits(d_slots, d_valid)
+        if led is not None:
+            # bulk ticks charge dispatch wall (loop-side, synchronous
+            # caller) with no per-key labels — labeling a 1M-key bulk
+            # tick would cost more than the tick; per-key detail for the
+            # bulk regime lives in the on-device per-slot cost twin
+            wall = max(0.0, time.perf_counter() - t_led)
+            led.charge_tick((grain_class.__name__, method, M, wall, ()))
+            if self.track_cost:
+                tbl.record_cost(d_slots, d_valid, int(wall * 1e6))
         self.ticks += 1
         self.messages_processed += M
         if device_results:
@@ -1263,6 +1319,8 @@ class VectorRuntime:
             # an unmasked write there could corrupt a hashed activation's
             # slot beyond the dense range
             all_valid=bool(plan.valid_b.all()))
+        led = self.ledger
+        t_led = time.perf_counter() if led is not None else 0.0
         with self._fence:  # see call_batch: bulk ticks serialize with
             # the off-loop worker's donated in-flight batches
             new_state, results = kern(
@@ -1272,6 +1330,15 @@ class VectorRuntime:
                 self._mark_dirty(grain_class, plan.keys)
         if self.track_load:
             tbl.record_hits(d_slots, d_valid, scale=K)
+        if led is not None:
+            # the wall already spans all K scanned rounds, so the µs
+            # charge needs no scale=K (unlike the per-round hit counts)
+            wall = max(0.0, time.perf_counter() - t_led)
+            led.charge_tick(
+                (grain_class.__name__, method, K * M, wall / max(1, K),
+                 ()))
+            if self.track_cost:
+                tbl.record_cost(d_slots, d_valid, int(wall * 1e6))
         self.ticks += K
         self.messages_processed += K * M
         if device_results:
@@ -1308,6 +1375,8 @@ class VectorRuntime:
         tbl = self.table(grain_class)
         m = self.method_of(grain_class, method)
         B = slots_b.shape[1]
+        led = self.ledger
+        t_led = time.perf_counter() if led is not None else 0.0
         with self._fence:  # see call_batch: serialize with off-loop ticks
             new_state, results = self._kernel(grain_class, method, B)(
                 tbl.state, slots_b, khash_b, fresh_b, valid_b, args_b)
@@ -1317,6 +1386,16 @@ class VectorRuntime:
             # device-resident masks fold without a host sync — the
             # telemetry stays all-device exactly like the exchange flow
             tbl.record_hits(slots_b, valid_b)
+        if led is not None:
+            # rows = all lanes (a device-resident valid mask must not be
+            # host-synced just to count); per-slot precision comes from
+            # record_cost, whose masked scatter stays all-device too
+            wall = max(0.0, time.perf_counter() - t_led)
+            led.charge_tick(
+                (grain_class.__name__, method, int(slots_b.shape[0] * B),
+                 wall, ()))
+            if self.track_cost:
+                tbl.record_cost(slots_b, valid_b, int(wall * 1e6))
         self.ticks += 1
         if isinstance(valid_b, np.ndarray):
             self.messages_processed += int(valid_b.sum())

@@ -74,6 +74,11 @@ def add_vector_grains(builder, *grain_classes: type[VectorGrain],
             # device-tier queue-wait feeds the same load-shed trend the
             # host turns feed (vector-heavy overload sheds too)
             silo.vector.shed_trend = silo.shed_trend
+        if silo.ledger is not None:
+            # cost attribution: batch epilogues charge the silo's ledger
+            # and the tables grow the on-device per-slot cost twin
+            silo.vector.ledger = silo.ledger
+            silo.vector.enable_cost_tracking()
         silo.vector.register(*grain_classes)
         for cls in grain_classes:
             silo.vector_interfaces[cls.__name__] = cls

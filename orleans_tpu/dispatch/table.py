@@ -123,6 +123,13 @@ class ShardedActorTable:
         # default (an extra scatter-add per tick is pure overhead unless a
         # rebalancer consumes it) — see enable_hit_tracking.
         self.hits: jax.Array | None = None
+        # cost attribution (observability.ledger, ISSUE 17): per-slot
+        # accumulated tick cost in MICROSECONDS, same [n_shards,
+        # capacity+1] layout / sink-row / donation / fence discipline as
+        # the hit counters (int32 µs holds ~35 minutes of charged wall
+        # per slot between reset_cost readouts). Off by default — see
+        # enable_cost_tracking.
+        self.cost: jax.Array | None = None
 
     # ------------------------------------------------------------------
     def _put(self, arr):
@@ -200,6 +207,64 @@ class ShardedActorTable:
         with self.fence:
             if self.hits is not None:
                 self.hits = self._put(
+                    jnp.zeros((self.n_shards, self.capacity + 1),
+                              jnp.int32))
+
+    # -- cost attribution (consumed by observability.ledger) --------------
+    # The hit-counter discipline verbatim (same donation, same fence —
+    # see the comment block above): the cost buffer is one more masked
+    # scatter-add folded into the tick, reusing _accumulate_hits with
+    # the per-row µs charge as the scale.
+    def enable_cost_tracking(self) -> None:
+        with self.fence:
+            if self.cost is None:
+                self.cost = self._put(
+                    jnp.zeros((self.n_shards, self.capacity + 1),
+                              jnp.int32))
+
+    def record_cost(self, slots_b, valid_b, cost_us: int) -> None:
+        """Fold one tick's [n_shards, B] batch into the per-slot cost
+        accumulators: every valid lane is charged ``cost_us``
+        microseconds (the tick wall — each resident row occupied the
+        whole tick). No-op until enable_cost_tracking; reentrant under
+        the engine fence like record_hits."""
+        with self.fence:
+            if self.cost is None or cost_us <= 0:
+                return
+            self.cost = _accumulate_hits(
+                self.cost, jnp.asarray(slots_b, jnp.int32),
+                jnp.asarray(valid_b), jnp.int32(cost_us))
+
+    def slot_cost(self) -> np.ndarray:
+        """Host copy of the per-slot cost µs [n_shards, capacity+1]
+        (ledger/planner-rate readout, not tick-rate)."""
+        with self.fence:
+            if self.cost is None:
+                return np.zeros((self.n_shards, self.capacity + 1),
+                                np.int32)
+            return np.asarray(self.cost)
+
+    def cost_seconds(self) -> float:
+        """Total charged row-seconds since the last reset, folded ON
+        DEVICE via ``ops.segment_reduce.masked_reduce`` (sink column
+        masked out) — ONE scalar crosses the host boundary, the DrJAX
+        masked-reduction shape the ledger's readout rides."""
+        with self.fence:
+            if self.cost is None:
+                return 0.0
+            from ..ops.segment_reduce import masked_reduce
+            valid = jnp.broadcast_to(
+                jnp.arange(self.capacity + 1) < self.capacity,
+                (self.n_shards, self.capacity + 1))
+            total = masked_reduce(self.cost, valid, "sum")
+            return float(np.asarray(total)) * 1e-6
+
+    def reset_cost(self) -> None:
+        """Zero the cost accumulators (int32-overflow protection, same
+        rationale as reset_hits)."""
+        with self.fence:
+            if self.cost is not None:
+                self.cost = self._put(
                     jnp.zeros((self.n_shards, self.capacity + 1),
                               jnp.int32))
 
@@ -349,6 +414,11 @@ class ShardedActorTable:
             moved_hits = self.hits[idx[0], idx[1]]
             self.hits = self.hits.at[idx[2], idx[3]].set(moved_hits) \
                 .at[idx[0], idx[1]].set(0)
+        if self.cost is not None:
+            # charged cost travels with the row too (same ghost rule)
+            moved_cost = self.cost[idx[0], idx[1]]
+            self.cost = self.cost.at[idx[2], idx[3]].set(moved_cost) \
+                .at[idx[0], idx[1]].set(0)
         self.state = new_state  # commit point
         for key, s_sh, s_sl, d_sh, d_sl in zip(
                 moved_keys, src_sh, src_sl, dst_sh, dst_sl):
@@ -410,6 +480,11 @@ class ShardedActorTable:
                                    jnp.int32)
             self.hits = self._put(
                 grown_hits.at[:, :old].set(self.hits[:, :old]))
+        if self.cost is not None:
+            grown_cost = jnp.zeros((self.n_shards, new_capacity + 1),
+                                   jnp.int32)
+            self.cost = self._put(
+                grown_cost.at[:, :old].set(self.cost[:, :old]))
         for s in range(self.n_shards):
             self.free[s] = list(range(new_capacity - 1, old - 1, -1)) + self.free[s]
         self.capacity = new_capacity

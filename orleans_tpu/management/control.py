@@ -195,6 +195,11 @@ class SiloControl:
         if cs is not None:
             # which grain methods are hot/slow/erroring behind the burn
             out["call_sites"] = cs.top(10)
+        led = self.silo.ledger
+        if led is not None:
+            # WHO is burning the budget: the cost ledger's heaviest
+            # keys, tenant-annotated (the breach drill-down)
+            out["ledger"] = led.top_burners(10)
         return out
 
     async def ctl_call_sites(self, k: int = 20) -> dict:
@@ -203,6 +208,16 @@ class SiloControl:
         turn seconds); {} when metrics are disabled."""
         cs = self.silo.call_sites
         return {} if cs is None else cs.snapshot(k)
+
+    async def ctl_ledger(self, k: int = 10) -> dict:
+        """This silo's cost-attribution ledger snapshot
+        (observability.ledger.CostLedger.snapshot: exact per-method
+        turn/device/wire/stream tables plus the top-``k`` key/tenant
+        sketches) — the per-silo leaf of
+        ManagementGrain.get_cluster_ledger's deterministic merge. {}
+        when ``ledger_enabled`` is off."""
+        led = self.silo.ledger
+        return {} if led is None else led.snapshot(k)
 
     async def ctl_histogram(self, name: str) -> dict | None:
         """One named histogram's summary (with per-bucket counts so the

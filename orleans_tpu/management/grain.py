@@ -232,6 +232,23 @@ class ManagementGrain(Grain):
         merged = CallSiteStats.merge(s for s in per_silo.values() if s)
         return CallSiteStats.format_top(merged["sites"], k)
 
+    async def get_cluster_ledger(self, k: int = 10) -> dict:
+        """Cluster-wide cost attribution over every silo's
+        ``ctl_ledger``: exact per-method turn/device/wire/stream tables
+        sum, the per-key and per-tenant space-saving sketches fold with
+        CostLedger.merge's deterministic flat merge (silo count and merge
+        order cannot change the answer — property-tested), and
+        ``worst_burner``/``worst_tenant`` name the cluster's heaviest key
+        and tenant from the merged ranking. Per-silo snapshots ride in
+        ``per_silo`` for drill-down. One call answers "who is spending
+        this cluster" — the drill-down an SLO breach (and the rebalance
+        planner's host-tier candidates) starts from."""
+        from ..observability.ledger import CostLedger
+        per_silo = await self._fan_out("ctl_ledger", k)
+        out = CostLedger.merge(s for s in per_silo.values() if s)
+        out["per_silo"] = per_silo
+        return out
+
     async def get_cluster_histogram(self, name: str) -> dict | None:
         """One named latency histogram aggregated across every silo
         (Histogram.merge over the per-bucket counts each SiloControl

@@ -446,6 +446,16 @@ class SloMonitor:
         name = obj.spec.name
         silo.stats.increment(SLO_STATS["breaches"])
         silo.stats.increment(SLO_STATS["breach"] % name)
+        # WHO was burning when the breach fired: the cost ledger's top
+        # keys, tenant-annotated — attached to the flight snapshot and
+        # the telemetry event so the drill-down starts named
+        burners: list = []
+        led = getattr(silo, "ledger", None)   # unit fakes omit the attr
+        if led is not None:
+            try:
+                burners = led.top_burners(5)
+            except Exception:  # noqa: BLE001
+                log.exception("slo breach ledger read failed")
         log.warning("SLO breach on %s: %s burn fast=%.1fx slow=%.1fx "
                     "(threshold %.1fx, target %s)", silo.config.name, name,
                     obj.burn_fast, obj.burn_slow, obj.spec.burn_threshold,
@@ -459,7 +469,8 @@ class SloMonitor:
                 lp.trigger("slo_breach", objective=name,
                            burn_fast=round(obj.burn_fast, 2),
                            burn_slow=round(obj.burn_slow, 2),
-                           target=obj.spec.target)
+                           target=obj.spec.target,
+                           top_burners=burners)
             except Exception:  # noqa: BLE001
                 log.exception("slo breach flight trigger failed")
         tracer = silo.tracer
@@ -479,7 +490,8 @@ class SloMonitor:
                                burn_fast=round(obj.burn_fast, 2),
                                burn_slow=round(obj.burn_slow, 2),
                                budget_burned=round(obj.budget_burned, 4),
-                               silo=silo.config.name)
+                               silo=silo.config.name,
+                               top_burners=burners)
             except Exception:  # noqa: BLE001
                 log.exception("slo breach telemetry failed")
 

@@ -84,6 +84,7 @@ class RebalancePlanner:
     def plan(self) -> MigrationPlan:
         plan = MigrationPlan()
         self._plan_activation_moves(plan)
+        self._plan_ledger_moves(plan)
         self._plan_shard_moves(plan)
         return plan
 
@@ -165,6 +166,68 @@ class RebalancePlanner:
                 break  # moving further would just invert the imbalance
             planned[dest] += 1
             plan.activation_moves.append(ActivationMove(act, dest))
+
+    def _plan_ledger_moves(self, plan: MigrationPlan) -> None:
+        """Host-tier HOT-ACTOR moves from the cost ledger
+        (``RebalanceOptions.use_ledger``): activation counts say WHERE
+        activations live, the ledger says WHO is burning — a silo whose
+        counts look balanced can still host the cluster's hottest keys,
+        and the count-based pass above will never move them. Keys whose
+        charged seconds exceed the imbalance ratio × the tracked mean
+        become migration candidates toward the coolest peers, sharing
+        the round's move budget with (and deduped against) the
+        count-based pass. The label scheme is EXACTLY the dispatcher's
+        charge key ("Class/key"), so resolution back to a local
+        activation is a dict lookup, not a scan per label."""
+        if not getattr(self.silo.config, "rebalance_use_ledger", False):
+            return
+        led = getattr(self.silo, "ledger", None)
+        if led is None or not led.keys.counts:
+            return
+        budget = self.budget - len(plan.activation_moves)
+        if budget <= 0:
+            return
+        peers, depths = self._peer_loads()
+        if not peers:
+            return
+        ranked = led.keys.top()
+        mean = sum(r[1] for r in ranked) / len(ranked)
+        if mean <= 0:
+            return
+        hot_labels = [label for label, seconds, _err in ranked
+                      if seconds > self.imbalance_ratio * mean]
+        if not hot_labels:
+            return
+        from ..runtime.activation import ActivationState
+
+        already = {id(m.act) for m in plan.activation_moves}
+        by_label: dict[str, object] = {}
+        for act in self.silo.catalog.by_activation.values():
+            gid = act.grain_id
+            if gid.is_system_target() or \
+                    act.state != ActivationState.VALID:
+                continue
+            if act.is_stateless_worker or act.timers or \
+                    act.activating_backlog or id(act) in already:
+                continue
+            by_label[f"{act.grain_class.__name__}/{gid.key}"] = act
+        planned = dict(peers)
+        for m in plan.activation_moves:
+            planned[m.dest] = planned.get(m.dest, 0) + 1
+        director = ActivationCountPlacement(
+            lambda s: planned.get(s, 1 << 30) + depths.get(s, 0))
+        candidates = list(planned)
+        for label in hot_labels:
+            if budget <= 0:
+                break
+            act = by_label.get(label)
+            if act is None:
+                continue  # remote, device-tier, or not movable here
+            dest = director.place(act.grain_id, self.silo.silo_address,
+                                  candidates)
+            planned[dest] += 1
+            plan.activation_moves.append(ActivationMove(act, dest))
+            budget -= 1
 
     # -- device tier -----------------------------------------------------
     def _plan_shard_moves(self, plan: MigrationPlan) -> None:

@@ -103,6 +103,11 @@ class Dispatcher:
         # None — fed in the turn epilogue, read by ctl_call_sites and
         # the SLO breach drill-down
         self._call_sites = silo.call_sites
+        # cost-attribution ledger (observability.ledger): the silo's
+        # ledger when ledger_enabled, else None — charged in the turn
+        # epilogue (exec + queue-wait seconds per grain/method/key),
+        # one attribute load per turn when off
+        self._ledger = silo.ledger
         # host-loop occupancy profiler (observability.profiling): set by
         # Silo._install_loop_profiler when profiling_enabled, else None —
         # the per-turn guard is one attribute load
@@ -997,6 +1002,22 @@ class Dispatcher:
                 # one dict upsert per turn, only when metrics are on
                 cs.note(msg.interface_name, msg.method_name, elapsed,
                         turn_error is not None)
+            led = self._ledger
+            if led is not None:
+                # cost attribution: charge this turn's exec + queue-wait
+                # to (interface, method) and the grain's key label —
+                # BEFORE RequestContext.clear() below, so the caller's
+                # tenant baggage is still readable. System targets keep
+                # their (interface, method) row but stay out of the
+                # burner sketch: the drill-down names APPLICATION
+                # actors, not runtime bookkeeping
+                led.charge_turn(
+                    msg.interface_name, msg.method_name, elapsed,
+                    queue_s=(max(0.0, t0 - msg.received_at)
+                             if msg.received_at is not None else 0.0),
+                    key=None if activation.grain_id.is_system_target()
+                    else f"{activation.grain_class.__name__}"
+                         f"/{activation.grain_id.key}")
             if tspan is not None:
                 current_trace.reset(ttoken)
                 if turn_error is not None:

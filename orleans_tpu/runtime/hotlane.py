@@ -48,6 +48,7 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import time
 from typing import TYPE_CHECKING
 
 from ..core.serialization import copy_call_body, copy_result
@@ -274,6 +275,13 @@ async def _hot_turn(client, silo: "Silo", act: "ActivationData", inv,
     marker = _acquire_marker(chain, is_read_only)
     act.record_running(marker)
     token = current_activation.set(act)
+    # cost attribution (observability.ledger): an inline turn is exec
+    # only — it never queued, and the lane declined any baggage-carrying
+    # call above, so tenancy comes from the tenant_of hook alone. The
+    # clock is read only when a ledger is installed (the disabled lane
+    # pays one attribute load).
+    led = silo.ledger
+    t_led = time.monotonic() if led is not None else 0.0
     try:
         result = copy_result(await inv.fn(act.grain_instance,
                                           *args, **kwargs))
@@ -283,6 +291,10 @@ async def _hot_turn(client, silo: "Silo", act: "ActivationData", inv,
         silo.catalog.on_invoke_error(act, e)
         raise
     finally:
+        if led is not None:
+            led.charge_turn(
+                interface_name, inv.name, time.monotonic() - t_led,
+                key=f"{act.grain_class.__name__}/{grain_id.key}")
         current_activation.reset(token)
         if ctx_token is not None:
             _request_context.reset(ctx_token)  # restore caller baggage

@@ -408,6 +408,10 @@ class IngressShard(threading.Thread):
                          daemon=True)
         self.pool = pool
         self.index = index
+        # wire-charge route label (cost attribution): per-shard, not
+        # per-peer — a shard owns its connections for life, so the label
+        # is stable and costs one tuple slot per ring entry
+        self._route = f"in:shard{index}"
         self.main_loop = pool.main_loop
         self.loop = asyncio.new_event_loop()
         self.ring = SpscRing(self.main_loop, pool._drain_entry)
@@ -806,17 +810,18 @@ class IngressShard(threading.Thread):
             e.message.received_at = now
             main.call_soon_threadsafe(fabric._bounce_undecodable,
                                       e.message, str(e))
-        if app is not None or (n and self.pool._ist is not None):
+        if app is not None or (n and (self.pool._ist is not None or
+                                      self.pool._led is not None)):
             # an entry rides even for QoS/bounce-only reads when metrics
-            # are on: the decode seconds/bytes and the ALL-category
-            # frame counts must replay loop-side exactly like the
-            # single-loop decode_frames observations (only the stats
-            # ride the ring then — the QoS messages themselves were
-            # already handed off above, ring-free)
+            # (or the cost ledger) are on: the decode seconds/bytes, the
+            # ALL-category frame counts, and the wire-byte charge must
+            # replay loop-side exactly like the single-loop decode_frames
+            # observations (only the stats ride the ring then — the QoS
+            # messages themselves were already handed off above, ring-free)
             self.batches += 1
             n_app = len(app) if app is not None else 0
-            self.ring.push((n_app, silo, app or [], decode_s, nbytes, n),
-                           n_app)
+            self.ring.push((n_app, silo, app or [], decode_s, nbytes, n,
+                            self._route), n_app)
 
 
 class IngressLoopPool:
@@ -834,6 +839,9 @@ class IngressLoopPool:
         self._rr = 0
         # ingest stage metrics replayed at drain (loop-side)
         self._ist = silo.ingest_stats
+        # cost ledger, same replay rule: shards stamp nbytes into the
+        # ring entry, the drain charges the route loop-side
+        self._led = silo.ledger
         self.shards = [IngressShard(self, i) for i in range(n)]
 
     def start(self) -> None:
@@ -853,7 +861,7 @@ class IngressLoopPool:
         the only thread the registry tolerates). ``n_total`` counts
         EVERY frame of the read — QoS-bypassed and bounced included —
         matching the single-loop ``decode_frames`` observations."""
-        _n, silo, msgs, decode_s, nbytes, n_total = item
+        _n, silo, msgs, decode_s, nbytes, n_total, route = item
         ist = self._ist
         if ist is not None and n_total:
             ist.observe(INGEST_STATS["decode"], decode_s)
@@ -862,6 +870,9 @@ class IngressLoopPool:
             ist.increment(INGEST_STATS["frames"], n_total)
             ist.histogram_with(INGEST_STATS["frame_batch"],
                                COUNT_BOUNDS).observe(n_total)
+        led = self._led
+        if led is not None and nbytes:
+            led.charge_wire(route, rx=nbytes)
         if msgs:
             silo.fabric._route_inbound_batch(silo, msgs)
 
@@ -984,6 +995,10 @@ _EG_CLIENT = 1   # (n, _EG_CLIENT, (addr, writer, native), [Message])
 
 _EGRESS_ENCODE_STAT = EGRESS_STATS["encode"]
 _EGRESS_DWELL_STAT = EGRESS_STATS["dwell"]
+
+# wire-charge stamp riding the egress stat rings (cost attribution):
+# replayed into the loop-confined CostLedger by _apply_stats
+from ..observability.ledger import WIRE_STAMP as _LEDGER_WIRE  # noqa: E402
 
 
 class EgressShard:
@@ -1144,6 +1159,10 @@ class EgressShard:
             if stamps is not None:
                 stamps.append((_EGRESS_ENCODE_STAT,
                                time.monotonic() - t0))
+                if fabric.ledger is not None:
+                    stamps.append((_LEDGER_WIRE,
+                                   (f"client:{addr}",
+                                    sum(len(c) for c in chunks))))
             self.encoded += 1
             try:
                 writer.write_many(chunks)
@@ -1174,7 +1193,9 @@ class EgressShard:
         if self.fabric.egress_stats is None:
             for m in msgs:
                 m.received_at = None
-            return None
+            # ledger-only mode: the wire charge still needs a stamp list
+            # to ride the stat ring when metrics are off
+            return [] if self.fabric.ledger is not None else None
         stamps: list = []
         now = time.monotonic()
         for m in msgs:
@@ -1294,12 +1315,18 @@ class EgressShardPool:
 
     def _apply_stats(self, item) -> None:
         """Stat-ring drain (MAIN loop — the only thread the registry
-        tolerates): replay the shard-stamped dwell/encode observations."""
+        tolerates): replay the shard-stamped dwell/encode observations
+        and the wire-byte ledger charges. The ledger entries are NOT
+        metrics-gated — ledger-only silos stamp too."""
         est = self.fabric.egress_stats
-        if est is None:
-            return
+        led = self.fabric.ledger
         for name, value in item[1]:
-            est.observe(name, value)
+            if name is _LEDGER_WIRE:
+                if led is not None:
+                    route, nbytes = value
+                    led.charge_wire(route, tx=nbytes)
+            elif est is not None:
+                est.observe(name, value)
 
     # -- lifecycle -------------------------------------------------------
     async def aclose(self) -> None:

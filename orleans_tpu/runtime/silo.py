@@ -211,6 +211,11 @@ class SiloConfig:
     rebalance_period: float = 0.0
     rebalance_budget: int = 8
     rebalance_imbalance_ratio: float = 1.2
+    # ledger-fed host-tier rebalancing (ISSUE 17): when enabled (and the
+    # ledger is on), the planner also plans moves for grains whose
+    # CHARGED seconds run hot against the per-key mean — load the
+    # activation-count signal cannot see
+    rebalance_use_ledger: bool = False
     # run new turn tasks eagerly to their first suspension
     # (asyncio.eager_task_factory): a turn that completes without awaiting
     # skips the event-loop round trip entirely — the asyncio analog of the
@@ -281,6 +286,17 @@ class SiloConfig:
     # namespace's PooledQueueCache (batches; pressure at 75%).
     stream_device_fanout: bool = False
     stream_device_cache_capacity: int = 1024
+    # cost-attribution ledger (observability.ledger / config.
+    # LedgerOptions): when enabled the silo charges every unit of work —
+    # host-turn exec/queue seconds, device row-seconds, wire bytes per
+    # route, stream deliveries — to (grain_class, method) × hashed-key ×
+    # tenant, bounded by top-K space-saving sketches. Off (default):
+    # silo.ledger is None, every charge site pays one attribute check.
+    ledger_enabled: bool = False
+    ledger_top_k: int = 32
+    # label ("Class/key") -> tenant hook; host turns also read the
+    # caller's "orleans.tenant" RequestContext baggage
+    ledger_tenant_of: object = None
     profiling_enabled: bool = False
     profiling_window: float = 1.0          # seconds per occupancy slice
     profiling_ring: int = 120              # slices retained (flight data)
@@ -811,6 +827,18 @@ class Silo:
         if config.metrics_enabled:
             from ..observability.stats import CallSiteStats
             self.call_sites = CallSiteStats()
+        # cost-attribution ledger (observability.ledger): charges every
+        # unit of work to (grain_class, method) × hashed-key × tenant —
+        # installed only when enabled, every charge site guards on the
+        # None (the disabled path costs one attribute check). The
+        # ledger.* gauges registered here are evaluated at snapshot time
+        # only, so exposure adds no hot-path cost either.
+        self.ledger = None
+        if config.ledger_enabled:
+            from ..observability.ledger import CostLedger
+            self.ledger = CostLedger(config.ledger_top_k,
+                                     config.ledger_tenant_of)
+            self.ledger.register_gauges(self.stats)
         # SLO monitor (observability.slo.SloMonitor): installed at start
         # when slo_enabled; silo.slo_specs (set pre-start by a builder
         # configurator) overrides the default objective set

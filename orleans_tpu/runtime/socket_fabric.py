@@ -84,6 +84,11 @@ _HW_WRITEV = _HW is not None and hasattr(_HW, "sock_writev")
 _EG_ENCODE = EGRESS_STATS["encode"]
 _EG_RING_DROPS = EGRESS_STATS["ring_drops"]
 
+# wire-charge stamp for the sharded egress stat rings (cost
+# attribution): the shard may not touch the loop-confined CostLedger,
+# so byte counts ride the ring and replay in EgressShardPool._apply_stats
+from ..observability.ledger import WIRE_STAMP as _LEDGER_WIRE  # noqa: E402
+
 
 def _writev_stream(writer: asyncio.StreamWriter, chunks: list) -> None:
     """Vectored drain for a StreamWriter-backed sender (the silo-peer
@@ -129,7 +134,8 @@ def _drain_batch(queue: "asyncio.Queue[Message]", first: Message) -> list:
     return batch
 
 
-async def _read_frame_batches(reader: asyncio.StreamReader, ist=None, *,
+async def _read_frame_batches(reader: asyncio.StreamReader, ist=None,
+                              ledger=None, route="", *,
                               strict_tail: bool, chunk_size: int = 1 << 16):
     """Shared chunked-receive state machine for the batched pumps (silo
     and gateway sides): one ``decode_frames`` pass per socket read,
@@ -153,6 +159,11 @@ async def _read_frame_batches(reader: asyncio.StreamReader, ist=None, *,
         consumed, msgs, bounces = decode_frames(buf, ist)
         if consumed:
             del buf[:consumed]
+            if ledger is not None:
+                # cost attribution: inbound bytes charged where the
+                # frame sizes are already known (loop-side callers only
+                # pass a ledger — the sharded pumps stamp instead)
+                ledger.charge_wire(route, rx=consumed)
         if msgs or bounces:
             yield msgs, bounces
         if leads_hostile_frame(buf):
@@ -340,6 +351,12 @@ class _Sender:
             templates=self.fabric.response_templates)
         if not chunks:
             return
+        led = self.fabric.ledger
+        if led is not None:
+            # main-loop sender: the ledger is loop-confined here, charge
+            # directly (the sharded path stamps instead)
+            led.charge_wire(f"peer:{self.endpoint}",
+                            tx=sum(len(c) for c in chunks))
         _writev_stream(self.writer, chunks)
         await self.writer.drain()
 
@@ -376,6 +393,12 @@ class _Sender:
         if chunks and stamps is not None and any(
                 m.direction == Direction.RESPONSE for m in batch):
             stamps.append((_EG_ENCODE, time.monotonic() - t0))
+        if chunks and stamps is not None and fab.ledger is not None:
+            # wire-byte charge stamped for loop-side replay (the shard
+            # may not touch the loop-confined ledger)
+            stamps.append((_LEDGER_WIRE,
+                           (f"peer:{self.endpoint}",
+                            sum(len(c) for c in chunks))))
         if stamps:
             shard.stat_ring.push((0, stamps), 0)
         if not chunks:
@@ -490,6 +513,10 @@ class SocketFabric:
         # encode paths pay one attribute load (senders are shared per
         # endpoint, so per-silo attribution is not available here)
         self.egress_stats = None
+        # cost-attribution ledger of the first ledger-enabled local silo
+        # (same sharing rule as egress_stats): senders/client routes
+        # charge wire bytes per route through it
+        self.ledger = None
         # header-prefix wire templates for response batches
         # (wire.encode_message_batch templates= switch): cleared when any
         # local silo runs batched_egress=False so the A/B lever also
@@ -536,6 +563,8 @@ class SocketFabric:
         self.dead.discard(addr)
         if self.egress_stats is None and silo.ingest_stats is not None:
             self.egress_stats = silo.stats
+        if self.ledger is None and silo.ledger is not None:
+            self.ledger = silo.ledger
         if not silo.config.batched_egress:
             self.response_templates = False
         sock = self._listen_socks.get(addr.endpoint)
@@ -840,6 +869,10 @@ class SocketFabric:
             except Exception as e:  # noqa: BLE001 — per-payload, not the route
                 self._client_encode_error(addr, writer, msg, e, native)
                 return
+            if self.ledger is not None:
+                # main-loop gateway write (per-message path): charge the
+                # client route directly (we ARE the loop)
+                self.ledger.charge_wire(f"client:{addr}", tx=len(data))
             try:
                 writer.write(data)
             except Exception:  # noqa: BLE001 — client gone mid-write
@@ -887,6 +920,11 @@ class SocketFabric:
                 templates=self.response_templates)
             if not chunks:
                 return
+            if self.ledger is not None:
+                # main-loop gateway write: charge the client route
+                # directly (we ARE the loop)
+                self.ledger.charge_wire(f"client:{addr}",
+                                        tx=sum(len(c) for c in chunks))
             try:
                 # shard-owned routes (multiloop.ShardWriter) take the
                 # chunk list whole — it rides one writev, no join copy
@@ -969,7 +1007,8 @@ class SocketFabric:
                 from ..observability.profiling import mark_loop_category
                 mark_loop_category("pump")
             if silo.config.batched_ingress:
-                await self._pump_batched(silo, reader, ist)
+                await self._pump_batched(silo, reader, ist,
+                                         route=f"in:{peer_addr}")
             else:
                 # per-frame hand-off (the batched-ingress A/B lever):
                 # decode + route one message per frame
@@ -1011,13 +1050,18 @@ class SocketFabric:
             writer.close()
 
     async def _pump_batched(self, silo: "Silo",
-                            reader: asyncio.StreamReader, ist) -> None:
+                            reader: asyncio.StreamReader, ist,
+                            route: str = "") -> None:
         """Batched receive pump: every complete frame buffered after one
         socket read decodes in ONE ``decode_frames`` pass (a single
         ``unpack_batch`` C call on the native build) and the decoded list
         rides one batched hand-off into the message center — the
-        receive-side symmetric of the sender's greedy ``_drain_batch``."""
+        receive-side symmetric of the sender's greedy ``_drain_batch``.
+        This pump runs ON the silo's loop, so the cost ledger (when
+        enabled) is passed live into the reader for per-route rx
+        charges."""
         async for msgs, bounces in _read_frame_batches(reader, ist,
+                                                       silo.ledger, route,
                                                        strict_tail=True):
             for e in bounces:
                 self._bounce_undecodable(e.message, str(e))

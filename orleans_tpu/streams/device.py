@@ -473,6 +473,11 @@ class DeviceStreamProvider(StreamProvider):
             for got in await asyncio.gather(*work):
                 delivered += int(got)
         self.silo.stats.increment("streams.device.delivered", delivered)
+        led = self.silo.ledger
+        if led is not None:
+            # cost attribution: the pump runs on the silo loop, charge
+            # the namespace's delivery count directly
+            led.charge_stream(self.name, delivered)
         return delivered
 
     def _send_remote(self, grp: _FanoutGroup, targets: np.ndarray,

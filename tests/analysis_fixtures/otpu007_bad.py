@@ -77,3 +77,37 @@ class EgressDrain(threading.Thread):
     def _drain(self, batch):
         encode_chunks(batch, self.registry)
         self.registry.observe("egress.dwell", 0.5)
+
+
+from orleans_tpu.observability.ledger import CostLedger  # noqa: E402
+
+
+class CostWorker:
+    """A tick worker charging the loop-confined cost ledger directly
+    from the worker thread — the tick charge must stamp into the job's
+    deferred list and replay loop-side instead."""
+
+    def __init__(self):
+        self.ledger = CostLedger()
+        self.thread = threading.Thread(target=self._worker_main)
+
+    def _worker_main(self):
+        while True:
+            self.ledger.charge_tick(("G", "m", 4, 0.1, ()))
+
+
+class WireShard(threading.Thread):
+    """An egress shard charging wire bytes straight into the ledger
+    from the shard loop instead of stamping them onto the stat ring."""
+
+    def __init__(self, ledger):
+        super().__init__(daemon=True)
+        self.loop = asyncio.new_event_loop()
+        self.ledger: CostLedger = ledger
+
+    def run(self):
+        self.loop.call_soon(self._drain)
+        self.loop.run_forever()
+
+    def _drain(self):
+        self.ledger.charge_wire("peer:x", tx=128)

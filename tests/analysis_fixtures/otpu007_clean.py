@@ -76,3 +76,65 @@ class EgressDrain(threading.Thread):
     def _replay(self, stamps):
         for key, value in stamps:
             self.registry.observe(key, value)
+
+
+from orleans_tpu.observability.ledger import CostLedger  # noqa: E402
+
+
+class CostWorker:
+    """Ledger discipline done RIGHT: the worker stamps the tick-charge
+    payload into a plain list and a main-loop callback replays it into
+    the loop-confined CostLedger (engine._complete_job's shape)."""
+
+    def __init__(self):
+        self.ledger = CostLedger()
+        self._loop = asyncio.get_running_loop()
+        self.thread = threading.Thread(target=self._worker_main)
+
+    def _worker_main(self):
+        while True:
+            stamps = []
+            stamps.append(("ledger", ("G", "m", 4, 0.1, ())))
+            self._loop.call_soon_threadsafe(self._replay, stamps)
+
+    def _replay(self, stamps):
+        for _key, payload in stamps:
+            self.ledger.charge_tick(payload)
+
+
+def read_frames(buf, ledger=None, route=""):
+    # ingress read helper: worker callers pass no ledger; the loop-side
+    # pump passes the live one (the guarded-parameter idiom)
+    if ledger is not None:
+        ledger.charge_wire(route, rx=len(buf))
+    return buf
+
+
+async def pump(reader, ledger):
+    # loop-side pump: the live ledger may ride into the guarded helper
+    read_frames(await reader.read(), ledger, "in:peer")
+
+
+class WireShard(threading.Thread):
+    """The sharded-egress ledger shape done RIGHT: the read helper gets
+    no ledger off-loop, and wire bytes are stamped into a plain list
+    replayed by a main-loop callback (the stat-ring hand-off)."""
+
+    def __init__(self, ledger):
+        super().__init__(daemon=True)
+        self.loop = asyncio.new_event_loop()
+        self.main_loop = asyncio.get_running_loop()
+        self.ledger = ledger
+
+    def run(self):
+        self.loop.call_soon(self._drain)
+        self.loop.run_forever()
+
+    def _drain(self):
+        read_frames(b"")
+        stamps = [("wire", ("peer:x", 128))]
+        self.main_loop.call_soon_threadsafe(self._replay, stamps)
+
+    def _replay(self, stamps):
+        for _key, (route, nbytes) in stamps:
+            self.ledger.charge_wire(route, tx=nbytes)

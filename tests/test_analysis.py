@@ -51,9 +51,12 @@ EXPECTED_BAD = {
     # helper (26), shard-loop StatsRegistry.increment (40),
     # run_in_executor trend note (52), egress-shard drain handing the
     # live registry into the encode helper (78) and writing dwell
-    # directly from the shard context (79) — the sharded-egress shapes
+    # directly from the shard context (79) — the sharded-egress shapes;
+    # cost-ledger charge from a tick-worker thread (96) and a wire
+    # charge from the egress-shard loop (113) — the ledger shapes
     "otpu007_bad.py": {("OTPU007", 25), ("OTPU007", 26), ("OTPU007", 40),
-                       ("OTPU007", 52), ("OTPU007", 78), ("OTPU007", 79)},
+                       ("OTPU007", 52), ("OTPU007", 78), ("OTPU007", 79),
+                       ("OTPU007", 96), ("OTPU007", 113)},
     # unfenced-caller propagation (14), entry-point read (22), hits
     # store (30), unfenced mutual-recursion cycle (37 — a cycle cannot
     # vouch for itself in the SCC-condensed held fixpoint), unfenced

@@ -440,3 +440,73 @@ async def test_device_rebalance_string_keys_63bit_hashes():
     finally:
         await client.close_async()
         await silo.stop()
+
+
+# ----------------------------------------------------------------------
+# Host tier: ledger-driven hot-actor candidates (ISSUE 17 satellite)
+# ----------------------------------------------------------------------
+class SplitDirector:
+    """Keys prefixed 'a' land on silo A, everything else on silo B —
+    the count-balanced skew generator: counts say balanced, the cost
+    ledger says silo A hosts the burner."""
+
+    def __init__(self, a: SiloAddress, b: SiloAddress):
+        self.a, self.b = a, b
+
+    def place(self, grain_id, requester, silos):
+        want = self.a if str(grain_id.key).startswith("a") else self.b
+        return want if want in silos else silos[0]
+
+
+async def test_ledger_hot_actor_gets_move_counts_never_planned():
+    """A silo whose activation COUNTS are balanced but whose cost ledger
+    names a hot local grain: the count-based pass plans nothing, and
+    with ``rebalance_use_ledger=True`` the ledger pass plans a move for
+    exactly the named burner (a migration it previously never got)."""
+    from orleans_tpu.rebalance.planner import RebalancePlanner
+
+    cluster = (TestClusterBuilder(2).add_grains(HotGrain)
+               .with_config(ledger_enabled=True, ledger_top_k=16)
+               .build())
+    async with cluster:
+        silo_a, silo_b = cluster.silos
+        for s in cluster.silos:
+            s.locator.placement.directors["pin_first"] = \
+                SplitDirector(silo_a.silo_address, silo_b.silo_address)
+        grains = [cluster.grain(HotGrain, f"{side}-{i}")
+                  for side in ("a", "b") for i in range(4)]
+        assert await asyncio.gather(*(g.incr() for g in grains)) == [1] * 8
+        assert silo_a.catalog.by_grain  # activations settled per director
+        # level the COUNTS exactly (management/system activations skew
+        # them): filler grains onto whichever silo runs lighter
+        for i in range(64):
+            ca = silo_a.catalog.activation_count()
+            cb = silo_b.catalog.activation_count()
+            if ca == cb:
+                break
+            side = "a" if ca < cb else "b"
+            await cluster.grain(HotGrain, f"{side}-fill-{i}").incr()
+        assert silo_a.catalog.activation_count() == \
+            silo_b.catalog.activation_count()
+        for s in cluster.silos:   # refresh the broadcast load view NOW
+            s.load_publisher._publish()
+        await asyncio.sleep(0)    # let the load_report turns land
+
+        # the real turn charges are microseconds; overlay a skewed window
+        # through the public charge verb: one burner, seven background keys
+        led = silo_a.ledger
+        led.charge_turn("IHot", "incr", 10.0, key="HotGrain/a-0")
+        for i in range(1, 4):
+            led.charge_turn("IHot", "incr", 0.05, key=f"HotGrain/a-{i}")
+
+        # counts balanced → the count-based pass plans nothing, and with
+        # the lever OFF (the default) the burner never gets a move
+        silo_a.config.rebalance_use_ledger = False
+        plan = RebalancePlanner(silo_a, budget=4, imbalance_ratio=1.5).plan()
+        assert not plan.activation_moves
+
+        silo_a.config.rebalance_use_ledger = True
+        plan = RebalancePlanner(silo_a, budget=4, imbalance_ratio=1.5).plan()
+        moved = [(m.act.grain_class.__name__, m.act.grain_id.key, m.dest)
+                 for m in plan.activation_moves]
+        assert moved == [("HotGrain", "a-0", silo_b.silo_address)]

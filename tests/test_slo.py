@@ -512,3 +512,24 @@ async def test_gauntlet_churn_storm_drops_nothing():
     assert e["errors"] == 0
     assert e["connects"] >= 2
     assert e["all_met"], e["verdicts"]
+
+
+async def test_gauntlet_hot_key_ledger_names_burner():
+    """ISSUE 17 acceptance: Zipf skew against a 2-silo cluster with the
+    cost ledger armed — the breach drill-down NAMES the hot key and its
+    tenant through get_cluster_ledger's deterministic sketch merge,
+    while the QoS lane stays clean (probe SLI, zero false suspicions)."""
+    from benchmarks import gauntlet
+    r = await gauntlet.hot_key(seconds=2.6, short=True, threshold=0.02)
+    e = r["extra"]
+    _check_verdicts(e["verdicts"])
+    assert e["app_slo_breached"], e["verdicts"]
+    # the ledger named WHO: the Zipf rank-0 key, tenant-annotated
+    assert e["ledger_names_hot_key"], e["ledger_worst_burner"]
+    assert e["ledger_names_tenant"], e["ledger_worst_tenant"]
+    assert e["ledger_worst_burner"]["seconds"] > 0
+    # and the QoS lane did not pay for the skew
+    assert e["false_suspicions"] == 0
+    assert e["membership_stable"]
+    assert e["qos_invariant_held"], (e["probe_rtt_fast_fraction"],
+                                     e["false_suspicions"])
