@@ -50,11 +50,24 @@ from benchmarks.ingest_attribution import (EchoGrain, _make_vector_grain,
                                            connect_clients)
 
 
+class LocalEchoGrain(EchoGrain):
+    """EchoGrain pinned to the accepting silo (ISSUE 18): under
+    ``worker_procs>1`` a client connection lands in ONE worker process,
+    and prefer_local placement keeps that client's host activations in
+    the worker that accepted it — host turns then run without a
+    cross-process relay hop, which is the multi-process lever's whole
+    throughput story. Used on BOTH sides of the multiproc A/B so the
+    ``worker_procs`` config is the only delta."""
+    __orleans_placement__ = "prefer_local"
+
+
 async def run(seconds: float = 2.0, concurrency: int = 32,
               n_grains: int = 64, n_keys: int = 64,
               offloop: bool = True, call_batch: bool = False,
               call_batch_size: int = 16, ingress_loops: int = 1,
-              egress_shards: int = 0, n_clients: int = 1) -> dict:
+              egress_shards: int = 0, n_clients: int = 1,
+              worker_procs: int = 1,
+              prefer_local_hosts: bool = False) -> dict:
     """One silo over real TCP, profiling on, mixed host + device traffic
     at closed-loop saturation; returns the loop-occupancy breakdown.
     ``offloop=False`` restores the loop-inline device tick (the A/B
@@ -66,19 +79,26 @@ async def run(seconds: float = 2.0, concurrency: int = 32,
     multi-loop A/B drives >= 2 connections on BOTH sides);
     ``egress_shards>=1`` moves outbound senders + shard-owned response
     encode/writev onto shard loops (ISSUE 15) — the main loop's
-    "egress" occupancy share is that lever's structural signal."""
+    "egress" occupancy share is that lever's structural signal;
+    ``worker_procs>=2`` forks SO_REUSEPORT worker processes fed through
+    shared-memory staging rings (ISSUE 18) — clients connect to the
+    advertised gateway endpoint and the MAIN process's pump+egress
+    shares are that lever's structural signal (``prefer_local_hosts``
+    keeps host activations in the accepting worker on both A/B sides)."""
     import numpy as np
 
     from orleans_tpu.dispatch import add_vector_grains
     from orleans_tpu.parallel import make_mesh
 
     EchoVec = _make_vector_grain()
+    Host = LocalEchoGrain if prefer_local_hosts else EchoGrain
     fabric = SocketFabric()
     b = (SiloBuilder().with_name("loop-silo").with_fabric(fabric)
-         .add_grains(EchoGrain)
+         .add_grains(Host)
          .with_config(profiling_enabled=True, profiling_window=0.25,
                       offloop_tick=offloop, ingress_loops=ingress_loops,
-                      egress_shards=egress_shards))
+                      egress_shards=egress_shards,
+                      worker_procs=worker_procs))
     add_vector_grains(b, EchoVec, mesh=make_mesh(1),
                       dense={EchoVec: n_keys})
     silo = b.build()
@@ -87,10 +107,13 @@ async def run(seconds: float = 2.0, concurrency: int = 32,
     # (threads/sockets otherwise leak into every later measurement)
     clients = []
     try:
-        clients = await connect_clients(silo.silo_address.endpoint,
+        # gateway_endpoint IS silo_address.endpoint when worker_procs=1
+        # (the property falls back), so single-process runs are
+        # unchanged and the multiproc A/B differs only in the lever
+        clients = await connect_clients(silo.gateway_endpoint,
                                         n_clients)
         client = clients[0]
-        host_refs = [clients[k % len(clients)].get_grain(EchoGrain, k)
+        host_refs = [clients[k % len(clients)].get_grain(Host, k)
                      for k in range(n_grains)]
         vec_refs = [clients[k % len(clients)].get_grain(EchoVec, k)
                     for k in range(n_keys)]
@@ -166,6 +189,8 @@ async def run(seconds: float = 2.0, concurrency: int = 32,
                         "busy_share": round(
                             1.0 - p["shares"].get("idle", 0.0), 4)}
                        for p in await pool.loop_profiles(windows=0)]
+        workers = (silo.workers.describe()
+                   if silo.workers is not None else None)
     finally:
         for c in clients:
             await c.close_async()
@@ -183,6 +208,8 @@ async def run(seconds: float = 2.0, concurrency: int = 32,
             "offloop": offloop, "call_batch": call_batch,
             "ingress_loops": ingress_loops,
             "egress_shards": egress_shards, "n_clients": n_clients,
+            "worker_procs": worker_procs,
+            "workers": workers,
             "ingress_loop_profiles": ingress,
             "calls": calls,
             "calls_per_sec": round(calls / elapsed, 1),
@@ -363,6 +390,81 @@ async def run_egress_shards_ab(seconds: float = 2.0,
     }
 
 
+async def run_multiproc_ab(seconds: float = 2.0, concurrency: int = 32,
+                           procs: int = 2, n_clients: int = 4) -> dict:
+    """Multi-process silo A/B (the ISSUE 18 acceptance point): identical
+    mixed TCP traffic over ``n_clients`` gateway connections against a
+    single-process silo vs a ``worker_procs=procs`` silo — ONLY the
+    ``worker_procs`` lever differs (both sides use prefer_local host
+    grains and connect to ``silo.gateway_endpoint``). Two structural
+    signals ride beside the msgs/sec ratio:
+
+      * the MAIN process's pump+egress occupancy share → ~0: clients
+        connect to the SO_REUSEPORT gateway, so the kernel hands every
+        accept to a worker process and the owner's loop never touches
+        client socket reads, wire decode, or response encode — only the
+        device engine (fed through the shm staging rings) remains;
+      * the accept-balance spread: per-worker live client-route counts
+        from the relay table prove the connections actually landed in
+        >= 2 distinct worker processes.
+
+    The end-to-end ratio is separate-GIL real parallelism, so — like
+    the multiloop A/B — it is only meaningful on a genuinely multi-core
+    runner; ``parallel_capacity`` is stamped into the payload so the
+    recorded ratio travels with the capacity of the box that measured
+    it (test_floor_multiproc gates on the same probe)."""
+    from benchmarks.parallel_probe import parallel_capacity
+
+    one = await run(seconds, concurrency, n_clients=n_clients,
+                    worker_procs=1, prefer_local_hosts=True)
+    multi = await run(seconds, concurrency, n_clients=n_clients,
+                      worker_procs=procs, prefer_local_hosts=True)
+
+    def rate(r):
+        return r["extra"]["calls_per_sec"]
+
+    def ingest_share(r):
+        # everything client-facing the workers should absorb: socket
+        # reads + wire decode (pump) and response encode + writes
+        # (egress) on the MAIN process's loop
+        x = r["extra"]
+        return round(x["pump_share"] + x["egress_share"], 4)
+
+    ratio = rate(multi) / rate(one) if rate(one) else 0.0
+    spread = [w["client_routes"]
+              for w in (multi["extra"]["workers"] or {}).get("workers", [])]
+    return {
+        "metric": "multiproc_speedup",
+        "value": round(ratio, 3),
+        "unit": f"x (worker_procs={procs} vs 1, same traffic)",
+        "vs_baseline": None,
+        "extra": {
+            "seconds": seconds, "concurrency": concurrency,
+            "procs": procs, "n_clients": n_clients,
+            "parallel_capacity": round(parallel_capacity(), 3),
+            "single": {"calls_per_sec": rate(one),
+                       "pump_share": one["extra"]["pump_share"],
+                       "egress_share": one["extra"]["egress_share"],
+                       "shares": one["extra"]["shares"]},
+            "multi": {"calls_per_sec": rate(multi),
+                      "pump_share": multi["extra"]["pump_share"],
+                      "egress_share": multi["extra"]["egress_share"],
+                      "shares": multi["extra"]["shares"],
+                      "workers": multi["extra"]["workers"]},
+            # the structural signals (the ISSUE 18 acceptance reads):
+            # owner sheds client-facing work entirely, and the kernel
+            # actually balanced accepts across >= 2 workers
+            "main_process_ingest_share": ingest_share(multi),
+            "main_process_ingest_share_single": ingest_share(one),
+            "main_process_ingest_share_ratio": round(
+                ingest_share(multi) / ingest_share(one), 3)
+            if ingest_share(one) else 0.0,
+            "worker_client_routes": spread,
+            "workers_with_clients": sum(1 for n in spread if n > 0),
+        },
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seconds", type=float, default=3.0)
@@ -383,8 +485,17 @@ def main() -> None:
                     help="run the 1-vs-2 ingress-loop A/B (ISSUE 11)")
     ap.add_argument("--egress-shards-ab", action="store_true",
                     help="run the egress_shards 0-vs-N A/B (ISSUE 15)")
+    ap.add_argument("--worker-procs", type=int, default=1,
+                    help="multi-process silo: N SO_REUSEPORT workers")
+    ap.add_argument("--multiproc-ab", action="store_true",
+                    help="run the worker_procs 1-vs-N A/B (ISSUE 18)")
     a = ap.parse_args()
-    if a.egress_shards_ab:
+    if a.multiproc_ab:
+        print(json.dumps(asyncio.run(run_multiproc_ab(
+            a.seconds, a.concurrency,
+            procs=a.worker_procs if a.worker_procs > 1 else 2,
+            n_clients=a.clients if a.clients > 1 else 4))))
+    elif a.egress_shards_ab:
         print(json.dumps(asyncio.run(run_egress_shards_ab(
             a.seconds, a.concurrency,
             shards=a.egress_shards if a.egress_shards > 1 else 2,
@@ -400,7 +511,9 @@ def main() -> None:
         print(json.dumps(asyncio.run(run(
             a.seconds, a.concurrency, offloop=not a.inline_tick,
             call_batch=a.call_batch, ingress_loops=a.ingress_loops,
-            egress_shards=a.egress_shards, n_clients=a.clients))))
+            egress_shards=a.egress_shards, n_clients=a.clients,
+            worker_procs=a.worker_procs,
+            prefer_local_hosts=a.worker_procs > 1))))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,56 @@
+"""Shared GIL-released parallelism probe for the CI perf floors.
+
+One definition used by every throughput floor that compares a
+parallel-lever silo against a single-threaded baseline
+(``test_floor_multiloop``, ``test_floor_sharded_egress``,
+``test_floor_multiproc``): a CONSERVATIVE measurement of how much
+speedup this runner actually delivers to perfectly parallel work. If
+two threads of pure GIL-released hashing can't reach the floor ratio,
+no pump/egress/worker-process lever can — so the floors skip (with the
+measured capacity in the skip reason) instead of failing on
+quota-shared or throttled cores, and the structural A/B assertions
+carry the verification (the ROADMAP's "trust A/B ratios, not
+absolutes" rule).
+
+Extracted from ``tests/test_perf_floors._parallel_capacity`` (ISSUE 18
+satellite) so the benchmark harnesses can also stamp the measured
+capacity into their JSON snapshots — a recorded ratio from a box that
+probes 0.6x means something different from the same ratio at 1.9x.
+"""
+
+import hashlib
+import threading
+import time
+
+__all__ = ["parallel_capacity"]
+
+
+def parallel_capacity(threads: int = 2, rounds: int = 3) -> float:
+    """CONSERVATIVE estimate of the speedup ``threads`` threads of
+    GIL-released work see vs serial on this runner: min serial time /
+    max parallel time over ``rounds`` interleaved rounds, so transient
+    quota throttling can only UNDERSTATE capacity (understating skips a
+    throughput floor, never falsely arms it — a one-shot probe under
+    suite load can flatter a throttled box by catching the serial half
+    in a slow slice)."""
+    buf = b"x" * (1 << 22)
+    per_thread = max(1, 12 // threads)
+
+    def work(n):
+        for _ in range(n):
+            hashlib.sha256(buf).digest()
+
+    serial_best, par_worst = float("inf"), 0.0
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        work(per_thread * threads)
+        serial_best = min(serial_best, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        ts = [threading.Thread(target=work, args=(per_thread,))
+              for _ in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        par_worst = max(par_worst, time.perf_counter() - t0)
+    return serial_best / par_worst if par_worst else 0.0

@@ -98,6 +98,15 @@ def main() -> None:
     # measured ~0.0-0.1x); msgs/sec ratio probe-gated like multiloop
     print(json.dumps(asyncio.run(loop_attribution.run_egress_shards_ab(
         seconds=2.0, concurrency=32))))
+    # multi-process silos A/B (ISSUE 18): worker_procs 1 vs 2 on
+    # identical mixed TCP traffic to the SO_REUSEPORT gateway — the
+    # main process's pump+egress share collapses to ~0 (structural,
+    # measured ~0.01-0.06x) and clients spread over both workers;
+    # msgs/sec ratio probe-gated like multiloop (separate GILs only pay
+    # off on genuinely parallel cores — parallel_capacity is stamped
+    # into the payload)
+    print(json.dumps(asyncio.run(loop_attribution.run_multiproc_ab(
+        seconds=2.0, concurrency=32))))
     # deliberate client-side batching vs per-message senders, vector-only
     # (isolates the sender-side win from the mixed harness's host/vec
     # mix shift; measured ~1.5-1.8x, CI floor 1.2x)

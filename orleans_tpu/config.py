@@ -88,6 +88,15 @@ class MessagingOptions:
     # ``offloop_tick=False`` restores the loop-inline tick — the A/B
     # lever paired with ``batched_ingress``
     offloop_tick: bool = True
+    # multi-process silo (runtime.multiproc): N >= 2 forks N single-GIL
+    # worker processes that each bind the SAME advertised endpoint via
+    # SO_REUSEPORT (kernel accept balancing; a connection pins to its
+    # accepting worker for life, so the multiloop per-grain FIFO
+    # argument carries over verbatim). The device engine stays in the
+    # owner process; workers feed vector calls through cross-process
+    # SPSC staging rings on multiprocessing.shared_memory. 1 (default)
+    # keeps the single-process path bit for bit — the A/B lever
+    worker_procs: int = 1
 
     def validate(self) -> None:
         # no cross-field rule tying max_request_processing_time to
@@ -107,6 +116,17 @@ class MessagingOptions:
             raise ConfigurationError(
                 f"egress_shards must be an int in [0, 64], got "
                 f"{self.egress_shards!r}")
+        if not isinstance(self.worker_procs, int) or \
+                isinstance(self.worker_procs, bool) or \
+                not (1 <= self.worker_procs <= 64):
+            raise ConfigurationError(
+                f"worker_procs must be an int in [1, 64], got "
+                f"{self.worker_procs!r}")
+        if self.worker_procs > 1 and self.ingress_loops > 1:
+            raise ConfigurationError(
+                "worker_procs > 1 and ingress_loops > 1 are mutually "
+                "exclusive: each worker process is already a single-GIL "
+                "silo (fork workers OR shard pump loops, not both)")
 
 
 @dataclass
@@ -487,6 +507,7 @@ _FLAT_MAP = {
     "batched_ingress": (MessagingOptions, "batched_ingress"),
     "ingress_loops": (MessagingOptions, "ingress_loops"),
     "egress_shards": (MessagingOptions, "egress_shards"),
+    "worker_procs": (MessagingOptions, "worker_procs"),
     "batched_egress": (MessagingOptions, "batched_egress"),
     "offloop_tick": (MessagingOptions, "offloop_tick"),
     "turn_warning_length": (SchedulingOptions, "turn_warning_length"),

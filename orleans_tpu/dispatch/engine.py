@@ -577,6 +577,28 @@ class VectorRuntime:
             self._schedule_tick(loop)
         return futs
 
+    def call_packed(self, grain_class: type, method: str, key_hashes: list,
+                    columns: dict, wants: list) -> list:
+        """Columnar enqueue — the owner-process half of the cross-process
+        staging ring (runtime.multiproc): a worker packs one ingress
+        batch's calls column-major (one ``columns[name]`` list per
+        argument) into the shared segment, and this unpacks them into
+        the SAME pending batch ``call_group`` would have built — one
+        method/table resolution, one enqueue stamp, one tick schedule
+        for the whole record, and bit-for-bit the ``call_group`` result
+        semantics (that is what the shm-parity test asserts).
+
+        Deliberately NOT a direct scatter into the ``[n_shards, B]``
+        staging buffers: lane allocation is owner state under the tick
+        fence (slot lookup, conflict deferral, double-buffer rotation),
+        so the fence-owning process does the staging fill exactly as it
+        does for in-process calls."""
+        names = tuple(columns)
+        cols = [columns[n] for n in names]
+        return self.call_group(grain_class, method, [
+            (kh, {n: col[i] for n, col in zip(names, cols)}, want)
+            for i, (kh, want) in enumerate(zip(key_hashes, wants))])
+
     # -- write-behind dirty tracking (consumed by storage.checkpoint) ----
     def enable_dirty_tracking(self) -> None:
         self.track_dirty = True

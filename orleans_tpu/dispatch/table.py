@@ -435,6 +435,14 @@ class ShardedActorTable:
         if key_hash != uniform_hash:
             self.route_hash[key_hash] = uniform_hash
 
+    def note_route_many(self, pairs) -> None:
+        """Batched :meth:`note_route` — worker-process proxies buffer
+        their (key_hash, uniform_hash) notes and ship them with the
+        packed call record, so the ownership sweep sees the same routes
+        it would have in-process (the pairs arrive pre-filtered:
+        proxies only buffer key_hash != uniform_hash)."""
+        self.route_hash.update(pairs)
+
     def unowned_keys(self, still_owned) -> list[int]:
         """Hashed-regime rows whose ring ownership left this silo (the
         membership-change sweep's release set). A row surviving on an

@@ -219,6 +219,21 @@ class SiloControl:
         led = self.silo.ledger
         return {} if led is None else led.snapshot(k)
 
+    async def ctl_workers(self) -> dict:
+        """Multi-process silo topology (runtime.multiproc): per-worker
+        pid/liveness/internal-endpoint, the staging/response ring
+        cumulative counters (single-writer, so this read is torn-free —
+        pushed == drained after a clean drain), and each worker's live
+        client-route count from the relay table (the accept-balance
+        spread the multiproc floor asserts on). Per-worker DEEP stats
+        need no special path: workers are full cluster-member silos, so
+        the existing per-silo ``ctl_*`` RPCs reach them by address.
+        ``{"worker_procs": 1}`` when this silo runs single-process."""
+        sup = self.silo.workers
+        if sup is None:
+            return {"worker_procs": 1}
+        return sup.describe()
+
     async def ctl_histogram(self, name: str) -> dict | None:
         """One named histogram's summary (with per-bucket counts so the
         ManagementGrain can merge silos losslessly); None if unknown."""

@@ -34,6 +34,9 @@
 #include <errno.h>
 #include <sys/socket.h>
 #include <sys/uio.h>
+#include <netinet/in.h>
+#include <arpa/inet.h>
+#include <unistd.h>
 #endif
 
 #define HW_MAGIC 0xA7
@@ -1516,6 +1519,171 @@ fail:
     PyMem_Free(iov); PyMem_Free(views); Py_DECREF(seq);
     return NULL;
 }
+
+/* bind_reuseport(host, port) -> fd
+ *
+ * One listening socket in an SO_REUSEPORT accept group (the
+ * multi-process silo's advertised endpoint): the option is set BEFORE
+ * bind — the kernel's admission rule for joining a group — so every
+ * worker process that calls this with the same (host, port) gets its
+ * own kernel accept queue and the kernel hash-balances incoming
+ * connections across them.  Raises OSError where the platform has no
+ * SO_REUSEPORT rather than silently binding without it (a group member
+ * that never joined would steal nothing, but one that joined and never
+ * accepts black-holes its share — better to fail loudly). */
+static PyObject *hw_bind_reuseport(PyObject *self, PyObject *args) {
+    const char *host;
+    int port;
+    if (!PyArg_ParseTuple(args, "si", &host, &port))
+        return NULL;
+#ifndef SO_REUSEPORT
+    PyErr_SetString(PyExc_OSError, "SO_REUSEPORT not supported here");
+    return NULL;
+#else
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0)
+        return PyErr_SetFromErrno(PyExc_OSError);
+    int one = 1;
+    struct sockaddr_in sa;
+    memset(&sa, 0, sizeof(sa));
+    sa.sin_family = AF_INET;
+    sa.sin_port = htons((uint16_t)port);
+    if (inet_pton(AF_INET, host, &sa.sin_addr) != 1) {
+        close(fd);
+        PyErr_Format(PyExc_ValueError, "bind_reuseport: bad host %s", host);
+        return NULL;
+    }
+    if (setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one)) < 0 ||
+        setsockopt(fd, SOL_SOCKET, SO_REUSEPORT, &one, sizeof(one)) < 0 ||
+        bind(fd, (struct sockaddr *)&sa, sizeof(sa)) < 0 ||
+        listen(fd, 128) < 0) {
+        PyErr_SetFromErrno(PyExc_OSError);
+        close(fd);
+        return NULL;
+    }
+    return PyLong_FromLong(fd);
+#endif
+}
+
+/* SPSC shm ring primitives — the cross-process staging ring's hot half.
+ *
+ * Layout (shared with the pure-Python twin in runtime/multiproc.py —
+ * a native producer and a Python consumer interoperate):
+ *   [0:8]   write_cum     producer-only writer
+ *   [8:16]  pushed_msgs   producer-only writer
+ *   [64:72] read_cum      consumer-only writer (own cache line)
+ *   [72:80] drained_msgs  consumer-only writer
+ *   [128:]  data (capacity bytes, 8-aligned); records are
+ *           u32 len | u32 n_msgs | payload, padded to 8; u32
+ *           0xFFFFFFFF marks an end-of-region wrap skip.
+ * Each counter has exactly one writer, so plain stores suffice for the
+ * owner side; the cross-side loads/stores pair acquire/release so the
+ * payload bytes are visible before the counter that publishes them. */
+#define SHM_HDR 128
+#define SHM_WRAP 0xFFFFFFFFu
+
+/* shm_push(buf, capacity, payload, n_msgs) -> bool (False = ring full) */
+static PyObject *hw_shm_push(PyObject *self, PyObject *args) {
+    Py_buffer buf, payload;
+    Py_ssize_t cap;
+    unsigned long long n_msgs;
+    if (!PyArg_ParseTuple(args, "w*ny*K", &buf, &cap, &payload, &n_msgs))
+        return NULL;
+    if (cap <= 64 || (cap & 7) || buf.len < SHM_HDR + cap) {
+        PyBuffer_Release(&buf); PyBuffer_Release(&payload);
+        PyErr_SetString(PyExc_ValueError, "shm_push: bad ring buffer");
+        return NULL;
+    }
+    uint8_t *base = (uint8_t *)buf.buf;
+    uint8_t *data = base + SHM_HDR;
+    uint64_t ln = (uint64_t)payload.len;
+    uint64_t rec = 8 + ((ln + 7) & ~7ULL);
+    if (rec > (uint64_t)cap - 8) {
+        PyBuffer_Release(&buf); PyBuffer_Release(&payload);
+        PyErr_Format(PyExc_ValueError,
+                     "shm_push: record of %llu bytes exceeds capacity %zd",
+                     (unsigned long long)ln, cap);
+        return NULL;
+    }
+    uint64_t wc = __atomic_load_n((uint64_t *)(base + 0), __ATOMIC_RELAXED);
+    uint64_t rc = __atomic_load_n((uint64_t *)(base + 64), __ATOMIC_ACQUIRE);
+    uint64_t pos = wc % (uint64_t)cap;
+    uint64_t contig = (uint64_t)cap - pos;
+    uint64_t need = rec + (contig < rec ? contig : 0);
+    if ((uint64_t)cap - (wc - rc) < need) {
+        PyBuffer_Release(&buf); PyBuffer_Release(&payload);
+        Py_RETURN_FALSE;
+    }
+    if (contig < rec) {
+        uint32_t w = SHM_WRAP;
+        memcpy(data + pos, &w, 4);
+        wc += contig;
+        pos = 0;
+    }
+    uint32_t l32 = (uint32_t)ln, m32 = (uint32_t)n_msgs;
+    memcpy(data + pos, &l32, 4);
+    memcpy(data + pos + 4, &m32, 4);
+    if (ln)
+        memcpy(data + pos + 8, payload.buf, ln);
+    uint64_t pushed = *(uint64_t *)(base + 8);
+    __atomic_store_n((uint64_t *)(base + 0), wc + rec, __ATOMIC_RELEASE);
+    __atomic_store_n((uint64_t *)(base + 8), pushed + n_msgs,
+                     __ATOMIC_RELEASE);
+    PyBuffer_Release(&buf); PyBuffer_Release(&payload);
+    Py_RETURN_TRUE;
+}
+
+/* shm_pop(buf, capacity) -> (payload, n_msgs) | None */
+static PyObject *hw_shm_pop(PyObject *self, PyObject *args) {
+    Py_buffer buf;
+    Py_ssize_t cap;
+    if (!PyArg_ParseTuple(args, "w*n", &buf, &cap))
+        return NULL;
+    if (cap <= 64 || (cap & 7) || buf.len < SHM_HDR + cap) {
+        PyBuffer_Release(&buf);
+        PyErr_SetString(PyExc_ValueError, "shm_pop: bad ring buffer");
+        return NULL;
+    }
+    uint8_t *base = (uint8_t *)buf.buf;
+    uint8_t *data = base + SHM_HDR;
+    for (;;) {
+        uint64_t rc = __atomic_load_n((uint64_t *)(base + 64),
+                                      __ATOMIC_RELAXED);
+        uint64_t wc = __atomic_load_n((uint64_t *)(base + 0),
+                                      __ATOMIC_ACQUIRE);
+        if (wc == rc) {
+            PyBuffer_Release(&buf);
+            Py_RETURN_NONE;
+        }
+        uint64_t pos = rc % (uint64_t)cap;
+        uint32_t l32, m32;
+        memcpy(&l32, data + pos, 4);
+        if (l32 == SHM_WRAP) {
+            __atomic_store_n((uint64_t *)(base + 64),
+                             rc + ((uint64_t)cap - pos), __ATOMIC_RELEASE);
+            continue;
+        }
+        memcpy(&m32, data + pos + 4, 4);
+        uint64_t rec = 8 + (((uint64_t)l32 + 7) & ~7ULL);
+        if (rec > (uint64_t)cap - pos) {
+            PyBuffer_Release(&buf);
+            PyErr_SetString(PyExc_ValueError, "shm_pop: corrupt record");
+            return NULL;
+        }
+        PyObject *payload = PyBytes_FromStringAndSize(
+            (const char *)(data + pos + 8), (Py_ssize_t)l32);
+        if (!payload) { PyBuffer_Release(&buf); return NULL; }
+        uint64_t drained = *(uint64_t *)(base + 72);
+        __atomic_store_n((uint64_t *)(base + 64), rc + rec,
+                         __ATOMIC_RELEASE);
+        __atomic_store_n((uint64_t *)(base + 72), drained + m32,
+                         __ATOMIC_RELEASE);
+        PyObject *res = Py_BuildValue("(Nk)", payload,
+                                      (unsigned long)m32);
+        PyBuffer_Release(&buf);
+        return res;
+    }
+}
 #endif /* !MS_WINDOWS */
 
 static PyMethodDef hw_methods[] = {
@@ -1554,6 +1722,15 @@ static PyMethodDef hw_methods[] = {
     {"sock_writev", hw_sock_writev, METH_VARARGS,
      "sock_writev(fd, chunks) -> bytes written: vectored send of an "
      "encoded chunk list (partial writes possible)."},
+    {"bind_reuseport", hw_bind_reuseport, METH_VARARGS,
+     "bind_reuseport(host, port) -> fd: listening socket in an "
+     "SO_REUSEPORT accept group (option set before bind)."},
+    {"shm_push", hw_shm_push, METH_VARARGS,
+     "shm_push(buf, capacity, payload, n_msgs) -> bool: append one "
+     "record to a cross-process SPSC shm ring (False = full)."},
+    {"shm_pop", hw_shm_pop, METH_VARARGS,
+     "shm_pop(buf, capacity) -> (payload, n_msgs) | None: pop one "
+     "record from a cross-process SPSC shm ring."},
 #endif
     {"configure", hw_configure, METH_VARARGS,
      "configure(GrainId, cat_members, SiloAddress, ActivationId, "

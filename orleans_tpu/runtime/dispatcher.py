@@ -300,7 +300,16 @@ class Dispatcher:
         if msg.is_expired:
             log.warning("dropping expired vector request %s", msg.method_name)
             return
+        proxy = getattr(rt, "is_shm_proxy", False)
         if msg.method_name in BULK_METHODS:
+            if proxy:
+                # worker process: population-wide ops anchor where the
+                # engine lives — re-address to the owner silo over the
+                # normal wire (bulk ops carry their own peer fan-out;
+                # the staging ring is for per-key call batches)
+                msg.target_silo = rt.owner_address
+                self.transmit(msg)
+                return
             # population-wide collective: no single target key, so the
             # per-key ownership forward below must not see it — the
             # receiving silo anchors (or runs its partition of) the op
@@ -312,21 +321,27 @@ class Dispatcher:
         # single-owner routing: device-tier state for a key lives in ONE
         # silo's table (the single-activation constraint); ring ownership
         # decides which, exactly like directory partitioning. Forward-count
-        # bound prevents ping-pong during membership transitions.
-        owner = self.silo.locator.ring.owner(msg.target_grain.uniform_hash)
-        if owner is not None and owner != self.silo.silo_address:
-            if msg.forward_count >= MAX_FORWARD_COUNT:
-                # never execute on a non-owner: that would mint a second
-                # divergent copy of the key's device state. Reject so the
-                # caller retries against a converged membership view.
-                self._reject(msg, RejectionType.TRANSIENT,
-                             f"vector owner unresolved after "
-                             f"{msg.forward_count} forwards")
+        # bound prevents ping-pong during membership transitions. A shm
+        # proxy skips the forward outright: every call from a worker
+        # process funnels over the staging ring into the ONE owner-process
+        # engine, so the constraint holds by topology, not by routing.
+        if not proxy:
+            owner = self.silo.locator.ring.owner(
+                msg.target_grain.uniform_hash)
+            if owner is not None and owner != self.silo.silo_address:
+                if msg.forward_count >= MAX_FORWARD_COUNT:
+                    # never execute on a non-owner: that would mint a
+                    # second divergent copy of the key's device state.
+                    # Reject so the caller retries against a converged
+                    # membership view.
+                    self._reject(msg, RejectionType.TRANSIENT,
+                                 f"vector owner unresolved after "
+                                 f"{msg.forward_count} forwards")
+                    return
+                msg.forward_count += 1
+                msg.target_silo = owner
+                self.transmit(msg)
                 return
-            msg.forward_count += 1
-            msg.target_silo = owner
-            self.transmit(msg)
-            return
         try:
             args, kwargs = msg.body if msg.body is not None else ((), {})
             if args:
@@ -411,6 +426,9 @@ class Dispatcher:
         rt = self.silo.vector
         my_addr = self.silo.silo_address
         ring = self.silo.locator.ring
+        # worker process (runtime.multiproc): no ownership forwards —
+        # the staging ring funnels everything into the owner engine
+        proxy = getattr(rt, "is_shm_proxy", False)
         bridge = getattr(self.silo, "vector_bridges", {}).get(vcls)
         tbl = rt.table(vcls)
         tracer = self.silo.tracer
@@ -422,11 +440,18 @@ class Dispatcher:
                             msg.method_name)
                 continue
             if msg.method_name in BULK_METHODS:
+                if proxy:
+                    # anchor where the engine lives (see
+                    # _handle_vector_request)
+                    msg.target_silo = rt.owner_address
+                    self.transmit(msg)
+                    continue
                 # bulk collectives peel before the per-key ownership
                 # check (they have no single target key to route by)
                 self._handle_vector_bulk(vcls, msg)
                 continue
-            owner = ring.owner(msg.target_grain.uniform_hash)
+            owner = None if proxy else \
+                ring.owner(msg.target_grain.uniform_hash)
             if owner is not None and owner != my_addr:
                 if msg.target_silo is None or msg.target_silo != my_addr:
                     # unaddressed gateway ingress: address like the

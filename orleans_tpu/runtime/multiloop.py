@@ -1164,22 +1164,37 @@ class EgressShard:
                                    (f"client:{addr}",
                                     sum(len(c) for c in chunks))))
             self.encoded += 1
-            try:
-                writer.write_many(chunks)
-            except Exception:  # noqa: BLE001 — client gone mid-write
-                log.info("dropping shard batch to disconnected client %s",
-                         addr)
-
-                def _drop(f=fabric, a=addr, w=writer):
-                    # is-ours identity check (same rule as _on_err): by
-                    # the time this runs on the main loop a reconnected
-                    # client may have registered a NEW route under addr
-                    if f.client_routes.get(a) is w:
-                        f._drop_client_route(a)
+            write_many = getattr(writer, "write_many", None)
+            if write_many is None:
+                # main-loop StreamWriter under standalone egress
+                # (ingress_loops=1): the encode above already ran HERE,
+                # off the main loop — the multi-loop residue fix. Only
+                # the final fd write marshals back; the fabric tail
+                # handles the disconnected-client drop on its own loop.
                 try:
-                    self.main_loop.call_soon_threadsafe(_drop)
+                    self.main_loop.call_soon_threadsafe(
+                        fabric._stream_write_client, addr, writer,
+                        b"".join(chunks))
                 except RuntimeError:
-                    pass
+                    pass  # main loop closed: route dying anyway
+            else:
+                try:
+                    write_many(chunks)
+                except Exception:  # noqa: BLE001 — client gone mid-write
+                    log.info("dropping shard batch to disconnected "
+                             "client %s", addr)
+
+                    def _drop(f=fabric, a=addr, w=writer):
+                        # is-ours identity check (same rule as _on_err):
+                        # by the time this runs on the main loop a
+                        # reconnected client may have registered a NEW
+                        # route under addr
+                        if f.client_routes.get(a) is w:
+                            f._drop_client_route(a)
+                    try:
+                        self.main_loop.call_soon_threadsafe(_drop)
+                    except RuntimeError:
+                        pass
         self._recycle_responses(msgs)
         if stamps:
             self.stat_ring.push((0, stamps), 0)
@@ -1311,6 +1326,20 @@ class EgressShardPool:
                 idx = self._rr
                 self._rr = (self._rr + 1) % len(self.shards)
             self._assigned[endpoint] = idx
+        return self.shards[idx]
+
+    def shard_for_client(self, addr) -> EgressShard:
+        """Sticky shard for one CLIENT route (the multi-loop residue
+        fix): under ``ingress_loops=1`` client connections are accepted
+        on the main loop, so without this their response encodes ran
+        there too while silo-peer links already encoded on the shards.
+        Round-robin at registration, sticky for the connection's life —
+        per-client FIFO holds exactly like per-peer FIFO does."""
+        idx = self._assigned.get(addr)
+        if idx is None:
+            idx = self._rr
+            self._rr = (self._rr + 1) % len(self.shards)
+            self._assigned[addr] = idx
         return self.shards[idx]
 
     def _apply_stats(self, item) -> None:

@@ -138,6 +138,20 @@ class SiloConfig:
     # Default 0 = today's main-loop senders/encode bit for bit (the
     # A/B lever); in-proc fabrics have no sockets and ignore the knob.
     egress_shards: int = 0
+    # multi-process silo (runtime.multiproc, ISSUE 18): N >= 2 forks N
+    # single-GIL worker processes at start(). Each worker is a full
+    # cluster-member silo that binds the SAME advertised endpoint with
+    # an SO_REUSEPORT listener — the kernel balances accepted
+    # connections across workers and a connection pins to its accepting
+    # worker for life (senders hash grains to connections, so the
+    # multiloop per-grain FIFO argument carries over verbatim; host
+    # activations live in the accepting worker). The device engine is
+    # owned by THIS process only: workers feed vector calls through
+    # cross-process SPSC staging rings on multiprocessing.shared_memory
+    # and completions ride per-worker response rings back. Default 1 =
+    # today's single-process path bit for bit (the A/B lever). Requires
+    # a SocketFabric and a file-backed membership table.
+    worker_procs: int = 1
     # batched egress (the response-path twin of batched_ingress):
     # responses resolved from one inbound batch group per origin in a
     # per-destination flush accumulator (runtime.egress.EgressBatcher)
@@ -811,6 +825,27 @@ class Silo:
         self.registry = registry
         self.storage_manager = storage
         self.silo_address = fabric.allocate_address(config.name)
+        # multi-process silo (runtime.multiproc): a SEPARATE advertised
+        # gateway endpoint reserved with SO_REUSEPORT at construction
+        # time (so it is printable/dialable before start). Forked
+        # workers join its accept group with their own listeners; the
+        # owner never accepts there and closes its copy once the
+        # workers are serving. silo_address stays a normal internal
+        # endpoint — all silo-to-silo traffic (membership probes,
+        # directory ops, forwards) avoids the reuseport group entirely.
+        self.advertised_address: SiloAddress | None = None
+        # runtime.multiproc.WorkerSupervisor once start() forks
+        self.workers: Any = None
+        if config.worker_procs > 1:
+            try:
+                self.advertised_address = fabric.allocate_address(
+                    config.name + "-gw", reuseport=True)
+            except TypeError:
+                from ..core.errors import ConfigurationError
+                raise ConfigurationError(
+                    "worker_procs > 1 needs a SocketFabric (SO_REUSEPORT "
+                    "accept balancing is a kernel feature; the in-proc "
+                    "fabric has no kernel)") from None
         self.stats = StatsRegistry()
         # ingest stage instrumentation (observability.stats.INGEST_STATS):
         # the registry when metrics are enabled, else None — every stage
@@ -944,6 +979,14 @@ class Silo:
     def runtime(self) -> "Silo":
         return self
 
+    @property
+    def gateway_endpoint(self) -> str:
+        """What clients dial: the SO_REUSEPORT advertised endpoint when
+        this silo runs worker processes, else the silo's own endpoint."""
+        if self.advertised_address is not None:
+            return self.advertised_address.endpoint
+        return self.silo_address.endpoint
+
     def get_stream_provider(self, name: str):
         try:
             return self.stream_providers[name]
@@ -964,6 +1007,18 @@ class Silo:
             log.info("SiloConfig.%s = %r", f.name,
                      getattr(self.config, f.name))
         self.status = "Joining"
+        if self.config.worker_procs > 1 and self.workers is None:
+            # fork FIRST — before the message center, profiler, metrics
+            # or any other thread-spawning service: each child must
+            # begin from a quiet interpreter (only the forking thread
+            # survives a fork), and a child never touches inherited
+            # loop/jax state
+            from .multiproc import WorkerSupervisor
+            self.workers = WorkerSupervisor(self)
+            self.workers.fork_workers()
+            self.workers.attach(asyncio.get_running_loop())
+            self.fabric.gateway_drop_endpoint = \
+                self.advertised_address.endpoint
         if self.config.eager_turns:
             _install_eager_factory(asyncio.get_running_loop())
             self._eager_installed = True
@@ -1033,6 +1088,11 @@ class Silo:
                 await r
         if self.membership is not None:
             await self.membership.become_active()
+        if self.workers is not None:
+            # every worker serving its reuseport listener, then retire
+            # the owner's never-accepting copy — from here the kernel
+            # balances ALL client ingress across the worker processes
+            await self.workers.wait_ready()
         self.status = "Running"
         log.info("silo %s running", self.silo_address)
 
@@ -1049,9 +1109,30 @@ class Silo:
             self.membership.stop()  # kill: timers die with us, no goodbye row
         if not graceful:
             self.dispatcher.cancel_turns()
+        workers_sup = None
+        if self.workers is not None:
+            # worker fleet first: each worker silo drains its own
+            # clients/turns (final vector calls still resolve through
+            # the engine, which is alive until shutdown_worker below),
+            # processes join, rings sweep (pushed == drained), segments
+            # unlink
+            workers_sup = self.workers
+            await workers_sup.stop(graceful=graceful)
+            self.workers = None
+            self.fabric.gateway_drop_endpoint = None
+            self.fabric.route_relays.clear()
+            if not graceful:
+                # kill path: membership timers died above, so no more
+                # table writes can land in the auto-provisioned dir
+                workers_sup.cleanup_membership_dir()
         if graceful:
             if self.membership is not None:
                 await self.membership.shutdown()
+            if workers_sup is not None:
+                # AFTER the owner's goodbye write: the owner's own
+                # iam-alive/refresh timers keep writing the shared table
+                # file until the shutdown above
+                workers_sup.cleanup_membership_dir()
             # let in-flight turns finish before tearing down the catalog;
             # stragglers past the deactivation budget are cancelled
             await self.dispatcher.drain_turns(self.config.deactivation_timeout)
@@ -1170,10 +1251,21 @@ class Silo:
             target_silo=a, category=Category.SYSTEM, timeout=1.0)
             for a in peers]
         results = await asyncio.gather(*calls, return_exceptions=True)
+        # cross-process span-level dedup: worker-process silos make the
+        # duplicate pull real — a leg that was forwarded (or a span a
+        # peer itself pulled and retained) can come back from more than
+        # one silo in this fan-out, and export must not double-count it
         out: list[dict] = []
+        seen: set = set()
         for r in results:
             if not isinstance(r, BaseException) and r:
-                out.extend(r)
+                for d in r:
+                    sid = d.get("span_id")
+                    if sid is not None:
+                        if sid in seen:
+                            continue
+                        seen.add(sid)
+                    out.append(d)
         return out
 
     def _install_loop_profiler(self, loop) -> None:

@@ -68,6 +68,9 @@ if TYPE_CHECKING:
 
 log = logging.getLogger("orleans.socket")
 
+# _relay_endpoint's "not a relay case" marker (None means "consumed")
+_NO_RELAY = object()
+
 __all__ = ["SocketFabric", "GatewayClient"]
 
 _CONNECT_RETRIES = 3
@@ -535,17 +538,49 @@ class SocketFabric:
         # on): the inline client-route encode+write paths book their
         # slice under "egress" so the sharded-egress A/B is measurable
         self.loop_prof = None
+        # multi-process silo (runtime.multiproc) relay state. All three
+        # stay empty/None under worker_procs=1 — the delivery hot path
+        # pays one falsy check on its MISS branches only.
+        #   route_relays: owner-side, client pseudo-address -> internal
+        #     endpoint of the worker holding that connection (announced
+        #     over the staging rings); consulted after a client_routes
+        #     miss because the pseudo-address carries the ADVERTISED
+        #     endpoint — dialing it would let the kernel hand the
+        #     connection to an arbitrary reuseport worker
+        self.route_relays: dict[SiloAddress, str] = {}
+        #   endpoint_aliases: worker-side, advertised endpoint -> the
+        #     owner's internal endpoint; a message for a client another
+        #     process holds routes to the owner, which relays
+        self.endpoint_aliases: dict[str, str] = {}
+        #   route_notify: worker-side callback (addr, up) fired when a
+        #     client route registers/drops, so the owner's relay table
+        #     tracks this process's connections
+        self.route_notify = None
+        #   gateway_drop_endpoint: owner-side, the advertised endpoint —
+        #     a client target there with NO relay is dropped, never
+        #     dialed (the kernel would hand the new connection to an
+        #     arbitrary reuseport worker, not the client)
+        self.gateway_drop_endpoint: str | None = None
 
     # -- address allocation ---------------------------------------------
-    def allocate_address(self, name: str) -> SiloAddress:
+    def allocate_address(self, name: str,
+                         reuseport: bool = False) -> SiloAddress:
         """Bind + listen immediately so peers can connect (backlog) even
         before the asyncio server attaches in register_silo — no startup
-        race between silos dialing each other."""
-        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        sock.bind((self.host, 0))
-        sock.listen(128)
-        sock.setblocking(False)
+        race between silos dialing each other. ``reuseport=True``
+        reserves a multi-process ADVERTISED endpoint: the socket opens
+        an SO_REUSEPORT accept group that forked worker processes join
+        with their own listeners (the owner's copy never accepts and
+        closes once the workers are serving)."""
+        if reuseport:
+            from .multiproc import _reuseport_listener
+            sock = _reuseport_listener(self.host, 0)
+        else:
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            sock.bind((self.host, 0))
+            sock.listen(128)
+            sock.setblocking(False)
         port = sock.getsockname()[1]
         addr = SiloAddress(self.host, port, _fresh_generation())
         self._listen_socks[addr.endpoint] = sock
@@ -716,9 +751,41 @@ class SocketFabric:
         if client_writer is not None:
             self._write_to_client(target, client_writer, msg)
             return
+        if self.route_relays or self.endpoint_aliases or \
+                self.gateway_drop_endpoint is not None:
+            ep = self._relay_endpoint(target, msg)
+            if ep is not _NO_RELAY:
+                if ep is not None:
+                    self._sender_for(ep).feed(msg)
+                return
         if target in self.dead:
             return
         self._sender_for(target.endpoint).feed(msg)
+
+    def _relay_endpoint(self, target: SiloAddress, msg: Message):
+        """Multi-process relay resolution for a client pseudo-address
+        another process holds (runtime.multiproc). Returns the internal
+        endpoint to relay through, None when the message was consumed
+        (dropped: unroutable or over the hop bound), or ``_NO_RELAY``
+        when this target is not a relay case at all. The forward count
+        bounds the worker->owner->worker path exactly like dispatcher
+        forwards — a stale relay can bounce at most that many times."""
+        ep = self.route_relays.get(target) if self.route_relays else None
+        if ep is None and self.endpoint_aliases:
+            ep = self.endpoint_aliases.get(target.endpoint)
+        if ep is None:
+            if target.endpoint == self.gateway_drop_endpoint:
+                log.info("dropping message for client %s with no relay "
+                         "route (disconnected)", target)
+                return None
+            return _NO_RELAY
+        from .dispatcher import MAX_FORWARD_COUNT
+        if msg.forward_count >= MAX_FORWARD_COUNT:
+            log.info("dropping message for unroutable client %s "
+                     "(relay hop bound)", target)
+            return None
+        msg.forward_count += 1
+        return ep
 
     # -- outbound sender placement (sharded egress) -----------------------
     def _sender_for(self, endpoint: str):
@@ -826,6 +893,20 @@ class SocketFabric:
         self.client_routes.pop(addr, None)
         self._route_owner.pop(addr, None)
         self._client_native.pop(addr, None)
+        if self.route_notify is not None:
+            self.route_notify(addr, False)
+
+    def _stream_write_client(self, addr: SiloAddress, writer,
+                             data: bytes) -> None:
+        """Main-loop tail of a shard-encoded client write (standalone
+        egress over a plain StreamWriter): the shard already paid the
+        encode; only the fd write lands here."""
+        try:
+            writer.write(data)
+        except Exception:  # noqa: BLE001 — client gone mid-write
+            log.info("dropping message to disconnected client %s", addr)
+            if self.client_routes.get(addr) is writer:
+                self._drop_client_route(addr)
 
     @staticmethod
     def _marshal_client_write(writer, data: bytes) -> None:
@@ -964,6 +1045,15 @@ class SocketFabric:
         if client_writer is not None:
             self._write_client_batch(target, client_writer, msgs)
             return
+        if self.route_relays or self.endpoint_aliases or \
+                self.gateway_drop_endpoint is not None:
+            ep = self._relay_endpoint(target, first)
+            if ep is not _NO_RELAY:
+                if ep is not None:
+                    for m in msgs[1:]:
+                        m.forward_count += 1
+                    self._sender_for(ep).feed_group(msgs)
+                return
         if target in self.dead:
             return
         self._sender_for(target.endpoint).feed_group(msgs)
@@ -990,6 +1080,18 @@ class SocketFabric:
                 self._route_owner[peer_addr] = silo.silo_address
                 self._client_native[peer_addr] = bool(
                     hs.get("hotwire", False))
+                pool = self.egress_pool
+                if pool is not None and not pool.closed and \
+                        silo.ingress_pool is None:
+                    # standalone-egress residue fix: pin this client
+                    # route to an egress shard so its response encodes
+                    # leave the main loop like silo-peer links already
+                    # do (multi-loop ingress pins routes shard-side)
+                    writer.egress_shard = pool.shard_for_client(peer_addr)
+                if self.route_notify is not None:
+                    # multi-process worker: announce the route so the
+                    # owner can relay responses produced elsewhere
+                    self.route_notify(peer_addr, True)
             # ingest stage metrics (observability.stats.INGEST_STATS):
             # decode is timed inside decode_frames/decode_message (which
             # also stamp the envelope's received_at) and frames-per-read
@@ -1047,6 +1149,8 @@ class SocketFabric:
                 self.client_routes.pop(peer_addr, None)
                 self._route_owner.pop(peer_addr, None)
                 self._client_native.pop(peer_addr, None)
+                if self.route_notify is not None:
+                    self.route_notify(peer_addr, False)
             writer.close()
 
     async def _pump_batched(self, silo: "Silo",

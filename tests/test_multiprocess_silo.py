@@ -165,6 +165,198 @@ async def test_mixed_build_cluster_negotiates_codec(tmp_path):
                     await silo.stop()
 
 
+# --------------------------------------------------------------------------
+# worker_procs silos (ISSUE 18): forked SO_REUSEPORT workers + shm rings
+# --------------------------------------------------------------------------
+
+def _vector_grain():
+    """Deterministic accumulating vector grain, built lazily so the jax
+    import stays inside the tests that need it. ``add`` folds each call's
+    float into per-key state — the SAME call sequence must produce
+    bit-identical accumulator reads whether the calls reach the engine
+    in-process (worker_procs=1) or across the shm staging rings
+    (worker_procs=2)."""
+    import jax.numpy as jnp
+
+    from orleans_tpu.dispatch import VectorGrain, actor_method
+
+    class AccumVec(VectorGrain):
+        STATE = {"acc": (jnp.float32, ()), "n": (jnp.int32, ())}
+
+        @staticmethod
+        def initial_state(key_hash):
+            return {"acc": jnp.float32(0), "n": jnp.int32(0)}
+
+        @actor_method(args={"x": (jnp.float32, ())})
+        def add(state, args):
+            new = {"acc": state["acc"] + args["x"], "n": state["n"] + 1}
+            return new, new["acc"]
+
+    return AccumVec
+
+
+def _build_mp_silo(table_path, vec_cls, worker_procs, name="mp"):
+    from orleans_tpu.dispatch import add_vector_grains
+    from orleans_tpu.parallel import make_mesh
+
+    fabric = SocketFabric()
+    b = (SiloBuilder().with_name(name).with_fabric(fabric)
+         .add_grains(EchoGrain)
+         .with_config(**LIVENESS, worker_procs=worker_procs))
+    add_vector_grains(b, vec_cls, mesh=make_mesh(8), capacity_per_shard=32)
+    silo = b.build()
+    join_cluster(silo, FileMembershipTable(table_path))
+    return silo
+
+
+async def _accum_sequence(endpoint, vec_cls, n_clients=4, keys=24,
+                          rounds=3):
+    """The shared parity workload: ``rounds`` waves of one ``add`` per
+    key, keys striped over ``n_clients`` gateway connections, results
+    collected IN ORDER. Returns the flat list of accumulator reads."""
+    clients = []
+    out = []
+    try:
+        for _ in range(n_clients):
+            clients.append(await GatewayClient(
+                [endpoint], response_timeout=15.0).connect())
+        for r in range(rounds):
+            vals = await asyncio.gather(*(
+                clients[k % n_clients].get_grain(vec_cls, k)
+                .add(x=float(k) * 0.5 + r)
+                for k in range(keys)))
+            out.extend(float(v) for v in vals)
+    finally:
+        for c in clients:
+            await c.close_async()
+    return out
+
+
+async def test_worker_procs_vector_parity_debug_pool(tmp_path):
+    """Bit-for-bit parity (the ISSUE 18 acceptance point): the same call
+    sequence against worker_procs=1 and worker_procs=2 silos produces
+    IDENTICAL accumulator reads — the shm staging rings + proxy
+    re-address + call_packed unpack change where the bytes travel, never
+    what the engine computes. Runs under debug pool-poisoning
+    (ORLEANS_TPU_DEBUG_POOL): forked workers inherit the flag, so a
+    recycled message shell touched by the relay/proxy paths would
+    assert, in any of the three processes."""
+    from orleans_tpu.core.message import set_debug_pool
+
+    vec_cls = _vector_grain()
+    prev = set_debug_pool(True)
+    try:
+        results = {}
+        for procs in (1, 2):
+            silo = _build_mp_silo(str(tmp_path / f"mbr{procs}.json"),
+                                  vec_cls, procs, name=f"par{procs}")
+            await silo.start()
+            try:
+                results[procs] = await _accum_sequence(
+                    silo.gateway_endpoint, vec_cls)
+                if procs == 2:
+                    d = silo.workers.describe()
+                    # clean-shutdown accounting: every decoded-and-staged
+                    # record drained, every completion delivered (the
+                    # counters are single-writer cumulative — torn-free)
+                    assert all(w["req_pushed"] == w["req_drained"] and
+                               w["resp_pushed"] == w["resp_drained"]
+                               for w in d["workers"]), d
+                    # the vector traffic actually crossed the rings:
+                    # every one of the 24 keys x 3 rounds staged exactly
+                    # one message (vec records count n_msgs=1 per call;
+                    # route/ready records count 0)
+                    assert sum(w["req_pushed"]
+                               for w in d["workers"]) == 24 * 3, d
+            finally:
+                await silo.stop()
+        assert results[2] == results[1], (
+            "shm-ring vector path diverged from the in-process path")
+    finally:
+        set_debug_pool(prev)
+
+
+async def test_worker_sigkill_rebalance(tmp_path):
+    """SIGKILL one worker mid-traffic: the kernel stops handing its
+    accept share out (new connections land on the survivor), the owner's
+    membership probes declare the worker silo dead, the supervisor drops
+    its relay routes, and traffic through the survivor — host and vector
+    — keeps answering. Clean shutdown afterwards still accounts every
+    staged record (pushed == drained on the survivor's rings)."""
+    vec_cls = _vector_grain()
+    silo = _build_mp_silo(str(tmp_path / "mbr.json"), vec_cls, 2,
+                          name="killmp")
+    await silo.start()
+    clients = []
+    try:
+        # pre-kill traffic over several connections (some will be pinned
+        # to the worker we are about to kill — that is the point)
+        for _ in range(4):
+            clients.append(await GatewayClient(
+                [silo.gateway_endpoint], response_timeout=15.0).connect())
+        vals = await asyncio.gather(*(
+            clients[k % 4].get_grain(vec_cls, k).add(x=1.0)
+            for k in range(16)))
+        assert [float(v) for v in vals] == [1.0] * 16
+
+        d = silo.workers.describe()
+        assert sum(w["client_routes"] for w in d["workers"]) == 4
+        victim = d["workers"][0]
+        survivor = d["workers"][1]
+        os.kill(victim["pid"], signal.SIGKILL)
+
+        # the supervisor's reaper notices the death and the owner's
+        # probes declare the worker silo dead (directory convergence)
+        async def worker_reaped():
+            while True:
+                dd = silo.workers.describe()
+                if not dd["workers"][0]["alive"]:
+                    return
+                await asyncio.sleep(0.1)
+        await asyncio.wait_for(worker_reaped(), timeout=10)
+
+        async def declared_dead():
+            while not any(victim["silo"] in str(a)
+                          for a in silo.membership.dead):
+                await asyncio.sleep(0.1)
+        await asyncio.wait_for(declared_dead(), timeout=20)
+
+        # new connections can only land on the survivor (the dead
+        # worker's SO_REUSEPORT listener died with it) and must answer
+        fresh = []
+        for _ in range(3):
+            fresh.append(await GatewayClient(
+                [silo.gateway_endpoint], response_timeout=15.0).connect())
+        clients.extend(fresh)
+        vals = await asyncio.gather(*(
+            c.get_grain(vec_cls, 100 + i).add(x=2.0)
+            for i, c in enumerate(fresh)))
+        assert [float(v) for v in vals] == [2.0] * 3
+        outs = await asyncio.gather(*(
+            c.get_grain(EchoGrain, 200 + i).echo("hi")
+            for i, c in enumerate(fresh)))
+        assert outs == [f"{200 + i}:hi" for i in range(3)]
+
+        d2 = silo.workers.describe()
+        # accept rebalancing: every fresh connection pinned to the
+        # survivor, and the dead worker's relay routes were dropped
+        assert d2["workers"][0]["client_routes"] == 0, d2
+        assert d2["workers"][1]["client_routes"] >= 3, d2
+        assert d2["workers"][1]["alive"]
+        # the survivor's rings still account every decoded message
+        assert survivor["silo"] == d2["workers"][1]["silo"]
+        w = d2["workers"][1]
+        assert w["req_pushed"] == w["req_drained"], d2
+        assert w["resp_pushed"] == w["resp_drained"], d2
+    finally:
+        for c in clients:
+            try:
+                await c.close_async()
+            except Exception:
+                pass
+        await silo.stop()
+
+
 async def test_cross_os_process_cluster_and_kill(tmp_path):
     table_path = str(tmp_path / "mbr.json")
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -393,34 +393,11 @@ MULTILOOP_PUMP_SHARE_RATIO_CEIL = 0.85
 MULTILOOP_MIN_CORES = 4
 
 
-def _parallel_capacity() -> float:
-    """CONSERVATIVE estimate of the speedup 2 threads of GIL-released
-    work see vs serial on this runner: min serial time / max parallel
-    time over 3 interleaved rounds, so transient quota throttling can
-    only understate capacity (understating skips the throughput floor,
-    never falsely arms it)."""
-    import hashlib
-    import threading
-    import time as _t
-    buf = b"x" * (1 << 22)
-
-    def work(n):
-        for _ in range(n):
-            hashlib.sha256(buf).digest()
-
-    serial_best, par_worst = float("inf"), 0.0
-    for _ in range(3):
-        t0 = _t.perf_counter()
-        work(12)
-        serial_best = min(serial_best, _t.perf_counter() - t0)
-        t0 = _t.perf_counter()
-        ts = [threading.Thread(target=work, args=(6,)) for _ in range(2)]
-        for t in ts:
-            t.start()
-        for t in ts:
-            t.join()
-        par_worst = max(par_worst, _t.perf_counter() - t0)
-    return serial_best / par_worst if par_worst else 0.0
+# one probe definition for every parallel-lever floor (multiloop,
+# sharded egress, multiproc) AND the benchmark snapshots — extracted to
+# benchmarks/parallel_probe so a recorded ratio always travels with the
+# capacity of the box that measured it (ISSUE 18 satellite)
+from benchmarks.parallel_probe import parallel_capacity as _parallel_capacity
 
 
 async def test_floor_multiloop():
@@ -532,6 +509,80 @@ async def test_floor_sharded_egress():
     assert speed >= 0.9, \
         f"sharded egress at {speed:.2f}x of unsharded on a multi-core " \
         f"runner — catastrophic regression"
+
+
+# Multi-process silos (ISSUE 18): worker_procs 1 vs 2 on identical mixed
+# TCP traffic to the advertised gateway endpoint. Share-based like the
+# floors above:
+#   * structural (always): clients must actually SPREAD over >= 2 worker
+#     processes (kernel SO_REUSEPORT accept balancing, read from the
+#     relay table), and the MAIN process's pump+egress occupancy share
+#     must collapse to ~0 of the single-process baseline — the owner
+#     never touches a client socket, only the shm-fed device engine
+#     (measured ~0.01-0.06x on this box; ceiling 0.3x trips only when
+#     client traffic leaks back onto the owner's loop).
+#   * throughput (gated on the same core-count + parallelism probe):
+#     separate GILs are REAL parallelism, so the >=1.7x ratio needs
+#     genuinely parallel cores to mean anything — this container
+#     (~0.5-1.6x probe) skips with the measured capacity in the reason.
+MULTIPROC_INGEST_SHARE_RATIO_CEIL = 0.3
+MULTIPROC_SPEEDUP_FLOOR = 1.7
+
+
+async def test_floor_multiproc():
+    import os
+
+    from benchmarks import loop_attribution
+
+    cores = (len(os.sched_getaffinity(0))
+             if hasattr(os, "sched_getaffinity") else (os.cpu_count() or 1))
+    if cores < 2:
+        pytest.skip("multi-process floor needs >=2 visible cores")
+
+    async def once():
+        r = await loop_attribution.run_multiproc_ab(seconds=1.5)
+        x = r["extra"]
+        return (r["value"], x["main_process_ingest_share_ratio"],
+                x["workers_with_clients"], x["worker_client_routes"])
+
+    speed, ratio, spread, routes = await once()
+    if ratio > MULTIPROC_INGEST_SHARE_RATIO_CEIL * 0.6 or \
+            speed < MULTIPROC_SPEEDUP_FLOOR * 1.1 or spread < 2:
+        s2, r2, sp2, rt2 = await once()  # noise guard: best of two
+        speed = max(speed, s2)
+        ratio = min(ratio, r2)
+        if sp2 > spread:
+            spread, routes = sp2, rt2
+    # structural, always: the kernel actually balanced the 4 gateway
+    # connections over >= 2 worker processes...
+    assert spread >= 2, \
+        f"client connections landed {routes} across workers — " \
+        f"SO_REUSEPORT accept balancing put them all in one process"
+    # ...and the owner's loop shed ALL client-facing work (socket reads,
+    # wire decode, response encode) onto the workers
+    assert ratio <= MULTIPROC_INGEST_SHARE_RATIO_CEIL, \
+        f"main-process pump+egress share only fell to {ratio:.2f}x of " \
+        f"single-process (ceiling {MULTIPROC_INGEST_SHARE_RATIO_CEIL}) " \
+        f"— client traffic is leaking onto the owner's loop"
+    if cores < MULTILOOP_MIN_CORES:
+        pytest.skip(
+            f"only {cores} visible cores — worker_procs=2 runs >=3 busy "
+            f"processes (owner engine + 2 workers) so the "
+            f">={MULTIPROC_SPEEDUP_FLOOR}x msgs/sec ratio needs "
+            f">={MULTILOOP_MIN_CORES}; structural spread {routes} + "
+            f"ingest-share A/B verified at {ratio:.2f}x")
+    capacity = _parallel_capacity()
+    if capacity < MULTIPROC_SPEEDUP_FLOOR:
+        pytest.skip(
+            f"runner delivers only {capacity:.2f}x to perfectly parallel "
+            f"GIL-released work (shared/throttled cores) — the "
+            f">={MULTIPROC_SPEEDUP_FLOOR}x msgs/sec ratio is only "
+            f"asserted on genuinely multi-core runners; structural "
+            f"spread {routes} + ingest-share A/B verified at "
+            f"{ratio:.2f}x")
+    assert speed >= MULTIPROC_SPEEDUP_FLOOR, \
+        f"2 worker processes only {speed:.2f}x of 1 " \
+        f"(floor {MULTIPROC_SPEEDUP_FLOOR}x on a multi-core runner)"
 
 
 # SLO monitor over the metrics pipeline: a same-process ratio (no

@@ -417,6 +417,38 @@ async def test_sharded_egress_parity_and_zero_constructs_nothing():
     assert results[0] == results[2] == [2 * i for i in range(32)]
 
 
+async def test_client_routes_encode_on_shards_under_single_ingress():
+    """The multi-loop residue fix (ISSUE 18 satellite): under
+    ``ingress_loops=1`` client connections are accepted on the MAIN
+    loop, and before the fix their response encodes ran there too while
+    silo-peer links already encoded on standalone egress shards. Now
+    ``_handle_conn`` pins every client route to a sticky shard
+    (``shard_for_client``), the encode runs shard-side, and only the
+    final fd write marshals back to the main-loop StreamWriter."""
+    silo = await _start_silo("resid", loops=1, shards=2)
+    client = None
+    try:
+        pool = silo.fabric.egress_pool
+        assert pool is not None and not pool.on_ingress  # standalone
+        client = await GatewayClient(
+            [silo.silo_address.endpoint], response_timeout=5.0).connect()
+        outs = await asyncio.gather(
+            *(client.get_grain(EchoGrain, i).echo(i) for i in range(32)))
+        assert outs == [2 * i for i in range(32)]
+        # the route was pinned to a shard at registration...
+        writers = list(silo.fabric.client_routes.values())
+        assert writers and all(
+            getattr(w, "egress_shard", None) is not None for w in writers)
+        # ...and the responses actually encoded there (the main-loop
+        # StreamWriter has no write_many, so a shard-side encode is only
+        # observable through the shard's own counter)
+        assert sum(s.encoded for s in pool.shards) > 0
+    finally:
+        if client is not None:
+            await client.close_async()
+        await silo.stop()
+
+
 async def test_recycle_discipline_under_debug_pool_sharded_egress():
     """ORLEANS_TPU_DEBUG_POOL=1 across the sharded response path:
     response batch → egress ring → shard encode (per-shard template

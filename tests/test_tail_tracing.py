@@ -265,6 +265,40 @@ async def test_cross_silo_leg_pull_via_control_path():
                     if s["trace_id"] in pulled_tids]
 
 
+async def test_pull_dedups_span_ids_across_fanout(monkeypatch):
+    """Cross-process span-level dedup (ISSUE 18 satellite): worker-process
+    silos make duplicate pulls real — a forwarded leg (or a span a peer
+    itself pulled and retained) can come back from MORE THAN ONE silo in
+    the ctl_trace_spans fan-out, and export must not double-count it.
+    The retained-trace pull keeps the first copy of each span_id."""
+    from orleans_tpu.core.ids import SiloAddress
+    from orleans_tpu.runtime import SiloBuilder
+
+    silo = (SiloBuilder().with_name("dedup")
+            .with_config(trace_enabled=True, trace_tail_enabled=True)
+            .build())
+    a1 = SiloAddress("127.0.0.1", 11, 1)
+    a2 = SiloAddress("127.0.0.1", 22, 1)
+    silo.locator.alive_list = [silo.silo_address, a1, a2]
+
+    def leg(sid):
+        return {"trace_id": 7, "span_id": sid, "parent_id": None,
+                "name": f"op{sid}", "kind": "server", "silo": "w",
+                "start": 0.0, "duration": 0.1, "attrs": {}}
+
+    async def fake_send_request(**kw):
+        # peer 1 and peer 2 both hold span 101 (one forwarded its leg
+        # through the other); 102 lacks a span_id and must pass through
+        if kw["target_silo"] == a1:
+            return [leg(100), leg(101)]
+        return [leg(101), leg(103), {"trace_id": 7, "attrs": {}}]
+
+    monkeypatch.setattr(silo.runtime_client, "send_request",
+                        fake_send_request)
+    out = await silo._pull_trace_legs(7)
+    assert [d.get("span_id") for d in out] == [100, 101, 103, None]
+
+
 # ----------------------------------------------------------------------
 # OTLP sink: batching / payload shape / retry / drop
 # ----------------------------------------------------------------------
