@@ -69,7 +69,16 @@ def _explain(rule_id: str) -> int:
 def main(argv: "list[str] | None" = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m orleans_tpu.analysis",
-        description="Actor-invariant static analyzer (OTPU001-OTPU009).")
+        description="Actor-invariant static analyzer (OTPU001-OTPU010).",
+        epilog="Exit codes: 0 — clean (no findings, or every finding "
+               "matched the baseline / an inline suppression); 1 — at "
+               "least one NEW finding or a file that does not parse "
+               "(OTPU000); 2 — usage or configuration error (unknown "
+               "rule id, filtered --write-baseline). Rule selection via "
+               "--rules is deterministic: ids are sorted and resolved "
+               "against the registry populated by importing every rule "
+               "module, so rules added in new modules load the same way "
+               "the built-ins do.")
     parser.add_argument("paths", nargs="*", default=["orleans_tpu"],
                         help="files or directories to scan "
                              "(default: orleans_tpu)")
@@ -96,6 +105,9 @@ def main(argv: "list[str] | None" = None) -> int:
                         help="print a rule's rationale and its "
                              "canonical bad/clean fixture pair, then "
                              "exit")
+    parser.add_argument("--stats", action="store_true",
+                        help="print per-phase wall time and the "
+                             "summary-cache hit ratio to stderr")
     args = parser.parse_args(argv)
 
     if args.explain:
@@ -122,8 +134,25 @@ def main(argv: "list[str] | None" = None) -> int:
             return 2
         rules = [RULES[r] for r in sorted(wanted)]
 
+    stats: "dict | None" = {} if args.stats else None
+    suppressed: list = []
     findings = analyze_paths(args.paths, rules=rules,
-                             interprocedural=not args.intra_only)
+                             interprocedural=not args.intra_only,
+                             stats=stats, suppressed=suppressed)
+    if stats is not None:
+        total = sum(v for k, v in stats.items() if k.endswith("_s"))
+        lookups = stats.get("cache_hits", 0) + \
+            stats.get("cache_misses", 0)
+        ratio = stats.get("cache_hits", 0) / lookups if lookups else 0.0
+        print(f"stats: {stats.get('files', 0)} file(s) in "
+              f"{total * 1000:.1f} ms — read+parse "
+              f"{stats.get('read_parse_s', 0.0) * 1000:.1f} ms, "
+              f"summarize {stats.get('summarize_s', 0.0) * 1000:.1f} ms"
+              f" (cache {stats.get('cache_hits', 0)}/{lookups} hit, "
+              f"{ratio:.0%}), link "
+              f"{stats.get('link_s', 0.0) * 1000:.1f} ms, rules "
+              f"{stats.get('rules_s', 0.0) * 1000:.1f} ms",
+              file=sys.stderr)
     floor = SEVERITY_ORDER[args.min_severity]
     findings = [f for f in findings
                 if SEVERITY_ORDER.get(f.severity, 1) >= floor
@@ -156,7 +185,12 @@ def main(argv: "list[str] | None" = None) -> int:
         }, indent=1, sort_keys=True))
     elif args.format == "sarif":
         from .sarif import sarif_json
-        print(sarif_json(new))
+        new_set = {id(f) for f in new}
+        baselined = [f for f in findings if id(f) not in new_set]
+        print(sarif_json(new, suppressed=suppressed,
+                         baselined=baselined,
+                         baseline_path=args.baseline or
+                         "analysis/baseline.json"))
     else:
         for f in new:
             print(f.render())

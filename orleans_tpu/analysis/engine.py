@@ -11,6 +11,7 @@ import ast
 import io
 import os
 import re
+import time
 import tokenize
 from typing import Iterable, Sequence
 
@@ -107,7 +108,9 @@ def analyze_source(source: str, rel_path: str, *,
                    path: str | None = None,
                    program: "object | None" = None,
                    interprocedural: bool = True,
-                   tree: "ast.Module | None" = None) -> list[Finding]:
+                   tree: "ast.Module | None" = None,
+                   suppressed: "list[Finding] | None" = None
+                   ) -> list[Finding]:
     """Run the (selected) rules over one source blob. Syntax errors come
     back as an ``OTPU000`` error finding rather than an exception — a
     file the analyzer cannot parse is a finding about that file.
@@ -116,7 +119,10 @@ def analyze_source(source: str, rel_path: str, *,
     ``interprocedural`` is set, a single-module program is built from
     this source alone (helper + caller in one file still link).
     ``interprocedural=False`` reproduces the legacy per-function pass —
-    no summaries, no call-site propagation, no program-backed rules."""
+    no summaries, no call-site propagation, no program-backed rules.
+    ``suppressed`` (optional list) collects the findings silenced by an
+    inline ``# otpu: ignore`` marker instead of dropping them — SARIF
+    reports them as ``suppressions`` so dashboards can trend the debt."""
     rel_path = rel_path.replace(os.sep, "/")
     if tree is None:
         try:
@@ -135,8 +141,12 @@ def analyze_source(source: str, rel_path: str, *,
     _spread_over_statements(supp, tree)
     findings: list[Finding] = []
     for rule in (rules if rules is not None else all_rules()):
-        findings.extend(f for f in rule.check(ctx)
-                        if not _is_suppressed(f, supp))
+        for f in rule.check(ctx):
+            if _is_suppressed(f, supp):
+                if suppressed is not None:
+                    suppressed.append(f)
+            else:
+                findings.append(f)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
@@ -187,11 +197,21 @@ def iter_python_files(paths: Sequence[str]) -> list[tuple[str, str]]:
 
 def analyze_paths(paths: Sequence[str], *,
                   rules: Iterable | None = None,
-                  interprocedural: bool = True) -> list[Finding]:
+                  interprocedural: bool = True,
+                  stats: "dict | None" = None,
+                  suppressed: "list[Finding] | None" = None
+                  ) -> list[Finding]:
     """Two-phase run: phase 1 summarizes every file (cached per content
     hash — see summaries.module_summary), phase 2 links them into one
     Program, then the rules run per file against the linked view. Files
-    are parsed once and the tree shared between summary and rules."""
+    are parsed once and the tree shared between summary and rules.
+
+    ``stats`` (optional dict) receives per-phase wall times in seconds
+    (``read_parse_s``, ``summarize_s``, ``link_s``, ``rules_s``), the
+    file count, and the phase-1 cache counters for this run
+    (``cache_hits``/``cache_misses``). ``suppressed`` collects inline-
+    suppressed findings (see ``analyze_source``)."""
+    t0 = time.perf_counter()
     loaded: list[tuple[str, str, str, "ast.Module | None"]] = []
     for full, rel in iter_python_files(paths):
         with open(full, encoding="utf-8") as fh:
@@ -201,18 +221,40 @@ def analyze_paths(paths: Sequence[str], *,
         except SyntaxError:
             tree = None
         loaded.append((full, rel.replace(os.sep, "/"), src, tree))
+    t1 = time.perf_counter()
 
     program = None
+    t2 = t1
     if interprocedural:
-        from .summaries import build_program
-        program = build_program(
-            [(src, rel, tree) for _, rel, src, tree in loaded
-             if tree is not None])
+        from .summaries import CACHE_STATS, Program, module_summary
+        before = dict(CACHE_STATS)
+        mods = []
+        for _, rel, src, tree in loaded:
+            if tree is None:
+                continue
+            try:
+                mods.append(module_summary(src, rel, tree))
+            except SyntaxError:
+                continue
+        t2 = time.perf_counter()
+        program = Program(mods)
+        if stats is not None:
+            stats["cache_hits"] = CACHE_STATS["hits"] - before["hits"]
+            stats["cache_misses"] = (CACHE_STATS["misses"] -
+                                     before["misses"])
+    t3 = time.perf_counter()
 
     findings: list[Finding] = []
     for full, rel, src, tree in loaded:
         findings.extend(analyze_source(
             src, rel, rules=rules, path=full, program=program,
-            interprocedural=interprocedural, tree=tree))
+            interprocedural=interprocedural, tree=tree,
+            suppressed=suppressed))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    if stats is not None:
+        stats["files"] = len(loaded)
+        stats["read_parse_s"] = t1 - t0
+        stats["summarize_s"] = t2 - t1
+        stats["link_s"] = t3 - t2
+        stats["rules_s"] = time.perf_counter() - t3
     return findings

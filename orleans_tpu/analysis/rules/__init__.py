@@ -1,6 +1,8 @@
 """Rule modules — importing this package registers every rule."""
 
-from . import concurrency, interfaces, pool, state, traced, turns  # noqa: F401
+from . import (  # noqa: F401
+    concurrency, interfaces, pool, rings, state, traced, turns,
+)
 
-__all__ = ["concurrency", "interfaces", "pool", "state", "traced",
-           "turns"]
+__all__ = ["concurrency", "interfaces", "pool", "rings", "state",
+           "traced", "turns"]

@@ -19,6 +19,12 @@ keep the rule honest at boundaries: a write whose receiver is a bare
 passing the live registry (or a None sink) from worker code is the
 finding, injecting None is clean.
 
+Context sensitivity is k=1 per call edge: a helper reached from BOTH a
+worker context and the main loop (or declared as an entry point) is
+"mixed" — its definite writes are flagged on each unambiguous worker
+call edge into it, never at the definition, so the main-loop path needs
+no suppression and the worker path cannot hide.
+
 **OTPU008 fence-discipline.** Donated device state — ``tbl.state`` rows,
 hit counters — may be mid-donation inside a worker-side kernel dispatch;
 touching it without the tick fence can materialize a deleted array or
@@ -103,10 +109,18 @@ class LoopConfinement(Rule):
             return
         for qual, s in ms.functions.items():
             key = (ms.module_key, qual)
-            reason = program.worker.get(key)
-            if reason is None:
+            kind = program.worker_context(key)
+            if kind is None:
                 continue
-            # -- direct writes in worker context ------------------------
+            reason = program.worker.get(key)
+            if kind == "mixed":
+                # k=1 edge context: this helper is ALSO reached from
+                # main-loop context (or is a declared entry point), so
+                # its body is not unconditionally worker code — the
+                # violation is judged on each worker call EDGE into it
+                # (emitted from the caller's side below)
+                continue
+            # -- direct writes in unambiguous worker context ------------
             for w in s.writes:
                 if w.recv_is_param is not None:
                     continue            # judged at call sites below
@@ -120,19 +134,42 @@ class LoopConfinement(Rule):
                     f"loop-confined registry write '{recv}.{w.method}()'"
                     f" in worker-thread context ({reason}); stamp "
                     "off-loop and replay loop-side", qual)
-            # -- call sites handing live registries to helpers ----------
+            # -- call edges out of unambiguous worker context -----------
             seen: set = set()
             for e in s.calls:
                 ckey = program.resolve_call(ms, qual, e.chain)
                 if ckey is None:
                     continue
                 callee = program.functions[ckey]
+                callee_kind = program.worker_context(ckey)
                 for w in callee.writes:
                     is_param_recv = w.recv_is_param is not None
                     has_guard = w.guard is not None and \
                         w.guard in callee.params
                     if not (is_param_recv or has_guard):
-                        continue        # handled at the definition
+                        # a definite write: flagged at the callee's
+                        # definition unless the callee is MIXED — then
+                        # THIS worker edge is the k=1 context
+                        if callee_kind != "mixed":
+                            continue
+                        if not self._typed_ok(
+                                program, program.modules[ckey[0]],
+                                ckey[1], w):
+                            continue
+                        dkey = (ckey, "edge", w.recv, w.method)
+                        if dkey in seen:
+                            continue
+                        seen.add(dkey)
+                        yield ctx.finding(
+                            self, _Anchor(e.lineno, e.col),
+                            f"worker-context call edge into "
+                            f"'{ckey[1]}' (which writes "
+                            f"'{'.'.join(w.recv)}.{w.method}()'); the "
+                            "helper is also reached from main-loop "
+                            "context, so the worker edge is the "
+                            f"violation ({reason}); stamp off-loop and "
+                            "replay loop-side", qual)
+                        continue
                     if not self._typed_ok(
                             program, program.modules[ckey[0]],
                             ckey[1], w):

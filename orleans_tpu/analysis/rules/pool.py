@@ -22,7 +22,16 @@ imports); ``y = x`` (and ``y = helper(x)`` when the helper returns its
 argument) makes ``y`` an alias whose release poisons the group; and loop
 bodies run twice with the back-edge state merged in, so a release in
 iteration N reaches a use in iteration N+1. Rebinding (``x = ...``) or
-``del x`` still clears the released state. The legacy intra-procedural
+``del x`` still clears the released state.
+
+Since the context-sensitivity upgrade the alias flow also crosses
+container and attribute boundaries: ``self._pending = m`` makes the
+attribute an alias of ``m``, ``batch.append(m)`` records membership so
+an item-release of the batch (``recycle_messages`` or a callee whose
+summary releases its container elements) poisons ``m``, and release
+depth is closed CROSS-module at link time via the Program's release
+overlay — a wrapper around an imported releaser poisons its callers'
+arguments even through multiple modules. The legacy intra-procedural
 configuration (no call-site propagation) stays available via the CLI's
 ``--intra-only``.
 """
@@ -34,10 +43,13 @@ from typing import Iterator
 
 from ..model import FileContext, Finding, Rule, register
 from ..summaries import (
+    ITEM_RELEASERS,
     RELEASERS,
     ReleaseWalker,
+    _arg_cell_name,
     _call_alias,
     _call_releases,
+    _call_releases_items,
 )
 from .common import iter_functions
 
@@ -46,9 +58,21 @@ def _direct_releases(call: ast.Call) -> list[str]:
     fn = call.func
     name = fn.attr if isinstance(fn, ast.Attribute) else \
         fn.id if isinstance(fn, ast.Name) else ""
-    if name in RELEASERS and call.args and \
-            isinstance(call.args[0], ast.Name):
-        return [call.args[0].id]
+    if name in RELEASERS and call.args:
+        nm = _arg_cell_name(call.args[0])
+        if nm is not None:
+            return [nm]
+    return []
+
+
+def _direct_item_releases(call: ast.Call) -> list[str]:
+    fn = call.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else \
+        fn.id if isinstance(fn, ast.Name) else ""
+    if name in ITEM_RELEASERS and call.args:
+        nm = _arg_cell_name(call.args[0])
+        if nm is not None:
+            return [nm]
     return []
 
 
@@ -58,7 +82,7 @@ def _has_releaser_call(fn) -> bool:
             f = node.func
             name = f.attr if isinstance(f, ast.Attribute) else \
                 f.id if isinstance(f, ast.Name) else ""
-            if name in RELEASERS:
+            if name in RELEASERS or name in ITEM_RELEASERS:
                 return True
     return False
 
@@ -92,12 +116,13 @@ class PoolDiscipline(Rule):
         releasing_short: set[str] = set()
         if ms is not None:
             for q, s in ms.functions.items():
-                if s.releases:
+                if s.releases or s.releases_items:
                     releasing_short.add(q.rsplit(".", 1)[-1])
             if program is not None:
-                for (mod, q), s in program.functions.items():
-                    if s.releases:
-                        releasing_short.add(q.rsplit(".", 1)[-1])
+                for key, s in program.functions.items():
+                    eff = program.release_summary(key)
+                    if eff.releases or eff.releases_items:
+                        releasing_short.add(key[1].rsplit(".", 1)[-1])
 
         for qualname, fn in iter_functions(ctx.tree):
             candidate = _has_releaser_call(fn)
@@ -133,12 +158,16 @@ class PoolDiscipline(Rule):
                        _call_releases(ms, _q, c, _e))
                 alias = (lambda c, _q=qualname, _e=extern:
                          _call_alias(ms, _q, c, _e))
+                items = (lambda c, _q=qualname, _e=extern:
+                         _call_releases_items(ms, _q, c, _e))
             else:
                 rel = _direct_releases
                 alias = None
+                items = _direct_item_releases
 
             walker = ReleaseWalker(_pos_params(fn), release_of_call=rel,
                                    alias_of_call=alias, on_use=on_use,
-                                   on_double=on_double)
+                                   on_double=on_double,
+                                   items_release_of_call=items)
             walker.run(fn.body)
             yield from findings

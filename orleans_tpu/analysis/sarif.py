@@ -1,9 +1,16 @@
 """SARIF 2.1.0 output — findings as CI-renderable annotations.
 
 Minimal but valid static-analysis interchange: one run, one driver, the
-rule metadata from the registry, one result per (non-baselined) finding.
-GitHub code scanning and most CI viewers render these as inline
-annotations at the exact line/column the text format prints.
+rule metadata from the registry, one result per finding. GitHub code
+scanning and most CI viewers render these as inline annotations at the
+exact line/column the text format prints.
+
+Suppressed findings are EMITTED, not omitted: an inline
+``# otpu: ignore`` marker becomes a result with an ``inSource``
+suppression, a baseline match becomes an ``external`` one (justified by
+the ratchet file). Dashboards can therefore trend suppression debt —
+an omitted finding looks identical to a fixed one, which is exactly the
+signal loss the ratchet exists to prevent.
 """
 
 from __future__ import annotations
@@ -22,9 +29,13 @@ SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
 
 
 def to_sarif(findings: Sequence[Finding], *,
+             suppressed: Sequence[Finding] = (),
+             baselined: Sequence[Finding] = (),
+             baseline_path: str = "analysis/baseline.json",
              tool_version: str = "1.0") -> dict:
     all_rules()  # ensure the registry is populated
-    rule_ids = sorted({f.rule for f in findings} | set(RULES))
+    rule_ids = sorted({f.rule for f in (*findings, *suppressed,
+                                        *baselined)} | set(RULES))
     rules_meta = []
     for rid in rule_ids:
         rule = RULES.get(rid)
@@ -42,9 +53,8 @@ def to_sarif(findings: Sequence[Finding], *,
         rules_meta.append(meta)
     index = {rid: i for i, rid in enumerate(rule_ids)}
 
-    results = []
-    for f in findings:
-        results.append({
+    def result(f: Finding, suppressions: "list | None") -> dict:
+        out = {
             "ruleId": f.rule,
             "ruleIndex": index[f.rule],
             "level": _LEVELS.get(f.severity, "warning"),
@@ -61,7 +71,17 @@ def to_sarif(findings: Sequence[Finding], *,
                     "fullyQualifiedName": f.symbol}]}
                    if f.symbol else {}),
             }],
-        })
+        }
+        if suppressions is not None:
+            out["suppressions"] = suppressions
+        return out
+
+    results = [result(f, None) for f in findings]
+    results += [result(f, [{"kind": "inSource"}]) for f in suppressed]
+    results += [result(f, [{"kind": "external",
+                            "justification":
+                                f"accepted in {baseline_path}"}])
+                for f in baselined]
 
     return {
         "$schema": SCHEMA,

@@ -34,10 +34,21 @@ Modules additionally contribute grain interface tables (host-tier
 type specs (annotations, constructor assignments, typed attribute
 chains) that phase 2 resolves lazily.
 
-Known, deliberate imprecision (ROADMAP): no context sensitivity — a
-function reachable from a worker context is worker-tainted at every call
-site; aliases do not flow through containers or attributes; bare-name
-call resolution is module-scoped (plus explicit imports).
+Context sensitivity is k=1 per call edge: phase 2 classifies every
+worker-tainted function as a seed, worker-only, or MIXED (also reached
+from main-loop context or declared as a runtime entry point), and the
+loop-confinement rule judges mixed helpers on the worker call edge
+instead of at the definition. Aliases flow through 2-chain attributes
+(``self._pending``) and container membership (``batch.append(m)``), and
+cross-module release depth closes over a link-time overlay (phase 2
+never mutates the cached summaries — re-summarizing an edited module is
+enough to re-judge every caller into it). Zero-call-site entry points
+(``ctl_*``, timer/reminder callbacks, loop-scheduled and ring-drain
+callbacks) get declared contexts from ``entrypoints.py``.
+
+Known, deliberate imprecision (ROADMAP): calling contexts are depth-1
+(k>1 chains collapse); bare-name call resolution is module-scoped (plus
+explicit imports).
 """
 
 from __future__ import annotations
@@ -45,8 +56,10 @@ from __future__ import annotations
 import ast
 import hashlib
 import itertools
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Iterable
+
+from .entrypoints import entry_label_for_name, entry_label_for_sched
 
 __all__ = [
     "CallEdge", "FunctionSummary", "GrainMethod", "GrainTable",
@@ -92,6 +105,10 @@ RELEASERS = {
     "recycle_message", "_recycle_callback", "recycle_callback",
     "_release_marker", "release_marker",
 }
+
+# batch releasers: release every ELEMENT of their container argument
+# (the container itself stays live)
+ITEM_RELEASERS = {"recycle_messages"}
 
 # loop-confined observability classes and their mutating surface
 REGISTRY_CLASSES = {"StatsRegistry", "Histogram", "QueueWaitTrend",
@@ -244,9 +261,10 @@ class CallEdge:
 class SchedEdge:
     """A callable handed to a thread/executor/loop-scheduling API."""
     target: tuple[str, ...]         # chain of the callable passed
-    kind: str                       # "thread" | "executor" | "loop"
+    kind: str                       # "thread" | "executor" | "loop" | "timer"
     loop: tuple | None              # receiver chain for kind == "loop"
     lineno: int
+    api: str = ""                   # the registration API name
 
 
 @dataclass(frozen=True)
@@ -275,6 +293,7 @@ class FunctionSummary:
     params: tuple[str, ...] = ()
     is_async: bool = False
     releases: frozenset = frozenset()       # definite param releases
+    releases_items: frozenset = frozenset()  # params whose ELEMENTS die
     escapes: frozenset = frozenset()
     returns_param: int | None = None
     calls: tuple[CallEdge, ...] = ()
@@ -312,6 +331,11 @@ class ClassInfo:
     attr_specs: dict = field(default_factory=dict)  # attr → spec
     loop_attrs: dict = field(default_factory=dict)  # attr → "worker"|"main"
     method_returns: dict = field(default_factory=dict)  # meth → spec
+    # shm-segment owner: assigns self.shm or lists "shm"/"buf" in
+    # __slots__ — the OTPU010 ring-discipline scope marker
+    shm_owner: bool = False
+    # mutable container attrs: attr → "list"|"dict"|"set"|"deque"
+    container_attrs: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -326,6 +350,11 @@ class ModuleSummary:
     # ClassName.attr = ... monkey-patches: the attached name joins the
     # class's interface table as an open (unknown-arity) method
     grain_patches: list = field(default_factory=list)
+    # qualname → function AST node. Retained for the link-time release
+    # overlay (Program re-walks callers of cross-module releasers). A
+    # pure function of the source text like everything else here, so
+    # the content-hash cache stays sound; in-memory only.
+    fn_nodes: dict = field(default_factory=dict, repr=False)
 
 
 # ---------------------------------------------------------------------------
@@ -352,28 +381,56 @@ class ReleaseWalker:
     loops run the body twice with the back-edge state merged in, so a
     release in iteration N is seen by a use in iteration N+1.
 
+    Beyond bare names, 2-chain attributes (``self._pending``) are
+    tracked as pseudo-variables that alias whatever was stored into
+    them, and container membership (``batch.append(m)`` / ``d[k] = m``)
+    is recorded per path so an ITEM-release of the container
+    (``recycle_messages(batch)`` or a callee with a ``releases_items``
+    summary) poisons the stashed members.
+
     ``release_of_call(call)`` maps a Call node to the names it releases
     ([] for unknown calls) — the interprocedural hook; ``alias_of_call``
-    maps a Call to the argument Name its result aliases (or None).
-    Callbacks ``on_use(node, name, release_line)`` and
-    ``on_double(node, name)`` fire findings; both optional (summary
+    maps a Call to the argument Name its result aliases (or None);
+    ``items_release_of_call`` maps a Call to the container names whose
+    ELEMENTS it releases. Callbacks ``on_use(node, name, release_line)``
+    and ``on_double(node, name)`` fire findings; both optional (summary
     mode records exit states instead).
     """
 
+    _META = ("//rel//", "//mem//")
+
     def __init__(self, params: Iterable[str], release_of_call,
-                 alias_of_call=None, on_use=None, on_double=None):
+                 alias_of_call=None, on_use=None, on_double=None,
+                 items_release_of_call=None):
         self._gids = itertools.count()
         self.release_of_call = release_of_call
         self.alias_of_call = alias_of_call or (lambda c: None)
+        self.items_release_of_call = items_release_of_call or \
+            (lambda c: [])
         self.on_use = on_use
         self.on_double = on_double
         self.reported: set = set()
         self.exit_releases: list[frozenset] = []
         self.return_params: list = []
         self.escaped: set[int] = set()
+        self.items_released: set[int] = set()
         self.entry = {}
         for i, p in enumerate(params):
             self.entry[p] = (next(self._gids), None, i)
+
+    @staticmethod
+    def _attr_pseudo(node) -> str | None:
+        """'a.b' pseudo-variable name for a 2-chain attribute."""
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name):
+            return f"{node.value.id}.{node.attr}"
+        return None
+
+    @staticmethod
+    def _cell_name(node) -> str | None:
+        if isinstance(node, ast.Name):
+            return node.id
+        return ReleaseWalker._attr_pseudo(node)
 
     # -- state helpers --------------------------------------------------
     def _merge(self, states):
@@ -387,8 +444,10 @@ class ReleaseWalker:
             out = {}
             memo: dict = {}
             rel0, rel1 = merged.get("//rel//"), other.get("//rel//")
+            mem0 = merged.get("//mem//", frozenset())
+            mem1 = other.get("//mem//", frozenset())
             for name, c in merged.items():
-                if name == "//rel//":
+                if name in self._META:
                     continue
                 o = other.get(name)
                 if o is None:
@@ -415,6 +474,12 @@ class ReleaseWalker:
                     memo[key] = (next(self._gids), rel, par)
                 out[name] = memo[key]
             out["//rel//"] = (rel0 or frozenset()) & (rel1 or frozenset())
+            # definite membership only: facts on both paths, and only
+            # for alias groups that survived the merge un-remapped
+            gids = {c[0] for n, c in out.items() if n not in self._META}
+            out["//mem//"] = frozenset(
+                t for t in (mem0 & mem1)
+                if t[0] in gids and t[1] in gids)
             merged = out
         return merged
 
@@ -425,6 +490,7 @@ class ReleaseWalker:
     def run(self, body: list[ast.stmt]) -> None:
         state = dict(self.entry)
         state["//rel//"] = frozenset()
+        state["//mem//"] = frozenset()
         end = self.exec_block(body, state)
         if end is not _TERMINATED:
             self.exit_releases.append(self._rel_set(end))
@@ -458,6 +524,15 @@ class ReleaseWalker:
                     out.append((node, names))
         return out
 
+    def _item_release_events(self, stmt):
+        out = []
+        for node in self._walk_shallow(stmt):
+            if isinstance(node, ast.Call):
+                names = self.items_release_of_call(node)
+                if names:
+                    out.append((node, names))
+        return out
+
     def _emit_use(self, node, name, line):
         key = ("use", name, getattr(node, "lineno", 0))
         if self.on_use is not None and key not in self.reported:
@@ -466,24 +541,35 @@ class ReleaseWalker:
 
     def _apply_simple(self, stmt, state):
         releases = self._release_events(stmt)
+        item_releases = self._item_release_events(stmt)
         # the arg Names a call releases are the release EVENT, not a
         # use — skip them in the use scan so a second release reports
         # as double-release, not use-after-release
         skip = set()
-        for call, names in releases:
+        for call, names in (*releases, *item_releases):
             for arg in (*call.args,
                         *(kw.value for kw in call.keywords)):
-                if isinstance(arg, ast.Name) and arg.id in names:
+                if self._cell_name(arg) in names:
                     skip.add(id(arg))
         # uses first: the statement's loads see the PRE-statement state
         for node in self._walk_shallow(stmt):
-            if isinstance(node, ast.Name) and id(node) not in skip and \
-                    isinstance(node.ctx, ast.Load):
+            if id(node) in skip or not isinstance(
+                    getattr(node, "ctx", None), ast.Load):
+                continue
+            if isinstance(node, ast.Name):
                 c = state.get(node.id)
                 if c is not None and c[1] is not None:
                     self._emit_use(node, node.id, c[1])
+            elif isinstance(node, ast.Attribute):
+                ps = self._attr_pseudo(node)
+                c = state.get(ps) if ps is not None else None
+                if c is not None and c[1] is not None:
+                    self._emit_use(node, ps, c[1])
         # escapes: a param stored into a container/field
         self._scan_escapes(stmt, state)
+        # container membership BEFORE releases: a same-statement stash
+        # never outruns the release sweep
+        self._scan_members(stmt, state)
         # releases
         for call, names in releases:
             for name in names:
@@ -499,15 +585,33 @@ class ReleaseWalker:
                         self.reported.add(key)
                         self.on_double(call, name)
                     continue
-                gid = c[0]
-                for n2, c2 in list(state.items()):
-                    if n2 != "//rel//" and c2[0] == gid:
-                        state[n2] = (gid, call.lineno, c2[2])
+                self._release_gid(state, c[0], call.lineno)
+        # item releases: the container stays live, its members die
+        for call, names in item_releases:
+            for name in names:
+                c = state.get(name)
+                if c is None:
+                    continue
                 if c[2] is not None:
-                    state["//rel//"] = self._rel_set(state) | {c[2]}
+                    self.items_released.add(c[2])
+                mem = state.get("//mem//", frozenset())
+                for cont_gid, member_gid in mem:
+                    if cont_gid == c[0]:
+                        self._release_gid(state, member_gid, call.lineno,
+                                          definite_only=True)
         # alias-aware rebinds (last: assignment targets bind AFTER rhs)
         self._apply_binds(stmt, state)
         return state
+
+    def _release_gid(self, state, gid, lineno, definite_only=False):
+        for n2, c2 in list(state.items()):
+            if n2 in self._META or c2[0] != gid:
+                continue
+            if definite_only and c2[1] is not None:
+                continue  # already released: no double-report for items
+            state[n2] = (gid, lineno, c2[2])
+            if c2[2] is not None:
+                state["//rel//"] = self._rel_set(state) | {c2[2]}
 
     def _scan_escapes(self, stmt, state):
         for node in self._walk_shallow(stmt):
@@ -528,27 +632,87 @@ class ReleaseWalker:
                 if c is not None and c[2] is not None:
                     self.escaped.add(c[2])
 
+    def _scan_members(self, stmt, state):
+        """Record container membership: ``c.append(m)`` / ``c[k] = m``
+        links m's alias group to c's so an item-release of c poisons
+        m."""
+        for node in self._walk_shallow(stmt):
+            cont = None
+            members: list = []
+            if isinstance(node, ast.Call):
+                fn = node.func
+                if isinstance(fn, ast.Attribute) and node.args:
+                    if fn.attr in ("append", "add", "put", "put_nowait"):
+                        cont = self._cell_name(fn.value)
+                        members = [node.args[0]]
+                    elif fn.attr in ("setdefault", "insert") and \
+                            len(node.args) > 1:
+                        cont = self._cell_name(fn.value)
+                        members = [node.args[1]]
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript):
+                        cont = self._cell_name(t.value)
+                        members = [node.value]
+            if cont is None or not members:
+                continue
+            c = state.get(cont)
+            if c is None:
+                c = (next(self._gids), None, None)
+                state[cont] = c
+            add = set()
+            for mnode in members:
+                mname = self._cell_name(mnode)
+                mc = state.get(mname) if mname is not None else None
+                if mc is not None:
+                    add.add((c[0], mc[0]))
+            if add:
+                state["//mem//"] = state.get("//mem//",
+                                             frozenset()) | add
+
+    def _bind_source(self, value, state):
+        """The cell an assignment RHS aliases, or None."""
+        if isinstance(value, ast.Name):
+            return state.get(value.id)
+        if isinstance(value, ast.Attribute):
+            ps = self._attr_pseudo(value)
+            return state.get(ps) if ps is not None else None
+        if isinstance(value, ast.Call):
+            al = self.alias_of_call(value)
+            if al is not None:
+                return state.get(al)
+        return None
+
+    def _invalidate_pseudo(self, state, base: str):
+        """Rebinding ``x`` invalidates every tracked ``x.attr`` cell."""
+        prefix = base + "."
+        for k in [k for k in state
+                  if k not in self._META and k.startswith(prefix)]:
+            state[k] = (next(self._gids), None, None)
+
     def _apply_binds(self, stmt, state):
-        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
-                isinstance(stmt.targets[0], ast.Name):
-            tgt = stmt.targets[0].id
-            src = None
-            if isinstance(stmt.value, ast.Name):
-                src = state.get(stmt.value.id)
-            elif isinstance(stmt.value, ast.Call):
-                al = self.alias_of_call(stmt.value)
-                if al is not None:
-                    src = state.get(al)
-            if src is not None:
-                state[tgt] = src            # alias: share the gid
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            t = stmt.targets[0]
+            if isinstance(t, ast.Name):
+                src = self._bind_source(stmt.value, state)
+                self._invalidate_pseudo(state, t.id)
+                if src is not None:
+                    state[t.id] = src       # alias: share the gid
+                    return
+                state[t.id] = (next(self._gids), None, None)
                 return
-            state[tgt] = (next(self._gids), None, None)
-            return
+            ps = self._attr_pseudo(t)
+            if ps is not None:
+                src = self._bind_source(stmt.value, state)
+                state[ps] = src if src is not None else \
+                    (next(self._gids), None, None)
+                return
         for node in self._walk_shallow(stmt):
             if isinstance(node, ast.Name) and \
                     isinstance(node.ctx, (ast.Store, ast.Del)):
                 if node.id in state:
                     state[node.id] = (next(self._gids), None, None)
+                self._invalidate_pseudo(state, node.id)
 
     # -- control flow ----------------------------------------------------
     def exec_stmt(self, stmt, state):
@@ -924,30 +1088,41 @@ class _FuncCollector:
                     tch = _chain(kw.value)
                     if tch:
                         self.sched.append(SchedEdge(
-                            tch, "thread", None, node.lineno))
+                            tch, "thread", None, node.lineno,
+                            api="Thread"))
         elif name == "run_in_executor" and len(node.args) >= 2:
             tch = _chain(node.args[1])
             if tch:
                 self.sched.append(SchedEdge(
-                    tch, "executor", None, node.lineno))
+                    tch, "executor", None, node.lineno,
+                    api="run_in_executor"))
             elif isinstance(node.args[1], ast.Lambda):
                 self.sched.append(SchedEdge(
                     (f"<lambda@{node.args[1].lineno}>",), "executor",
-                    None, node.lineno))
+                    None, node.lineno, api="run_in_executor"))
         elif name in _LOOP_CB_APIS and len(ch) >= 2:
             idx = _LOOP_CB_APIS[name]
             if len(node.args) > idx:
                 tch = _chain(node.args[idx])
                 if tch:
                     self.sched.append(SchedEdge(
-                        tch, "loop", ch[:-1], node.lineno))
+                        tch, "loop", ch[:-1], node.lineno, api=name))
         elif name == "create_task" and len(ch) >= 2 and node.args:
             inner = node.args[0]
             if isinstance(inner, ast.Call):
                 tch = _chain(inner.func)
                 if tch:
                     self.sched.append(SchedEdge(
-                        tch, "loop", ch[:-1], node.lineno))
+                        tch, "loop", ch[:-1], node.lineno,
+                        api="create_task"))
+        elif name == "register_timer" and node.args:
+            # grain/activation timers: the callback fires as a turn on
+            # the silo main loop — a declared entry point at link time
+            tch = _chain(node.args[0])
+            if tch:
+                self.sched.append(SchedEdge(
+                    tch, "timer", None, node.lineno,
+                    api="register_timer"))
         # -- registry writes --------------------------------------------
         if len(ch) >= 2 and (name in UNTYPED_WRITES or
                              name in TYPED_WRITES):
@@ -990,12 +1165,41 @@ def _grain_method(fn) -> GrainMethod:
                        a.kwarg is not None, one_way)
 
 
+def _container_kind(val) -> str | None:
+    """'list'|'dict'|'set'|'deque' for a container-constructor RHS."""
+    if isinstance(val, ast.List):
+        return "list"
+    if isinstance(val, (ast.Dict, ast.DictComp)):
+        return "dict"
+    if isinstance(val, (ast.Set, ast.SetComp)):
+        return "set"
+    if isinstance(val, ast.ListComp):
+        return "list"
+    if isinstance(val, ast.Call):
+        ch = _chain(val.func)
+        if ch and ch[-1] in ("list", "dict", "set", "deque",
+                             "defaultdict", "OrderedDict", "Counter"):
+            return "deque" if ch[-1] == "deque" else (
+                "dict" if ch[-1] in ("dict", "defaultdict",
+                                     "OrderedDict", "Counter")
+                else ch[-1])
+    return None
+
+
 def _class_info(node: ast.ClassDef) -> ClassInfo:
     bases = tuple(dotted_name(b).rsplit(".", 1)[-1] for b in node.bases
                   if dotted_name(b))
     info = ClassInfo(node.name, bases=bases,
                      is_thread="Thread" in bases)
     for stmt in node.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name) and \
+                stmt.targets[0].id == "__slots__":
+            slots = {e.value for e in ast.walk(stmt.value)
+                     if isinstance(e, ast.Constant) and
+                     isinstance(e.value, str)}
+            if "shm" in slots:
+                info.shm_owner = True
         if isinstance(stmt, ast.AnnAssign) and \
                 isinstance(stmt.target, ast.Name):
             spec = _ann_spec(stmt.annotation)
@@ -1013,7 +1217,12 @@ def _class_info(node: ast.ClassDef) -> ClassInfo:
                         attr = tch[1]
                         if attr in ("fence", "_fence"):
                             info.fence_owner = True
+                        if attr == "shm":
+                            info.shm_owner = True
                         val = sub.value
+                        ckind = _container_kind(val)
+                        if ckind is not None:
+                            info.container_attrs.setdefault(attr, ckind)
                         vch = _chain(val if not isinstance(val, ast.Call)
                                      else val.func)
                         if isinstance(val, ast.Call):
@@ -1135,6 +1344,7 @@ def summarize_module(source: str, rel_path: str,
                         t.value.id[:1].isupper():
                     ms.grain_patches.append((t.value.id, t.attr))
     _close_releases(ms, fn_nodes)
+    ms.fn_nodes = fn_nodes
     return ms
 
 
@@ -1162,41 +1372,87 @@ def resolve_local(ms: ModuleSummary, caller_qual: str,
     return None
 
 
+def _arg_cell_name(node) -> str | None:
+    """Name id or 2-chain attribute pseudo-name for a call argument."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name):
+        return f"{node.value.id}.{node.attr}"
+    return None
+
+
+def _callee_summary(ms: ModuleSummary, caller_qual: str, ch: tuple,
+                    extern=None):
+    """Resolve a call chain to a FunctionSummary. ``extern(chain) ->
+    FunctionSummary|None`` extends resolution across modules at
+    link/check time and is consulted FIRST when present: the Program
+    hook resolves locals too and applies its release overlay, which is
+    how an edit to module A re-judges B's edges into A without
+    re-summarizing B."""
+    if extern is not None:
+        summ = extern(ch)
+        if summ is not None:
+            return summ
+    local = resolve_local(ms, caller_qual, ch)
+    return ms.functions[local] if local is not None else None
+
+
+def _param_args(summ, ch: tuple, call: ast.Call,
+                indices) -> list:
+    """Map callee param indices to caller-side cell names."""
+    out = []
+    offset = 1 if (summ.params and summ.params[0] in ("self", "cls")
+                   and len(ch) >= 2) else 0
+    for j in sorted(indices):
+        pos = j - offset
+        if 0 <= pos < len(call.args):
+            nm = _arg_cell_name(call.args[pos])
+            if nm is not None:
+                out.append(nm)
+                continue
+        if j < len(summ.params):
+            pname = summ.params[j]
+            for kw in call.keywords:
+                if kw.arg == pname:
+                    nm = _arg_cell_name(kw.value)
+                    if nm is not None:
+                        out.append(nm)
+    return out
+
+
 def _call_releases(ms: ModuleSummary, caller_qual: str, call: ast.Call,
                    extern=None) -> list:
     """Names a Call releases: the direct releasers, plus calls to
-    functions whose (current) summary definitely releases a parameter.
-    ``extern(chain) -> FunctionSummary|None`` extends resolution across
-    modules at link/check time."""
+    functions whose (current) summary definitely releases a
+    parameter."""
     ch = _chain(call.func)
     if not ch:
         return []
-    out = []
-    if ch[-1] in RELEASERS and call.args and \
-            isinstance(call.args[0], ast.Name):
-        out.append(call.args[0].id)
-        return out
-    summ = None
-    local = resolve_local(ms, caller_qual, ch)
-    if local is not None:
-        summ = ms.functions[local]
-    elif extern is not None:
-        summ = extern(ch)
+    if ch[-1] in RELEASERS and call.args:
+        nm = _arg_cell_name(call.args[0])
+        return [nm] if nm is not None else []
+    summ = _callee_summary(ms, caller_qual, ch, extern)
     if summ is None or not summ.releases:
-        return out
-    offset = 1 if (summ.params and summ.params[0] in ("self", "cls")
-                   and len(ch) >= 2) else 0
-    for j in sorted(summ.releases):
-        pos = j - offset
-        if 0 <= pos < len(call.args) and \
-                isinstance(call.args[pos], ast.Name):
-            out.append(call.args[pos].id)
-            continue
-        pname = summ.params[j]
-        for kw in call.keywords:
-            if kw.arg == pname and isinstance(kw.value, ast.Name):
-                out.append(kw.value.id)
-    return out
+        return []
+    return _param_args(summ, ch, call, summ.releases)
+
+
+def _call_releases_items(ms: ModuleSummary, caller_qual: str,
+                         call: ast.Call, extern=None) -> list:
+    """Container names whose ELEMENTS a Call releases (the container
+    itself stays live): the batch releasers, plus calls to functions
+    with a ``releases_items`` summary."""
+    ch = _chain(call.func)
+    if not ch:
+        return []
+    if ch[-1] in ITEM_RELEASERS and call.args:
+        nm = _arg_cell_name(call.args[0])
+        return [nm] if nm is not None else []
+    summ = _callee_summary(ms, caller_qual, ch, extern)
+    if summ is None or not summ.releases_items:
+        return []
+    return _param_args(summ, ch, call, summ.releases_items)
 
 
 def _call_alias(ms: ModuleSummary, caller_qual: str, call: ast.Call,
@@ -1206,48 +1462,94 @@ def _call_alias(ms: ModuleSummary, caller_qual: str, call: ast.Call,
     ch = _chain(call.func)
     if not ch:
         return None
-    summ = None
-    local = resolve_local(ms, caller_qual, ch)
-    if local is not None:
-        summ = ms.functions[local]
-    elif extern is not None:
-        summ = extern(ch)
+    summ = _callee_summary(ms, caller_qual, ch, extern)
     if summ is None or summ.returns_param is None:
         return None
     offset = 1 if (summ.params and summ.params[0] in ("self", "cls")
                    and len(ch) >= 2) else 0
     pos = summ.returns_param - offset
-    if 0 <= pos < len(call.args) and isinstance(call.args[pos], ast.Name):
-        return call.args[pos].id
+    if 0 <= pos < len(call.args):
+        return _arg_cell_name(call.args[pos])
     return None
 
 
-def _summarize_releases(ms: ModuleSummary, qual: str, fn) -> tuple:
-    """(releases, returns_param, escapes) for one function via the
-    dataflow walker, consulting the module's current summaries for
-    callee release behavior."""
+def _for_loop_item_releases(ms: ModuleSummary, qual: str, fn,
+                            extern=None) -> frozenset:
+    """Param indices whose ELEMENTS the function definitely releases via
+    the ``for m in batch: recycle_message(m)`` idiom (direct body
+    statements only — a conditional release is not definite for the
+    stash the caller tracked)."""
+    params = _FuncCollector._pos_params(fn)
+    out: set[int] = set()
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+        if not isinstance(node, ast.For) or \
+                not isinstance(node.target, ast.Name):
+            continue
+        it = node.iter
+        base = None
+        if isinstance(it, ast.Name):
+            base = it.id
+        elif isinstance(it, ast.Call) and \
+                isinstance(it.func, ast.Attribute) and \
+                it.func.attr in ("values",) and \
+                isinstance(it.func.value, ast.Name):
+            base = it.func.value.id
+        if base not in params:
+            continue
+        t = node.target.id
+        for stmt in node.body:
+            if isinstance(stmt, (ast.If, ast.For, ast.While, ast.Try,
+                                 ast.With)):
+                continue
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call) and t in _call_releases(
+                        ms, qual, sub, extern):
+                    out.add(params.index(base))
+    return frozenset(out)
+
+
+def _summarize_releases(ms: ModuleSummary, qual: str, fn,
+                        extern=None) -> tuple:
+    """(releases, returns_param, escapes, releases_items) for one
+    function via the dataflow walker, consulting the module's current
+    summaries (plus ``extern`` at link time) for callee release
+    behavior."""
     params = _FuncCollector._pos_params(fn)
     walker = ReleaseWalker(
         params,
-        release_of_call=lambda c: _call_releases(ms, qual, c),
-        alias_of_call=lambda c: _call_alias(ms, qual, c))
+        release_of_call=lambda c: _call_releases(ms, qual, c, extern),
+        alias_of_call=lambda c: _call_alias(ms, qual, c, extern),
+        items_release_of_call=lambda c: _call_releases_items(
+            ms, qual, c, extern))
     walker.run(fn.body)
+    items = frozenset(walker.items_released) | \
+        _for_loop_item_releases(ms, qual, fn, extern)
     return (walker.definite_releases(), walker.returned_param(),
-            frozenset(walker.escaped))
+            frozenset(walker.escaped), items)
 
 
 def _close_releases(ms: ModuleSummary, fn_nodes: dict) -> None:
     """Module-local transitive release closure: seed with functions that
     call a releaser directly, then re-walk callers of releasing
     functions until the summaries stop changing (bounded — chains in
-    practice are 2-3 deep). Cross-module closure is a documented gap."""
+    practice are 2-3 deep). Cross-module closure happens at link time
+    via the Program's release overlay."""
     releasing_names: set[str] = set()
     for qual, s in ms.functions.items():
-        if not s.has_releasers:
+        if not s.has_releasers and not any(
+                e.chain[-1] in ITEM_RELEASERS for e in s.calls):
             continue
-        rel, ret, esc = _summarize_releases(ms, qual, fn_nodes[qual])
+        rel, ret, esc, items = _summarize_releases(
+            ms, qual, fn_nodes[qual])
         s.releases, s.returns_param, s.escapes = rel, ret, esc
-        if rel:
+        s.releases_items = items
+        if rel or items:
             releasing_names.add(qual.rsplit(".", 1)[-1])
     if not releasing_names:
         return
@@ -1258,14 +1560,18 @@ def _close_releases(ms: ModuleSummary, fn_nodes: dict) -> None:
                 continue
             calls_releasing = any(
                 e.chain[-1] in releasing_names or
-                e.chain[-1] in RELEASERS for e in s.calls)
+                e.chain[-1] in RELEASERS or
+                e.chain[-1] in ITEM_RELEASERS for e in s.calls)
             if not calls_releasing:
                 continue
-            rel, ret, esc = _summarize_releases(ms, qual, fn_nodes[qual])
-            if rel != s.releases or ret != s.returns_param:
+            rel, ret, esc, items = _summarize_releases(
+                ms, qual, fn_nodes[qual])
+            if rel != s.releases or ret != s.returns_param or \
+                    items != s.releases_items:
                 changed = True
                 s.releases, s.returns_param, s.escapes = rel, ret, esc
-                if rel:
+                s.releases_items = items
+                if rel or items:
                     releasing_names.add(qual.rsplit(".", 1)[-1])
         if not changed:
             break
@@ -1275,6 +1581,8 @@ def _close_releases(ms: ModuleSummary, fn_nodes: dict) -> None:
 # functions of the source text; phase 2 never mutates them)
 _CACHE: dict = {}
 _CACHE_CAP = 4096
+# monotonic counters for --stats; callers snapshot-and-diff around a run
+CACHE_STATS = {"hits": 0, "misses": 0}
 
 
 def module_summary(source: str, rel_path: str,
@@ -1283,7 +1591,9 @@ def module_summary(source: str, rel_path: str,
            rel_path)
     hit = _CACHE.get(key)
     if hit is not None:
+        CACHE_STATS["hits"] += 1
         return hit
+    CACHE_STATS["misses"] += 1
     ms = summarize_module(source, rel_path, tree)
     if len(_CACHE) >= _CACHE_CAP:
         _CACHE.clear()
@@ -1307,6 +1617,21 @@ class Program:
             m.module_key: m for m in modules}
         self.by_rel: dict[str, ModuleSummary] = {
             m.rel_path: m for m in modules}
+        # dotted-suffix index: an import records the module string as
+        # WRITTEN ('from ring_helper import free'), but module keys are
+        # derived from scan-root-relative paths, so a sibling import
+        # carries no directory prefix. A unique dotted suffix resolves;
+        # an ambiguous one stays unresolved (None tombstone).
+        self._suffix_index: dict[str, str | None] = {}
+        for key in self.modules:
+            parts = key.split(".")
+            for i in range(len(parts)):
+                suf = ".".join(parts[i:])
+                if suf in self._suffix_index and \
+                        self._suffix_index[suf] != key:
+                    self._suffix_index[suf] = None
+                else:
+                    self._suffix_index[suf] = key
         # class name → (module, ClassInfo); first definition wins, which
         # is fine for THIS tree (no duplicate class names across layers)
         self.class_index: dict[str, tuple] = {}
@@ -1322,10 +1647,21 @@ class Program:
                 self.functions[(m.module_key, q)] = s
         self._call_sites: dict[tuple, list] = {}
         self._index_call_sites()
+        # declared entry-point contexts (ctl_* handlers, timer and
+        # loop-scheduled callbacks, ring drains): key → label
+        self.entry_contexts: dict[tuple, str] = {}
+        self._collect_entry_contexts()
         self.worker: dict[tuple, str] = {}
+        self.worker_seeds: set = set()
         self._worker_fixpoint()
+        self._worker_kind: dict[tuple, str] = {}
+        self._classify_worker_contexts()
         self.held: dict[tuple, bool] = {}
         self._fence_fixpoint()
+        # link-time cross-module release closure (phase 2 NEVER mutates
+        # the cached summaries — re-judged facts live here)
+        self._rel_overlay: dict[tuple, tuple] = {}
+        self._release_overlay()
 
     # -- grain tables ----------------------------------------------------
     def _merge_grains(self, modules):
@@ -1384,6 +1720,15 @@ class Program:
                 break
 
     # -- resolution ------------------------------------------------------
+    def module_named(self, mod: str) -> ModuleSummary | None:
+        """Module summary for an import-recorded module string: exact
+        key first, else the unique dotted-suffix match."""
+        hit = self.modules.get(mod)
+        if hit is not None:
+            return hit
+        key = self._suffix_index.get(mod)
+        return self.modules[key] if key is not None else None
+
     def enclosing_class(self, ms: ModuleSummary, qual: str) -> str | None:
         parts = qual.split(".")
         for p in parts[:-1]:
@@ -1403,10 +1748,10 @@ class Program:
             imp = ms.imports.get(chain[0])
             if imp is not None:
                 mod, orig = imp
-                target = self.modules.get(mod)
+                target = self.module_named(mod)
                 if target is not None and (orig or chain[0]) in \
                         target.functions:
-                    return (mod, orig or chain[0])
+                    return (target.module_key, orig or chain[0])
             return None
         if chain[0] in ("self", "cls") and len(chain) == 2:
             # unresolved locally: walk base classes by name
@@ -1417,9 +1762,9 @@ class Program:
         if len(chain) == 2:
             imp = ms.imports.get(chain[0])
             if imp is not None and imp[1] == "":
-                target = self.modules.get(imp[0])
+                target = self.module_named(imp[0])
                 if target is not None and chain[1] in target.functions:
-                    return (imp[0], chain[1])
+                    return (target.module_key, chain[1])
         # typed receiver: resolve the receiver chain's class, then the
         # method on it (or its bases)
         recv = self.receiver_class(ms, caller_qual, chain[:-1])
@@ -1445,13 +1790,67 @@ class Program:
                 return found
         return None
 
+    def release_summary(self, key) -> FunctionSummary | None:
+        """A function's summary with the link-time release overlay
+        applied (the cached summary itself is never touched)."""
+        s = self.functions.get(key)
+        if s is None:
+            return None
+        ov = self._rel_overlay.get(key)
+        if ov is None:
+            return s
+        return replace(s, releases=ov[0], returns_param=ov[1],
+                       releases_items=ov[2])
+
     def extern_summary(self, ms: ModuleSummary, caller_qual: str):
         """Cross-module callee-summary lookup hook for the release
-        walker (same signature as ``_call_releases``'s ``extern``)."""
+        walker (same signature as ``_call_releases``'s ``extern``).
+        Resolves locals too and applies the release overlay, so
+        check-time walks always see the freshest cross-module facts."""
         def look(chain):
             key = self.resolve_call(ms, caller_qual, chain)
-            return self.functions.get(key) if key is not None else None
+            return self.release_summary(key) if key is not None else None
         return look
+
+    def _release_overlay(self):
+        """Cross-module transitive release closure: re-walk callers of
+        releasing functions against the PROGRAM's resolution (overlay-
+        aware), recording changed facts in ``_rel_overlay``. This is
+        what closes the summary-cache staleness hole: the overlay is
+        rebuilt from the current summaries on every link, so editing
+        module A re-judges B's call edges into A while B's cached
+        summary stays untouched."""
+        work = {k for k in self.functions
+                if self.functions[k].releases or
+                self.functions[k].releases_items}
+        for _ in range(6):
+            if not work:
+                break
+            cands: set = set()
+            for k in work:
+                changed_callee = k in self._rel_overlay
+                for gkey, _e in self._call_sites.get(k, []):
+                    # same-module callers already saw the raw summary in
+                    # the phase-1 closure; re-judge them only when the
+                    # callee's facts CHANGED at link time
+                    if changed_callee or gkey[0] != k[0]:
+                        cands.add(gkey)
+            work = set()
+            for gkey in sorted(cands):
+                mod, qual = gkey
+                m = self.modules[mod]
+                fn = m.fn_nodes.get(qual)
+                if fn is None:
+                    continue
+                look = self.extern_summary(m, qual)
+                rel, ret, _esc, items = _summarize_releases(
+                    m, qual, fn, extern=look)
+                cur = self.release_summary(gkey)
+                if (rel, ret, items) != (cur.releases,
+                                         cur.returns_param,
+                                         cur.releases_items):
+                    self._rel_overlay[gkey] = (rel, ret, items)
+                    work.add(gkey)
 
     # -- type specs ------------------------------------------------------
     def resolve_spec(self, ms: ModuleSummary, fn: FunctionSummary | None,
@@ -1499,7 +1898,7 @@ class Program:
     def _apply_step(self, cur, step, depth):
         kind = step[0]
         if cur[0] == "mod" and kind == "attr":
-            target = self.modules.get(cur[1])
+            target = self.module_named(cur[1])
             if target is None:
                 return None
             if step[1] in target.classes:
@@ -1627,6 +2026,7 @@ class Program:
                             mark(self.resolve_call(m, q, e.target),
                                  "scheduled onto a shard/worker loop "
                                  f"in '{q.rsplit('.', 1)[-1]}'")
+        self.worker_seeds = set(self.worker)
         while work:
             key = work.pop()
             mod, qual = key
@@ -1644,6 +2044,54 @@ class Program:
             # sched edges need no re-scan here: thread/executor targets
             # and worker-loop callbacks were all seeded globally above
             # (loop affinity is a property of the loop, not the caller)
+
+    # -- entry-point registry (link-time) --------------------------------
+    def _collect_entry_contexts(self):
+        """Consult the declared entry-point registry: naming
+        conventions (``ctl_*``, ``receive_reminder``) plus the targets
+        of loop/timer scheduling edges whose loop is NOT worker-kind
+        (worker-loop callbacks belong to the worker fixpoint)."""
+        for key in self.functions:
+            label = entry_label_for_name(key[1])
+            if label is not None:
+                self.entry_contexts[key] = label
+        for m in self.modules.values():
+            for q, s in m.functions.items():
+                for e in s.sched:
+                    if e.kind not in ("loop", "timer"):
+                        continue
+                    if e.kind == "loop" and \
+                            self.loop_kind(m, q, e.loop or ()) == \
+                            "worker":
+                        continue
+                    target = self.resolve_call(m, q, e.target)
+                    if target is None:
+                        continue
+                    label = entry_label_for_sched(e.api, q)
+                    if label is not None:
+                        self.entry_contexts.setdefault(target, label)
+
+    # -- per-call-edge context classification (k=1) ----------------------
+    def worker_context(self, key) -> str | None:
+        """None (not worker-reachable) | "seed" (a thread target /
+        executor callable / worker-loop callback itself) | "only"
+        (every call edge comes from worker context) | "mixed" (also
+        reached from main-loop context or a declared entry point —
+        judged per call edge, not at the definition)."""
+        return self._worker_kind.get(key)
+
+    def _classify_worker_contexts(self):
+        for key in self.worker:
+            if key in self.worker_seeds:
+                self._worker_kind[key] = "seed"
+                continue
+            mixed = key in self.entry_contexts
+            if not mixed:
+                for gkey, _e in self._call_sites.get(key, []):
+                    if gkey not in self.worker:
+                        mixed = True
+                        break
+            self._worker_kind[key] = "mixed" if mixed else "only"
 
     # -- fence fixpoint --------------------------------------------------
     def fence_owner_class(self, name: str | None) -> bool:
@@ -1767,6 +2215,11 @@ class Program:
             for gkey, e in sites:
                 if scc_of.get(gkey) != sid:
                     entering[sid].append((gkey, e))
+        # a declared entry point is entered UNFENCED by the runtime
+        # regardless of its visible call sites — its SCC can never be
+        # promoted (the registry edge is a permanent unfenced entry)
+        entry_sccs = {scc_of[k] for k in self.entry_contexts
+                      if k in scc_of}
         held_scc: dict[int, bool] = {sid: False for sid in entering}
         changed = True
         guard = 0
@@ -1774,7 +2227,7 @@ class Program:
             changed = False
             guard += 1
             for sid, edges in entering.items():
-                if held_scc[sid] or not edges:
+                if held_scc[sid] or not edges or sid in entry_sccs:
                     continue
                 if all(e.fenced or held_scc.get(scc_of.get(gkey), False)
                        for gkey, e in edges):
@@ -1785,7 +2238,11 @@ class Program:
 
     def unfenced_witness(self, key) -> str | None:
         """A human-readable example of why a function is not fence-held
-        (one unfenced call site, or 'no call sites')."""
+        (a declared entry context, one unfenced call site, or 'no call
+        sites')."""
+        label = self.entry_contexts.get(key)
+        if label is not None:
+            return f"entry point: {label}"
         sites = self._call_sites.get(key, [])
         if not sites:
             return "no fenced call path (entry point)"

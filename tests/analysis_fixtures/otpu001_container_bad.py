@@ -1,0 +1,41 @@
+"""OTPU001 container/attribute alias + cross-module release depth bad:
+a Message stashed in a list dies with the batch when a helper in
+ANOTHER module recycles the elements; an attribute stash aliases the
+local; and a local wrapper around an imported releaser poisons its own
+callers (two cross-module hops via the link-time overlay)."""
+from otpu001_container_helper import free_all, free_one
+
+from orleans_tpu.core.message import recycle_messages
+
+
+def batch_release(m, n):
+    batch = []
+    batch.append(m)
+    batch.append(n)
+    free_all(batch)
+    return m.payload
+
+
+def batch_release_direct(m):
+    batch = []
+    batch.append(m)
+    recycle_messages(batch)
+    return m.seq
+
+
+class PendingBox:
+    def stash_and_touch(self, m):
+        self._pending = m
+        free_one(self._pending)
+        return m.payload
+
+
+def drop(m):
+    # cross-module wrapper: phase 1 cannot see free_one's summary, the
+    # link-time overlay gives drop releases={0}
+    free_one(m)
+
+
+def use_after_drop(m):
+    drop(m)
+    return m.seq

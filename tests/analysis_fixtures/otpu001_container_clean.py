@@ -1,0 +1,35 @@
+"""OTPU001 container/attribute alias clean: the same shapes with the
+discipline kept — nothing touches a pooled object after its container
+or alias is released, and rebinding severs the alias before reuse."""
+from otpu001_container_helper import free_all, free_one
+
+from orleans_tpu.core.message import make_request
+
+
+def batch_release_ok(m, n):
+    batch = []
+    batch.append(m)
+    batch.append(n)
+    count = len(batch)
+    free_all(batch)
+    return count
+
+
+class PendingBox:
+    def stash_and_release(self, m):
+        self._pending = m
+        free_one(self._pending)
+        # rebinding the attribute severs the alias; the fresh object
+        # is safe to hand out
+        self._pending = make_request("G", "k", "m", ())
+        return self._pending
+
+
+def drop(m):
+    free_one(m)
+
+
+def drop_then_fresh(m):
+    drop(m)
+    m = make_request("G", "k", "m", ())
+    return m.seq

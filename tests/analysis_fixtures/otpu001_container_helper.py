@@ -1,0 +1,20 @@
+"""Cross-module release helpers for the OTPU001 container fixtures.
+Clean on its own — every function releases its argument and stops.
+The bad/clean twins import these so the release depth crosses the
+module boundary (resolved by the link-time overlay, not the cached
+per-module summaries)."""
+from orleans_tpu.core.message import recycle_message, recycle_messages
+
+
+def free_one(m):
+    recycle_message(m)
+
+
+def free_all(batch):
+    # item release: the ELEMENTS of batch die, not the container
+    recycle_messages(batch)
+
+
+def free_shell(m):
+    local = m
+    free_one(local)

@@ -1,0 +1,26 @@
+"""OTPU007 edge-context bad: a helper reached from BOTH the main loop
+and a worker thread. Under k=1 call-edge judging the definition is not
+the violation (the main-loop path is fine) — the worker call EDGE into
+it is, so exactly one finding fires, at the call line inside the
+thread target."""
+import threading
+
+from orleans_tpu.observability.stats import StatsRegistry
+
+
+class MixedBump:
+    def __init__(self):
+        self.stats = StatsRegistry()
+        self.thread = threading.Thread(target=self._worker_main)
+
+    def bump(self):
+        # definite registry write; 'mixed' context — NOT flagged here
+        self.stats.increment("frames")
+
+    def on_loop_tick(self):
+        # main-loop caller: makes bump() mixed, stays clean itself
+        self.bump()
+
+    def _worker_main(self):
+        while True:
+            self.bump()

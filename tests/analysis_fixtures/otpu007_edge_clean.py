@@ -1,0 +1,26 @@
+"""OTPU007 edge-context clean: the same mixed-helper shape done right —
+the worker thread never CALLS the helper, it hands it back to the main
+loop with call_soon_threadsafe (callables returned to the loop may
+write), while the loop-side path calls it directly. No worker call
+edge exists, so nothing fires."""
+import asyncio
+import threading
+
+from orleans_tpu.observability.stats import StatsRegistry
+
+
+class HandedBack:
+    def __init__(self):
+        self.stats = StatsRegistry()
+        self._loop = asyncio.get_running_loop()
+        self.thread = threading.Thread(target=self._worker_main)
+
+    def bump(self):
+        self.stats.increment("frames")
+
+    def on_loop_tick(self):
+        self.bump()
+
+    def _worker_main(self):
+        while True:
+            self._loop.call_soon_threadsafe(self.bump)

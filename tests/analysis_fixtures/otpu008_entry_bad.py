@@ -1,0 +1,32 @@
+"""OTPU008 entry-point bad: zero-call-site runtime entries reaching
+donated state unfenced. ctl_dump has a FENCED internal call site — the
+old fixpoint would promote it to fence-held on that evidence — but it
+is also a ctl_* control handler the runtime dispatches unfenced, so
+the entry-point registry blocks the promotion. The add_reader drain
+and the grain timer callback are entries the same way."""
+import threading
+
+
+class CtlEngine:
+    def __init__(self, loop):
+        self.fence = threading.RLock()
+        self.state = {}
+        self.hits = None
+        loop.add_reader(7, self._on_ring_ready)
+        self.register_timer(self._on_timer, 1.0, None)
+
+    def register_timer(self, callback, due, period):
+        return (callback, due, period)
+
+    def tick(self):
+        with self.fence:
+            self.ctl_dump()
+
+    def ctl_dump(self):
+        return dict(self.state)
+
+    def _on_ring_ready(self):
+        return len(self.state)
+
+    def _on_timer(self):
+        return self.hits

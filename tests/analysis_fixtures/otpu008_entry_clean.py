@@ -1,0 +1,32 @@
+"""OTPU008 entry-point clean: the same runtime entries, fenced — an
+entry point cannot inherit a fence from its call sites (the runtime
+enters it bare), so each takes the tick fence itself before touching
+donated state; the timer callback touches none at all."""
+import threading
+
+
+class CtlEngine:
+    def __init__(self, loop):
+        self.fence = threading.RLock()
+        self.state = {}
+        self.hits = None
+        loop.add_reader(7, self._on_ring_ready)
+        self.register_timer(self._on_timer, 1.0, None)
+
+    def register_timer(self, callback, due, period):
+        return (callback, due, period)
+
+    def tick(self):
+        with self.fence:
+            self.ctl_dump()
+
+    def ctl_dump(self):
+        with self.fence:
+            return dict(self.state)
+
+    def _on_ring_ready(self):
+        with self.fence:
+            return len(self.state)
+
+    def _on_timer(self):
+        return "tick"

@@ -1,0 +1,76 @@
+"""OTPU010 bad: every way to break the cross-process ring discipline —
+a producer method storing a consumer-owned header counter, a reset
+helper zeroing a cumulative counter from neither side, a Python object
+pushed across the shm segment (method and native forms), unlink with
+no prior drain sweep, the SpscRing counter contract broken on the
+attribute form, and a worker thread structurally mutating a shared
+freelist without a lock."""
+import struct
+import threading
+
+_OFF_WRITE = 0
+_OFF_PUSHED = 8
+_OFF_READ = 64
+_OFF_DRAINED = 72
+_U64 = struct.Struct("<Q")
+_HW = None
+
+
+class BadRing:
+    __slots__ = ("shm", "buf", "capacity")
+
+    def __init__(self, shm):
+        self.shm = shm
+        self.buf = shm.buf
+        self.capacity = shm.size
+
+    def _store(self, off, v):
+        _U64.pack_into(self.buf, off, v)
+
+    def push(self, payload: bytes, n_msgs):
+        self._store(_OFF_WRITE, 8)
+        self._store(_OFF_DRAINED, n_msgs)
+
+    def reset_counters(self):
+        self._store(_OFF_PUSHED, 0)
+
+    def send_route(self, m):
+        self.push(("route", m), 1)
+
+    def send_native(self, m):
+        _HW.shm_push(self.buf, self.capacity, {"msg": m}, 1)
+
+    def teardown(self):
+        self.shm.close()
+        self.shm.unlink()
+
+
+class BadCounterRing:
+    def __init__(self):
+        self._items = []
+        self.pushed_msgs = 0
+        self.drained_msgs = 0
+
+    def push(self, item):
+        self._items.append(item)
+        self.pushed_msgs += 1
+
+    def drain(self):
+        while self._items:
+            self._items.pop()
+            self.drained_msgs += 1
+            self.pushed_msgs -= 1
+
+
+class SharedFreelist:
+    def __init__(self):
+        self.free = []
+        self.thread = threading.Thread(target=self._worker_main)
+
+    def _worker_main(self):
+        while True:
+            self.free.pop()
+
+    def alloc(self):
+        self.free.append(object())
+        return self.free.pop()

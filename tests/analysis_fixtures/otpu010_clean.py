@@ -1,0 +1,89 @@
+"""OTPU010 clean: the ring discipline kept — each header counter
+written only by its owning side, only serialized bytes cross the
+segment, a final drain sweep precedes every unlink (with the
+creation-rollback exemption), the SpscRing attribute counters stay on
+their own sides over a deque hand-off, and the shared freelist is
+worker-append / main-drain (stamp feed) with the structural worker
+mutation under a lock."""
+import pickle
+import struct
+import threading
+from multiprocessing import shared_memory
+
+_OFF_WRITE = 0
+_OFF_PUSHED = 8
+_OFF_READ = 64
+_OFF_DRAINED = 72
+_U64 = struct.Struct("<Q")
+
+
+class GoodRing:
+    __slots__ = ("shm", "buf", "capacity")
+
+    def __init__(self, shm):
+        self.shm = shm
+        self.buf = shm.buf
+        self.capacity = shm.size
+
+    def _store(self, off, v):
+        _U64.pack_into(self.buf, off, v)
+
+    def push(self, payload: bytes, n_msgs):
+        self._store(_OFF_WRITE, 8)
+        self._store(_OFF_PUSHED, n_msgs)
+
+    def pop(self):
+        self._store(_OFF_READ, 8)
+        self._store(_OFF_DRAINED, 1)
+        return None
+
+    def send_route(self, m):
+        self.push(pickle.dumps(("route", m)), 1)
+
+    def teardown(self):
+        while self.pop() is not None:
+            pass
+        self.shm.close()
+        self.shm.unlink()
+
+
+def make_ring(size):
+    shm = shared_memory.SharedMemory(create=True, size=size)
+    try:
+        return GoodRing(shm)
+    except ValueError:
+        shm.unlink()
+        raise
+
+
+class GoodCounterRing:
+    def __init__(self):
+        from collections import deque
+        self._items = deque()
+        self.pushed_msgs = 0
+        self.drained_msgs = 0
+
+    def push(self, item):
+        self._items.append(item)
+        self.pushed_msgs += 1
+
+    def drain(self):
+        while self._items:
+            self._items.popleft()
+            self.drained_msgs += 1
+
+
+class SharedFreelist:
+    def __init__(self):
+        self.free = []
+        self._lock = threading.Lock()
+        self.thread = threading.Thread(target=self._worker_main)
+
+    def _worker_main(self):
+        while True:
+            self.free.append(object())
+            with self._lock:
+                self.free.pop()
+
+    def alloc(self):
+        return self.free.pop()
