@@ -1,0 +1,279 @@
+"""Multi-process observability harness — the cross-process request
+waterfall and where a multi-process silo's time goes (ISSUE 20).
+
+PR 18 split the silo into SO_REUSEPORT worker processes fed through
+shared-memory staging rings, and PR 19's analyzer hardened the relay
+protocol — but the observability stack stopped at the process boundary:
+a traced request went dark between the worker's ingress span and the
+owner's device tick, and no single report said how much of a request's
+wall time the ring hops cost. This harness drives the same saturated
+mixed host+vector workload as ``loop_attribution`` against a
+``worker_procs=2`` silo with the FULL observability stack on
+(profiling + metrics + tracing + ledger + management), then reads the
+three cross-process surfaces this PR adds back out:
+
+  * ``get_cluster_critical_path`` — loop occupancy, ingest/ring/egress
+    stage histograms, and device-tick span seconds from EVERY process
+    merged into one waterfall whose shares sum to ~1.0 of summed loop
+    wall (``shares_sum`` is the self-check the floor test asserts);
+  * ``get_cluster_ledger`` — per-origin device attribution: row-seconds
+    keyed by the originating worker process, so the merged ledger names
+    which worker's clients burn the device tier;
+  * a tail-traced probe request whose spans — client root, worker
+    ingress, shm staging-ring dwell, owner queue-wait + device tick,
+    response-ring dwell — are merged cluster-wide and checked for
+    union-interval coverage of the request wall (the contiguous
+    cross-process waterfall the ISSUE's acceptance names).
+
+``--observability-off`` runs the identical harness bare: the overhead
+A/B ``test_floor_multiproc_observability`` reads (full stack must keep
+>= 0.85x of bare multiproc throughput)."""
+
+import argparse
+import asyncio
+import json
+import time
+
+if __package__ in (None, ""):
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from orleans_tpu.runtime import SiloBuilder
+from orleans_tpu.runtime.socket_fabric import SocketFabric
+
+# same saturated mixed workload as the loop/ingest harnesses (one
+# definition: cross-bench share comparisons require identical traffic)
+from benchmarks.ingest_attribution import (_make_vector_grain,
+                                           connect_clients)
+from benchmarks.loop_attribution import LocalEchoGrain
+
+
+def waterfall_coverage(spans: list, trace_id: int) -> dict:
+    """Union-interval coverage of one trace's request wall: the client
+    root span is the wall, every other span contributes its clipped
+    [start, end) interval, and coverage is union seconds / root
+    duration. ONE definition shared with the worker_procs=2 trace test
+    (the ISSUE 20 acceptance read: >= 0.95 with the ring/queue/tick
+    segments present as contiguous legs)."""
+    tspans = [s for s in spans if s["trace_id"] == trace_id]
+    roots = [s for s in tspans if s["kind"] == "client"]
+    if not roots:
+        return {"coverage": 0.0, "segments": [], "kinds": []}
+    root = max(roots, key=lambda s: s["duration"])
+    t0, t1 = root["start"], root["start"] + root["duration"]
+    segs = []
+    for s in tspans:
+        if s is root:
+            continue
+        a = max(t0, s["start"])
+        b = min(t1, s["start"] + s["duration"])
+        if b > a:
+            segs.append((a, b, s["name"], s["kind"]))
+    segs.sort()
+    covered = 0.0
+    hi = t0
+    for a, b, _, _ in segs:
+        if b > hi:
+            covered += b - max(a, hi)
+            hi = b
+    wall = t1 - t0
+    return {
+        "coverage": round(covered / wall, 4) if wall > 0 else 0.0,
+        "wall_s": round(wall, 6),
+        "kinds": sorted({k for _, _, _, k in segs}),
+        "segments": [{"name": n, "kind": k,
+                      "offset_us": round((a - t0) * 1e6, 1),
+                      "dur_us": round((b - a) * 1e6, 1)}
+                     for a, b, n, k in segs],
+    }
+
+
+async def run(seconds: float = 2.0, concurrency: int = 32,
+              n_grains: int = 64, n_keys: int = 64,
+              worker_procs: int = 2, n_clients: int = 4,
+              observability: bool = True) -> dict:
+    """One ``worker_procs``-process silo over real TCP at closed-loop
+    saturation with management installed on both sides; with
+    ``observability`` the full stack is on (profiling, metrics, tracing,
+    ledger) and the cluster critical path, merged ledger, and a traced
+    probe request's waterfall ride in ``extra``. ``observability=False``
+    is the bare side of the overhead A/B — identical traffic, identical
+    management wiring, only the observability config differs."""
+    import numpy as np
+
+    from orleans_tpu.dispatch import add_vector_grains
+    from orleans_tpu.management import ManagementGrain, add_management
+    from orleans_tpu.parallel import make_mesh
+
+    EchoVec = _make_vector_grain()
+    fabric = SocketFabric()
+    obs_cfg = dict(profiling_enabled=True, profiling_window=0.25,
+                   metrics_enabled=True, trace_enabled=True,
+                   trace_sample_rate=0.01, ledger_enabled=True) \
+        if observability else {}
+    b = (SiloBuilder().with_name("mpobs-silo").with_fabric(fabric)
+         .add_grains(LocalEchoGrain)
+         .with_config(worker_procs=worker_procs, **obs_cfg))
+    add_vector_grains(b, EchoVec, mesh=make_mesh(1),
+                      dense={EchoVec: n_keys})
+    add_management(b)
+    silo = b.build()
+    await silo.start()
+    clients = []
+    try:
+        clients = await connect_clients(silo.gateway_endpoint, n_clients)
+        client = clients[0]
+        host_refs = [clients[k % len(clients)].get_grain(LocalEchoGrain, k)
+                     for k in range(n_grains)]
+        vec_refs = [clients[k % len(clients)].get_grain(EchoVec, k)
+                    for k in range(n_keys)]
+        await asyncio.gather(*(g.ping(0) for g in host_refs))
+        await asyncio.gather(*(v.ping(x=np.int32(0)) for v in vec_refs[:8]))
+
+        stop_at = time.perf_counter() + seconds
+        calls = 0
+
+        async def host_worker(wid: int) -> None:
+            nonlocal calls
+            i = wid
+            while time.perf_counter() < stop_at:
+                await host_refs[i % n_grains].ping(i)
+                i += 1
+                calls += 1
+
+        async def vec_worker(wid: int) -> None:
+            nonlocal calls
+            i = wid
+            while time.perf_counter() < stop_at:
+                await vec_refs[i % n_keys].ping(x=np.int32(i & 0x7FFF))
+                i += 1
+                calls += 1
+
+        t0 = time.perf_counter()
+        half = max(1, concurrency // 2)
+        await asyncio.gather(
+            *(host_worker(w) for w in range(half)),
+            *(vec_worker(w) for w in range(half)))
+        elapsed = time.perf_counter() - t0
+
+        workers = (silo.workers.describe()
+                   if silo.workers is not None else None)
+        critical_path = ledger = probe = None
+        if observability:
+            mgmt = client.get_grain(ManagementGrain, 0)
+            cp = await mgmt.get_cluster_critical_path()
+            critical_path = {
+                "wall_s": cp["wall_s"],
+                "shares": cp["shares"],
+                "shares_sum": round(sum(cp["shares"].values()), 4),
+                "processes": sorted(
+                    (p.get("pid"), addr) for addr, p
+                    in cp["processes"].items()),
+                "ring_stages": cp["stages"].get("ring", {}),
+                "device_spans": cp.get("device_spans"),
+            }
+            led = await mgmt.get_cluster_ledger(5)
+            ledger = {"procs": led.get("procs", {}),
+                      "worst_burner": led.get("worst_burner"),
+                      "wire_routes": len(led.get("wire", {}))}
+            # traced probe: one vector request rooted at the client with
+            # sample_rate=1.0 — the cross-process waterfall acceptance
+            client.enable_tracing(sample_rate=1.0, name="mpobs-client")
+            await vec_refs[0].ping(x=np.int32(1))
+            await asyncio.sleep(0.2)  # let the engine roll the tick span
+            cspans = client.tracer.snapshot()
+            tids = [s["trace_id"] for s in cspans if s["kind"] == "client"]
+            if tids:
+                tid = tids[-1]
+                spans = cspans + await mgmt.get_trace_spans(tid)
+                probe = waterfall_coverage(spans, tid)
+    finally:
+        for c in clients:
+            await c.close_async()
+        await silo.stop()
+    return {
+        "metric": "cluster_critical_path_shares_sum",
+        "value": (critical_path or {}).get("shares_sum", 0.0),
+        "unit": "sum of merged loop-share categories (~1.0)",
+        "vs_baseline": None,
+        "extra": {
+            "seconds": seconds, "concurrency": concurrency,
+            "worker_procs": worker_procs, "n_clients": n_clients,
+            "observability": observability,
+            "calls": calls,
+            "calls_per_sec": round(calls / elapsed, 1),
+            "workers": workers,
+            "critical_path": critical_path,
+            "ledger": ledger,
+            "trace_waterfall": probe,
+        },
+    }
+
+
+async def run_observability_ab(seconds: float = 2.0,
+                               concurrency: int = 32, procs: int = 2,
+                               n_clients: int = 4) -> dict:
+    """Observability-overhead A/B on the multi-process silo (the ISSUE
+    20 floor): identical mixed TCP traffic against two
+    ``worker_procs=procs`` silos differing ONLY in the observability
+    config — bare vs the full stack (profiling + metrics + tracing +
+    ledger). The floor is the throughput ratio (full/bare >= 0.85x);
+    the critical-path shares_sum and the traced probe's waterfall
+    coverage ride along as the structural acceptance reads.
+    ``parallel_capacity`` is stamped so the recorded ratio travels with
+    the capacity of the box that measured it."""
+    from benchmarks.parallel_probe import parallel_capacity
+
+    bare = await run(seconds, concurrency, worker_procs=procs,
+                     n_clients=n_clients, observability=False)
+    full = await run(seconds, concurrency, worker_procs=procs,
+                     n_clients=n_clients, observability=True)
+
+    def rate(r):
+        return r["extra"]["calls_per_sec"]
+
+    ratio = rate(full) / rate(bare) if rate(bare) else 0.0
+    x = full["extra"]
+    return {
+        "metric": "multiproc_observability_overhead",
+        "value": round(ratio, 3),
+        "unit": f"x (full stack vs bare, worker_procs={procs})",
+        "vs_baseline": None,
+        "extra": {
+            "seconds": seconds, "concurrency": concurrency,
+            "procs": procs, "n_clients": n_clients,
+            "parallel_capacity": round(parallel_capacity(), 3),
+            "bare_calls_per_sec": rate(bare),
+            "full_calls_per_sec": rate(full),
+            "critical_path": x["critical_path"],
+            "ledger": x["ledger"],
+            "trace_waterfall": x["trace_waterfall"],
+        },
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=3.0)
+    ap.add_argument("--concurrency", type=int, default=32)
+    ap.add_argument("--worker-procs", type=int, default=2)
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--observability-off", action="store_true",
+                    help="bare side of the overhead A/B")
+    ap.add_argument("--ab", action="store_true",
+                    help="run the bare-vs-full observability A/B")
+    a = ap.parse_args()
+    if a.ab:
+        print(json.dumps(asyncio.run(run_observability_ab(
+            a.seconds, a.concurrency, procs=a.worker_procs,
+            n_clients=a.clients))))
+    else:
+        print(json.dumps(asyncio.run(run(
+            a.seconds, a.concurrency, worker_procs=a.worker_procs,
+            n_clients=a.clients,
+            observability=not a.observability_off))))
+
+
+if __name__ == "__main__":
+    main()

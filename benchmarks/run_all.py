@@ -20,6 +20,7 @@ from benchmarks import (
     ingest_attribution,
     ledger_attribution,
     loop_attribution,
+    multiproc_attribution,
     mxu_handler,
     mapreduce,
     ping,
@@ -107,6 +108,15 @@ def main() -> None:
     # into the payload)
     print(json.dumps(asyncio.run(loop_attribution.run_multiproc_ab(
         seconds=2.0, concurrency=32))))
+    # multi-process observability A/B (ISSUE 20): bare vs full stack
+    # (profiling + metrics + tracing + ledger + management) on identical
+    # worker_procs=2 traffic — the overhead ratio (CI floor 0.85 in
+    # test_floor_multiproc_observability), plus the cluster critical
+    # path (merged shares_sum ~1.0), per-worker ledger attribution, and
+    # the traced probe's cross-process waterfall coverage (>= 0.95)
+    print(json.dumps(asyncio.run(
+        multiproc_attribution.run_observability_ab(
+            seconds=2.0, concurrency=32))))
     # deliberate client-side batching vs per-message senders, vector-only
     # (isolates the sender-side win from the mixed harness's host/vec
     # mix shift; measured ~1.5-1.8x, CI floor 1.2x)

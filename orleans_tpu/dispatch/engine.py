@@ -227,13 +227,20 @@ class _Pending:
     delay AND conflict-deferred extra ticks are attributed, on the owning
     silo only. ``future`` may be None (one-way batched-ingress calls —
     nothing consumes the per-lane result, so the batch skips the
-    future/callback machinery for them entirely)."""
+    future/callback machinery for them entirely). ``trace`` is an
+    optional ``(trace_id, parent_span_id)`` request trace context (set
+    by the dispatcher's vector bridge and by the cross-process staging
+    ring): a batch containing traced items records a correctly-parented
+    device-tick child span even when the engine's own head-sample roll
+    misses. ``origin`` labels the originating worker process for packed
+    cross-process batches (ledger per-worker attribution); None for
+    in-process calls."""
 
     __slots__ = ("key_hash", "shard", "slot", "fresh", "args", "future",
-                 "t_enq")
+                 "t_enq", "trace", "origin")
 
     def __init__(self, key_hash, shard, slot, fresh, args, future,
-                 t_enq=0.0):
+                 t_enq=0.0, trace=None, origin=None):
         self.key_hash = key_hash
         self.shard = shard
         self.slot = slot
@@ -241,6 +248,8 @@ class _Pending:
         self.args = args
         self.future = future
         self.t_enq = t_enq
+        self.trace = trace
+        self.origin = origin
 
 
 class _TickJob:
@@ -523,7 +532,8 @@ class VectorRuntime:
         return fut
 
     def call_group(self, grain_class: type, method: str,
-                   items: list) -> list:
+                   items: list, traces: list | None = None,
+                   origin: str | None = None) -> list:
         """Grouped enqueue — the engine half of the batched ingress
         hand-off. ``items`` is a list of ``(key_hash, kwargs,
         want_future)`` triples for ONE (class, method); every invocation
@@ -536,20 +546,30 @@ class VectorRuntime:
         entirely, which is a large slice of the per-message hand-off
         cost at batch sizes. A per-item schema violation resolves THAT
         item's future with the error (or drops the one-way item, the
-        per-message one-way contract); the rest of the group proceeds."""
+        per-message one-way contract); the rest of the group proceeds.
+
+        ``traces`` is an optional parallel list of per-item
+        ``(trace_id, parent_span_id)`` contexts (None entries for
+        untraced items): the tick records a correctly-parented
+        device-tick child span for each distinct context. ``origin``
+        labels every item with the originating worker process (the
+        cross-process ledger attribution key)."""
         m = self.method_of(grain_class, method)
         schema = m.args_schema
         skeys = schema.keys() if schema is not None else None
         tbl = self.table(grain_class)
         loop = asyncio.get_running_loop()
         t_enq = time.monotonic() if (self.stats is not None or
-                                     self.shed_trend is not None) else 0.0
+                                     self.shed_trend is not None or
+                                     traces is not None) else 0.0
         pend: list | None = None  # created on first ENQUEUED item so an
         # all-failed group never leaves an empty pending entry behind (a
         # tick over it would crash first-batch schema inference)
         dense_n, per = tbl.dense_n, tbl.dense_per_shard
         futs: list = []
+        idx = -1
         for key_hash, args, want_future in items:
+            idx += 1
             fut = loop.create_future() if want_future else None
             futs.append(fut)
             try:
@@ -572,13 +592,17 @@ class VectorRuntime:
             if pend is None:
                 pend = self.pending.setdefault((grain_class, method), [])
             pend.append(_Pending(key_hash, shard, slot, fresh, args, fut,
-                                 t_enq))
+                                 t_enq,
+                                 traces[idx] if traces is not None else None,
+                                 origin))
         if pend is not None:
             self._schedule_tick(loop)
         return futs
 
     def call_packed(self, grain_class: type, method: str, key_hashes: list,
-                    columns: dict, wants: list) -> list:
+                    columns: dict, wants: list,
+                    traces: list | None = None,
+                    origin: str | None = None) -> list:
         """Columnar enqueue — the owner-process half of the cross-process
         staging ring (runtime.multiproc): a worker packs one ingress
         batch's calls column-major (one ``columns[name]`` list per
@@ -587,6 +611,9 @@ class VectorRuntime:
         method/table resolution, one enqueue stamp, one tick schedule
         for the whole record, and bit-for-bit the ``call_group`` result
         semantics (that is what the shm-parity test asserts).
+        ``traces``/``origin`` carry the ring record's per-sub trace
+        contexts and originating-worker label through to the tick (see
+        :meth:`call_group`).
 
         Deliberately NOT a direct scatter into the ``[n_shards, B]``
         staging buffers: lane allocation is owner state under the tick
@@ -597,7 +624,8 @@ class VectorRuntime:
         cols = [columns[n] for n in names]
         return self.call_group(grain_class, method, [
             (kh, {n: col[i] for n, col in zip(names, cols)}, want)
-            for i, (kh, want) in enumerate(zip(key_hashes, wants))])
+            for i, (kh, want) in enumerate(zip(key_hashes, wants))],
+            traces=traces, origin=origin)
 
     # -- write-behind dirty tracking (consumed by storage.checkpoint) ----
     def enable_dirty_tracking(self) -> None:
@@ -790,21 +818,55 @@ class VectorRuntime:
             ctr[p.key_hash] = ctr.get(p.key_hash, 0) + 1
         self._worker_q.put(job)
 
-    def _record_tick_span(self, span, n: int, error: bool = False) -> None:
+    def _record_tick_span(self, span, ready: list, error: bool = False
+                          ) -> None:
         """Loop-side record of a device-tick span from worker- (or
         inline-) stamped timings; ``span`` = (name, wall_start,
-        duration) or None. The error form is what tail retention keys
-        on, so failing sampled ticks stay visible in retained traces."""
-        if span is not None and self.tracer is not None:
-            name, start_wall, dur = span
+        duration[, batch_wall, batch_mono]) or None. The error form is
+        what tail retention keys on, so failing sampled ticks stay
+        visible in retained traces.
+
+        Items carrying a request trace context additionally get (a) a
+        device-tick child span parented into THEIR trace, spanning
+        batch start (staging fill) through host materialize — the
+        owner-side leg of the cross-process waterfall — and (b) a
+        queue-wait server span covering enqueue → batch start, so the
+        ring-dwell / queue-wait / tick segments read contiguously. One
+        pair per distinct context (the tick is one event)."""
+        tracer = self.tracer
+        if span is None or tracer is None:
+            return
+        name, start_wall, dur = span[0], span[1], span[2]
+        n = len(ready)
+        if error:
+            tracer.record(tracer.device_trace_id, None, name,
+                          "device_tick", start_wall, dur, batch=n,
+                          error=True)
+        else:
+            tracer.record(tracer.device_trace_id, None, name,
+                          "device_tick", start_wall, dur, batch=n)
+        if len(span) < 5:
+            return
+        batch_wall, batch_mono = span[3], span[4]
+        end_wall = start_wall + dur
+        seen: set = set()
+        for p in ready:
+            tr = p.trace
+            if tr is None or tr in seen:
+                continue
+            seen.add(tr)
+            tid, psid = tr
             if error:
-                self.tracer.record(self.tracer.device_trace_id, None,
-                                   name, "device_tick", start_wall, dur,
-                                   batch=n, error=True)
+                tracer.record(tid, psid, name, "device_tick", batch_wall,
+                              max(0.0, end_wall - batch_wall), batch=n,
+                              error=True)
             else:
-                self.tracer.record(self.tracer.device_trace_id, None,
-                                   name, "device_tick", start_wall, dur,
-                                   batch=n)
+                tracer.record(tid, psid, name, "device_tick", batch_wall,
+                              max(0.0, end_wall - batch_wall), batch=n)
+            if p.t_enq and batch_mono > p.t_enq:
+                q = batch_mono - p.t_enq
+                tracer.record(tid, psid, "engine.queue_wait", "server",
+                              batch_wall - q, q, queue_s=q, exec_s=0.0)
 
     def _complete_job(self, job: _TickJob, host, err) -> None:
         """Loop-side completion: resolve futures (or fail them), record
@@ -841,12 +903,12 @@ class VectorRuntime:
                 log.error("vector tick failed for %s.%s",
                           job.cls.__name__, job.method, exc_info=err)
                 self._record_tick_span(getattr(err, "_tick_span", None),
-                                       len(job.ready), error=True)
+                                       job.ready, error=True)
                 for p in job.ready:
                     if p.future is not None and not p.future.done():
                         p.future.set_exception(err)
             else:
-                self._record_tick_span(job.span, len(job.ready))
+                self._record_tick_span(job.span, job.ready)
                 self._resolve_batch(job.ready, job.per_shard, host)
         except BaseException as e2:  # noqa: BLE001 — fail futures, not loop
             log.exception("vector tick completion failed for %s.%s",
@@ -901,8 +963,13 @@ class VectorRuntime:
             if not ready:
                 continue
             # device-tick sampling rolls HERE (loop-side) on both paths:
-            # the worker must not touch the collector
-            roll = tracer is not None and tracer.sample()
+            # the worker must not touch the collector. A batch carrying
+            # request trace contexts (threaded over the cross-process
+            # staging ring or the vector bridge) records regardless of
+            # the roll: header presence IS the upstream sampled decision
+            roll = tracer is not None and (
+                tracer.sample()
+                or any(p.trace is not None for p in ready))
             if offloop:
                 self._submit_job(_TickJob(cls, method, ready, roll))
                 continue
@@ -912,7 +979,7 @@ class VectorRuntime:
                 log.exception("vector tick failed for %s.%s",
                               cls.__name__, method)
                 self._record_tick_span(getattr(e, "_tick_span", None),
-                                       len(ready), error=True)
+                                       ready, error=True)
                 for p in ready:
                     if p.future is not None and not p.future.done():
                         p.future.set_exception(e)
@@ -949,7 +1016,7 @@ class VectorRuntime:
         with self._fence:
             per_shard, host, span = self._execute_batch(
                 cls, method, ready, self.loop_prof, trace_roll=trace_roll)
-        self._record_tick_span(span, len(ready))
+        self._record_tick_span(span, ready)
         self._resolve_batch(ready, per_shard, host)
 
     def _resolve_batch(self, ready: list[_Pending], per_shard,
@@ -982,13 +1049,21 @@ class VectorRuntime:
             # names this batch in the flight recorder's top-K and is only
             # string-joined on admission — every tick pays no format
             lp.set_category("tick_staging", ("tick", cls.__name__, method))
-        t_stage = now_mono = 0.0
+        t_stage = now_mono = batch_wall = 0.0
         if st is not None:
             t_stage = time.perf_counter()
-        if st is not None or self.shed_trend is not None:
+        if st is not None or self.shed_trend is not None or trace_roll:
             now_mono = time.monotonic()  # queue-wait ends at batch start
             # (the shed trend needs the stamp even with metrics off —
-            # t_enq is gated the same way in call/call_group)
+            # t_enq is gated the same way in call/call_group; traced
+            # batches need it for the queue-wait child span)
+        if trace_roll:
+            # wall twin of the batch-start stamp: the traced device-tick
+            # child span opens HERE (staging fill onward), so the
+            # waterfall's queue-wait → staging/transfer/tick segments
+            # are contiguous (the sampled device_trace_id span keeps its
+            # kernel-dispatch-onward semantics)
+            batch_wall = time.time()
         tbl = self.tables[cls]
         m = tbl.methods[method]
         # schema inference is committed only after a successful batch so a
@@ -1091,7 +1166,8 @@ class VectorRuntime:
                 # just loses the span, never the error)
                 try:
                     e._tick_span = (span_name, span_start,
-                                    time.perf_counter() - t_span0)
+                                    time.perf_counter() - t_span0,
+                                    batch_wall, now_mono)
                 except AttributeError:
                     pass
             raise
@@ -1143,6 +1219,13 @@ class VectorRuntime:
             payload = (cls.__name__, method, len(ready), tick_s,
                        tuple(f"{cls.__name__}#{p.key_hash}"
                              for p in ready))
+            if any(p.origin is not None for p in ready):
+                # cross-process batch: per-item originating-worker labels
+                # ride as a parallel 6th element (the ledger's per-process
+                # device-time attribution key); in-process payloads stay
+                # 5-tuples so merged snapshots are stable across versions
+                payload = payload + (
+                    tuple(p.origin for p in ready),)
             if sink is not None:
                 sink.append((_LEDGER, payload))
             else:
@@ -1153,8 +1236,10 @@ class VectorRuntime:
         if trace_roll and span_name is not None:
             # duration closes AFTER the host transfer: closing at kernel
             # return would record ~0 for exactly the hot ticks tracing
-            # exists to attribute. Recorded by the caller (loop-side).
-            span = (span_name, span_start, time.perf_counter() - t_span0)
+            # exists to attribute. Recorded by the caller (loop-side);
+            # the batch-start stamps parent traced items' child spans.
+            span = (span_name, span_start, time.perf_counter() - t_span0,
+                    batch_wall, now_mono)
         if lp is not None:
             # sync paid: future resolution is scheduling work again
             lp.set_category("tick_schedule")

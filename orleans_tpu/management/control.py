@@ -166,11 +166,20 @@ class SiloControl:
         co-hosted silos on one event loop share one profiler (occupancy
         is a loop property), so their payloads are views of the same
         loop."""
+        import os
         lp = self.silo.loop_prof
         if lp is None:
             return {}
         out = lp.profile(windows, snapshots=snapshots)
         out["silo"] = self.silo.config.name
+        # pid-stamp the payload AND each flight-recorder snapshot: under
+        # worker_procs>1 every process profiles its own loop, and a
+        # cluster merge that pools anomaly snapshots must still name the
+        # process that tripped (copies — the recorder ring is live state)
+        out["pid"] = os.getpid()
+        if out.get("snapshots"):
+            out["snapshots"] = [dict(s, pid=os.getpid())
+                                for s in out["snapshots"]]
         pool = self.silo.ingress_pool
         if pool is not None:
             # multi-loop silo: the profiler installs PER LOOP, so each
@@ -179,6 +188,43 @@ class SiloControl:
             # ctl_loop_profile aggregation the tentpole design promised)
             out["ingress_loops"] = await pool.loop_profiles(
                 windows=min(windows, 8))
+        return out
+
+    async def ctl_critical_path(self) -> dict:
+        """Per-silo critical-path leaf: loop-profiler occupancy seconds
+        over its wall, the ingest / shm-ring / egress stage histograms
+        (bucket-bearing summaries, so the cluster merge folds them
+        losslessly via Histogram.merge), and the device-tick span count/
+        seconds from the tracer's synthetic device trace.
+        ManagementGrain.get_cluster_critical_path merges one of these
+        per process — owner and every shm worker — into the cluster
+        request waterfall."""
+        import os
+        from ..observability.stats import (EGRESS_STATS, INGEST_STATS,
+                                           RING_STATS)
+        out: dict = {"silo": self.silo.config.name, "pid": os.getpid()}
+        lp = self.silo.loop_prof
+        if lp is not None:
+            prof = lp.profile(0, snapshots=False)
+            out["loop"] = {"wall_s": prof["wall_s"],
+                           "seconds": prof["seconds"]}
+        hists = self.silo.stats.histograms
+        stages: dict[str, dict] = {}
+        for group, table in (("ingest", INGEST_STATS),
+                             ("ring", RING_STATS),
+                             ("egress", EGRESS_STATS)):
+            g = {key: hists[name].summary()
+                 for key, name in table.items() if name in hists}
+            if g:
+                stages[group] = g
+        out["stages"] = stages
+        tracer = self.silo.tracer
+        if tracer is not None:
+            dev = tracer.snapshot(tracer.device_trace_id)
+            out["device_spans"] = {
+                "count": len(dev),
+                "seconds": round(sum(s["duration"] for s in dev), 6),
+            }
         return out
 
     async def ctl_slo(self) -> dict:

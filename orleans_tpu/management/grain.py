@@ -173,6 +173,53 @@ class ManagementGrain(Grain):
             "per_silo": per_silo,
         }
 
+    async def get_cluster_critical_path(self) -> dict:
+        """Cluster-wide request waterfall over every process's
+        ``ctl_critical_path``: loop-profiler occupancy seconds sum per
+        category across processes (owner and shm workers alike — workers
+        are cluster members, so the fan-out reaches them by address) with
+        shares recomputed over the summed wall, so the shares sum to
+        ~1.0 of measured loop wall by construction; ingest / shm-ring /
+        egress stage histograms fold losslessly via their per-bucket
+        counts; device-tick span seconds sum. ``processes`` carries each
+        per-process leaf (silo name + pid) for drill-down — the answer to
+        "where does a cross-process request spend its wall time"."""
+        from ..observability.stats import Histogram
+        per_silo = await self._fan_out("ctl_critical_path")
+        seconds: dict[str, float] = {}
+        wall = 0.0
+        stage_h: dict[str, dict[str, Histogram]] = {}
+        dev_count, dev_seconds = 0, 0.0
+        for snap in per_silo.values():
+            loop = snap.get("loop")
+            if loop:
+                wall += float(loop.get("wall_s", 0.0))
+                for k, v in (loop.get("seconds") or {}).items():
+                    seconds[k] = seconds.get(k, 0.0) + float(v)
+            for group, table in (snap.get("stages") or {}).items():
+                acc = stage_h.setdefault(group, {})
+                for key, h in table.items():
+                    merged = acc.get(key)
+                    if merged is None:
+                        acc[key] = Histogram.from_snapshot(h)
+                    else:
+                        merged.merge(Histogram.from_snapshot(h))
+            dev = snap.get("device_spans")
+            if dev:
+                dev_count += int(dev.get("count", 0))
+                dev_seconds += float(dev.get("seconds", 0.0))
+        return {
+            "wall_s": round(wall, 6),
+            "seconds": {k: round(v, 6) for k, v in seconds.items()},
+            "shares": {k: round(v / wall, 4)
+                       for k, v in seconds.items()} if wall else {},
+            "stages": {group: {key: h.summary() for key, h in acc.items()}
+                       for group, acc in stage_h.items()},
+            "device_spans": {"count": dev_count,
+                             "seconds": round(dev_seconds, 6)},
+            "processes": per_silo,
+        }
+
     async def get_cluster_slo(self) -> dict:
         """Cluster-wide SLO rollup over every silo's ``ctl_slo``:
         per-objective **worst-burn-wins** merge — burn rates and budget

@@ -171,6 +171,12 @@ class CostLedger:
         self.device: dict[tuple[str, str], list[float]] = {}
         self.row_overflow = 0          # charges past the _MAX_ROWS cap
         self.wire: dict[str, list[int]] = {}   # route -> [rx, tx]
+        # exact per-originating-process device rows (the cross-process
+        # attribution of ISSUE 20): origin label ("worker-N") ->
+        # [rows, row_seconds] — which worker's traffic is burning the
+        # owner's device. Fed by the engine payload's optional origins
+        # column; empty in single-process silos.
+        self.procs: dict[str, list[float]] = {}
         self.streams: dict[str, int] = {}      # namespace -> deliveries
         self.keys = SpaceSavingSketch(self.top_k)     # label -> seconds
         self.tenants = SpaceSavingSketch(self.top_k)  # tenant -> seconds
@@ -220,11 +226,14 @@ class CostLedger:
 
     def charge_tick(self, payload: tuple) -> None:
         """One device tick, as stamped by the engine:
-        ``(cls_name, method, rows, tick_seconds, key_labels)`` —
-        row-seconds = rows × tick wall; each key label is charged its
+        ``(cls_name, method, rows, tick_seconds, key_labels[, origins])``
+        — row-seconds = rows × tick wall; each key label is charged its
         per-row share. Batched traffic carries no per-call baggage, so
-        tenancy comes from the ``tenant_of`` hook only."""
-        cls_name, method, rows, tick_s, key_labels = payload
+        tenancy comes from the ``tenant_of`` hook only. The optional
+        ``origins`` column (parallel to ``key_labels``) attributes each
+        row's device time to the originating worker process — the
+        cross-process batch case; 5-tuples (in-process) skip it."""
+        cls_name, method, rows, tick_s, key_labels = payload[:5]
         self.charges += 1
         row = self.device.get((cls_name, method))
         if row is not None:
@@ -239,6 +248,18 @@ class CostLedger:
             share = tick_s  # each row occupied the whole tick's wall
             for label in key_labels:
                 self._charge_key(label, share, baggage=False)
+        if len(payload) > 5 and payload[5]:
+            for origin in payload[5]:
+                if origin is None:
+                    continue
+                prow = self.procs.get(origin)
+                if prow is not None:
+                    prow[0] += 1
+                    prow[1] += tick_s
+                elif len(self.procs) < _MAX_ROWS:
+                    self.procs[origin] = [1, tick_s]
+                else:
+                    self.row_overflow += 1
 
     def charge_wire(self, route: str, rx: int = 0, tx: int = 0) -> None:
         """Bytes moved on one route (peer endpoint / client address /
@@ -318,6 +339,8 @@ class CostLedger:
                        for (c, m), r in self.device.items()},
             "row_overflow": self.row_overflow,
             "wire": {route: list(r) for route, r in self.wire.items()},
+            "procs": {origin: list(r)
+                      for origin, r in self.procs.items()},
             "streams": dict(self.streams),
             "keys": self.keys.snapshot(),
             "tenants": self.tenants.snapshot(),
@@ -335,6 +358,7 @@ class CostLedger:
         turns: dict[str, list[float]] = {}
         device: dict[str, list[float]] = {}
         wire: dict[str, list[int]] = {}
+        procs: dict[str, list[float]] = {}
         streams: dict[str, int] = {}
         row_overflow = 0
         charges = 0
@@ -353,6 +377,10 @@ class CostLedger:
                 acc = wire.setdefault(route, [0, 0])
                 acc[0] += row[0]
                 acc[1] += row[1]
+            for origin, row in s.get("procs", {}).items():
+                acc = procs.setdefault(origin, [0, 0.0])
+                acc[0] += row[0]
+                acc[1] += row[1]
             for ns, n in s.get("streams", {}).items():
                 streams[ns] = streams.get(ns, 0) + n
         keys = SpaceSavingSketch.merge(
@@ -361,6 +389,7 @@ class CostLedger:
             [s.get("tenants", {}) for s in snapshots])
         out = {
             "turns": turns, "device": device, "wire": wire,
+            "procs": procs,
             "streams": streams, "row_overflow": row_overflow,
             "charges": charges, "keys": keys, "tenants": tenants,
             "worst_burner": None, "worst_tenant": None,

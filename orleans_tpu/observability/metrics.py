@@ -171,6 +171,8 @@ class MetricsSampler:
             self._install_vector_sources()
         if silo.stream_providers:
             self._install_stream_sources()
+        if getattr(silo, "workers", None) is not None:
+            self._install_worker_sources()
 
     def _install_vector_sources(self) -> None:
         silo = self.silo
@@ -182,6 +184,38 @@ class MetricsSampler:
                         lambda: silo.vector.staging_lanes())
         self.add_source("vector.staging_fill",
                         lambda: silo.vector.staging_fill)
+
+    def _install_worker_sources(self) -> None:
+        """Multi-process shm-ring health gauges, read off the owner's
+        WorkerSupervisor.describe() (single-writer cumulative counters,
+        so each read is torn-free):
+
+        - ``workers.alive`` — live worker processes (a drop below
+          ``worker_procs`` is the page);
+        - ``workers.req_pushed/req_drained/req_backlog`` — staging-ring
+          totals across workers (a growing backlog means the owner's
+          drain is falling behind the workers' decode);
+        - ``workers.resp_pushed/resp_drained/resp_backlog`` — the return
+          leg (a growing backlog means a worker pump has stalled);
+        - ``workers.route_spread`` — max-min client routes per worker
+          (the accept-balance spread the multiproc floor asserts on)."""
+        sup = self.silo.workers
+
+        def _field(key: str) -> float:
+            return float(sum(w.get(key, 0) or 0
+                             for w in sup.describe()["workers"]))
+
+        def _spread() -> float:
+            routes = [w.get("client_routes", 0)
+                      for w in sup.describe()["workers"]]
+            return float(max(routes) - min(routes)) if routes else 0.0
+
+        self.add_source("workers.alive",
+                        lambda: _field("alive"))
+        for key in ("req_pushed", "req_drained", "req_backlog",
+                    "resp_pushed", "resp_drained", "resp_backlog"):
+            self.add_source(f"workers.{key}", lambda k=key: _field(k))
+        self.add_source("workers.route_spread", _spread)
 
     def _install_stream_sources(self) -> None:
         """Stream-provider health gauges, summed over every installed
@@ -251,6 +285,11 @@ class MetricsSampler:
             # stream providers install via lifecycle stages that run
             # after the sampler is constructed
             self._install_stream_sources()
+        if getattr(self.silo, "workers", None) is not None and \
+                "workers.alive" not in self._sources:
+            # the worker supervisor spawns during silo start, after the
+            # sampler is constructed
+            self._install_worker_sources()
         self._task = asyncio.get_running_loop().create_task(self._loop())
 
     def stop(self) -> None:

@@ -15,8 +15,8 @@ from typing import Callable
 
 __all__ = ["StatsRegistry", "Histogram", "QueueWaitTrend", "CallSiteStats",
            "DISPATCH_STATS", "REBALANCE_STATS", "INGEST_STATS",
-           "INGEST_STAGES", "EGRESS_STATS", "EGRESS_STAGES", "SLO_STATS",
-           "SIZE_BOUNDS", "COUNT_BOUNDS"]
+           "INGEST_STAGES", "EGRESS_STATS", "EGRESS_STAGES", "RING_STATS",
+           "RING_STAGES", "SLO_STATS", "SIZE_BOUNDS", "COUNT_BOUNDS"]
 
 # Hot-lane dispatch counter pair (runtime.hotlane): hits = calls that ran
 # as frame-collapsed inline turns (including the always-interleave direct
@@ -134,6 +134,47 @@ EGRESS_STATS = {
     # for a producer that cannot pause response generation; senders
     # learn via response timeout exactly like a dead-peer send drop)
     "ring_drops": "egress.ring_drops",
+}
+
+
+# Canonical shm-ring stage metrics — the cross-process leg of the ingest
+# decomposition (runtime.multiproc: worker SO_REUSEPORT silos feed the
+# device owner over shared-memory SPSC staging rings; responses return
+# over per-worker response rings). Stage histograms attribute the ring
+# hop the same way INGEST_STAGES attribute the in-process pipeline:
+#
+#   staging_dwell   push (worker-side VectorShmClient.call_group) ->
+#                   pop (owner-side WorkerSupervisor drain) of one
+#                   staging-ring record, against the system-wide
+#                   CLOCK_MONOTONIC stamp carried in the record.
+#                   Stamped push-side in the worker process, observed
+#                   pop-side on the owner's loop (the cross-PROCESS
+#                   analog of the stamp-and-replay rule: the stamp is
+#                   plain bytes in the ring record, the observe runs
+#                   loop-confined on the consumer)
+#   response_dwell  push (owner-side _flush_link) -> pop (worker-side
+#                   response drain) of one response batch — the return
+#                   leg, observed on the worker's loop
+#   drain_batch     records drained per owner wakeup (COUNT_BOUNDS —
+#                   the ring twin of ingest frame_batch: a rising batch
+#                   size under load is the rings' natural coalescing)
+#   group           packed-group size: vector subs per "vec" record
+#                   (COUNT_BOUNDS — the cross-process batching degree)
+#   hops            relay hop count per record (COUNT_BOUNDS — 1 for
+#                   the direct worker->owner path today; forwarded/
+#                   re-pushed records would accumulate here)
+#
+# Everything is gated on SiloConfig.metrics_enabled exactly like the
+# ingest/egress stages — one attr check per site when off.
+RING_STAGES = ("staging_dwell", "response_dwell")
+
+RING_STATS = {
+    "staging_dwell": "ring.staging.dwell.seconds",
+    "response_dwell": "ring.response.dwell.seconds",
+    "drain_batch": "ring.drain_batch.size",      # COUNT_BOUNDS histogram
+    "group": "ring.packed_group.size",           # COUNT_BOUNDS histogram
+    "hops": "ring.relay.hops",                   # COUNT_BOUNDS histogram
+    "records": "ring.records",                   # counter: records drained
 }
 
 

@@ -103,9 +103,12 @@ def arm_root_link(link: tuple[int, int] | None) -> None:
 
 # span kinds a collector records; critical_path_breakdown buckets by these
 # ("event" is the zero-duration annotation kind — rejections, forward hops —
-# which the breakdown deliberately ignores)
+# which the breakdown deliberately ignores; "ring" is the shm staging/
+# response ring dwell between a worker process and the device owner —
+# network-style, stamped push-side and observed pop-side, but bucketed
+# separately so the cross-process hop is attributable on its own)
 SPAN_KINDS = ("client", "server", "network", "directory", "device",
-              "device_tick", "migration", "event")
+              "device_tick", "migration", "ring", "event")
 
 
 def new_trace_id() -> int:
@@ -802,7 +805,7 @@ def restamp_header(request_context: dict | None) -> dict | None:
 # ---------------------------------------------------------------------------
 
 _BREAKDOWN_KEYS = ("queue", "exec", "network", "directory", "device",
-                   "migration")
+                   "migration", "ring")
 
 
 def critical_path_breakdown(spans) -> dict:
@@ -839,6 +842,8 @@ def critical_path_breakdown(spans) -> dict:
             seconds["device"] += s["duration"]
         elif kind == "migration":
             seconds["migration"] += s["duration"]
+        elif kind == "ring":
+            seconds["ring"] += s["duration"]
     return {
         "total_s": total,
         "span_count": len(dicts),

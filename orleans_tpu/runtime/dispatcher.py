@@ -384,10 +384,18 @@ class Dispatcher:
         in the trace header it already parsed for the want-future
         decision)."""
         tracer = self.silo.tracer
+        vspan = None
         if tracer is not None:
             if hdr is _HDR_UNPARSED:
                 hdr = context_from_headers(msg.request_context)
             if hdr is not None:
+                # request-leg network span (host-path twin): the
+                # client's send-side wall stamp → here, so the traced
+                # waterfall has no dark gap between the client root and
+                # the first silo-side span (ISSUE 20: under worker
+                # processes the next span is the shm staging-ring leg)
+                tracer.record(hdr[0], hdr[1], "network", "network",
+                              hdr[2], time.time() - hdr[2])
                 # device span: enqueue → tick-resolved future (the host
                 # view of the batched kernel turn; the engine's own tick
                 # spans + TraceAnnotation carry the per-tick detail)
@@ -407,9 +415,17 @@ class Dispatcher:
                 return
             exc = f.exception()
             if exc is not None:
-                self.send_response(msg, make_error_response(msg, exc))
+                resp = make_error_response(msg, exc)
             else:
-                self.send_response(msg, make_response(msg, f.result()))
+                resp = make_response(msg, f.result())
+            if vspan is not None:
+                # response-leg wall stamp, as on host turns: the client
+                # measures stamp → arrival as the response network span
+                # (under worker processes this stamp lands right after
+                # the response-ring pop, so the waterfall's tail —
+                # egress encode + wire — is covered too)
+                self._stamp_response(resp, vspan)
+            self.send_response(msg, resp)
 
         fut.add_done_callback(done)
 
@@ -512,9 +528,17 @@ class Dispatcher:
             g.append((msg, key_hash, kwargs, want, hdr))
         for method, items in groups.items():
             try:
+                # per-item trace contexts ride beside the group: the
+                # engine (or the shm proxy, in a worker process) parents
+                # the device-tick span into each sampled request's trace
+                # — hdr differs per message within one group, so it
+                # threads per item, not per group
+                traces = ([hdr[:2] if hdr is not None else None
+                           for _, _, _, _, hdr in items]
+                          if tracer is not None else None)
                 futs = rt.call_group(vcls, method,
                                      [(kh, kw, w) for _, kh, kw, w, _ in
-                                      items])
+                                      items], traces=traces)
             except Exception as e:  # noqa: BLE001 — unknown method etc.
                 # the whole group failed together: one egress flush per
                 # destination instead of N per-message response hops

@@ -81,11 +81,26 @@ from typing import TYPE_CHECKING, Any
 from ..core import serialization as _ser
 from ..core.errors import ConfigurationError, SiloUnavailableError
 from ..core.ids import SiloAddress
+from ..observability.stats import COUNT_BOUNDS, RING_STATS
 
 if TYPE_CHECKING:
     from .silo import Silo
 
 log = logging.getLogger("orleans.multiproc")
+
+# ring-stage metric names resolved once (observability.stats.RING_STATS —
+# the cross-process leg of the ingest decomposition). Dwell stages are
+# stamped push-side INTO the ring record (plain bytes cross the process
+# boundary, the stamp-and-replay discipline one address space wider) and
+# observed pop-side on the consumer's own loop; CLOCK_MONOTONIC is
+# system-wide on Linux, so a producer stamp compares against a consumer
+# read directly.
+_RS_STAGING = RING_STATS["staging_dwell"]
+_RS_RESPONSE = RING_STATS["response_dwell"]
+_RS_DRAIN = RING_STATS["drain_batch"]
+_RS_GROUP = RING_STATS["group"]
+_RS_HOPS = RING_STATS["hops"]
+_RS_RECORDS = RING_STATS["records"]
 
 __all__ = ["ShmRing", "WorkerSupervisor", "VectorShmClient"]
 
@@ -345,6 +360,17 @@ class VectorShmClient:
         self.exchange_lanes = 0
         self.tables: dict = {}
         self.pending: dict = {}
+        # observability taps, set by _worker_async after the worker silo
+        # builds them (None = off; every site guards on the None):
+        # tracer closes the response-ring leg span per traced call,
+        # stats is the silo's metrics-gated registry (ingest_stats
+        # idiom) for the ring-stage histograms
+        self.tracer = None
+        self.stats = None
+        # corr -> (trace_id, parent_span_id) for in-flight traced calls:
+        # the response pop closes the return-leg span into the right
+        # trace (bounded by the futures table it parallels)
+        self._trace_of: dict[int, tuple] = {}
 
     # the one key->hash rule, mirrored from VectorRuntime.key_hash_for
     # (dispatch.engine imports jax; a worker process must not)
@@ -373,19 +399,33 @@ class VectorShmClient:
                                [(key_hash, args, True)])[0]
 
     def call_group(self, grain_class: type, method: str,
-                   items: list) -> list:
+                   items: list, traces: list | None = None,
+                   origin: str | None = None) -> list:
         """Grouped enqueue, ring edition: the batch packs column-major
         (one names tuple + per-argument value columns — the staging
         layout the owner's ``call_packed`` consumes) and lands in the
         shared segment in ONE push. Returns one entry per item in item
         order: a future where ``want_future`` was set, else None (the
-        ``call_group`` contract)."""
+        ``call_group`` contract).
+
+        ``traces`` (optional, parallel to ``items``) carries per-item
+        ``(trace_id, parent_span_id)`` contexts: they ride the record
+        across the ring so the owner opens correctly-parented ring-leg
+        and device-tick child spans, and the response pop here closes
+        the return leg. The record header carries the push stamps
+        (monotonic for dwell, wall for span starts) and a relay hop
+        count — stamped push-side, observed pop-side. ``origin`` is
+        accepted for engine-signature parity; the owner labels batches
+        by link, so it is unused here."""
         loop = asyncio.get_running_loop()
+        tracer = self.tracer
         futs: list = []
         # sub-batches keyed by the kwargs name tuple: schema-bound
         # callers all share one; a mixed group still packs correctly
         subs: dict[tuple, list] = {}
+        idx = -1
         for key_hash, args, want_future in items:
+            idx += 1
             fut = loop.create_future() if want_future else None
             futs.append(fut)
             corr = -1
@@ -393,18 +433,28 @@ class VectorShmClient:
                 self._corr += 1
                 corr = self._corr
                 self._futures[corr] = fut
+            tr = traces[idx] if traces is not None else None
+            if tr is not None and tracer is not None:
+                if corr >= 0:
+                    self._trace_of[corr] = tr
+                # this trace's legs are about to leave the process over
+                # the ring — retention must fan the pull out (the
+                # send-side hook rule, ring edition)
+                tracer.mark_remote(tr[0])
             names = tuple(args)
             sub = subs.get(names)
             if sub is None:
-                sub = subs[names] = [[], [], [list() for _ in names]]
+                sub = subs[names] = [[], [], [list() for _ in names], []]
             sub[0].append(key_hash)
             sub[1].append(corr)
             for col, name in zip(sub[2], names):
                 col.append(args[name])
+            sub[3].append(tr)
         routes = self.table(grain_class).drain_routes()
         record = ("vec", grain_class.__name__, method, routes,
-                  [(names, khs, corrs, cols)
-                   for names, (khs, corrs, cols) in subs.items()])
+                  [(names, khs, corrs, cols, trs)
+                   for names, (khs, corrs, cols, trs) in subs.items()],
+                  time.monotonic(), time.time(), 1)
         if not self.ring.push(pickle.dumps(record, protocol=5),
                               n_msgs=len(items)):
             # bounded backpressure: the staging ring (or the engine
@@ -417,13 +467,32 @@ class VectorShmClient:
                     fut.set_exception(err)
             self._futures = {c: f for c, f in self._futures.items()
                              if not f.done()}
+            self._trace_of = {c: t for c, t in self._trace_of.items()
+                              if c in self._futures}
         return futs
 
     # -- response-ring drain (worker loop) --------------------------------
-    def resolve(self, results: list) -> None:
-        """Apply one response batch: ``(corr, ok, payload)`` triples."""
+    def resolve(self, results: list, t_push_mono: float = 0.0,
+                t_push_wall: float = 0.0) -> None:
+        """Apply one response batch: ``(corr, ok, payload)`` triples.
+        ``t_push_mono``/``t_push_wall`` are the owner's response-ring
+        push stamps: the pop here (this worker's loop) closes the
+        return-leg dwell — the response_dwell histogram plus one "ring"
+        span per traced call, parented into the request's trace."""
+        dwell = 0.0
+        if t_push_mono:
+            dwell = max(0.0, time.monotonic() - t_push_mono)
+            st = self.stats
+            if st is not None:
+                st.observe(_RS_RESPONSE, dwell)
+        tracer = self.tracer
+        trace_of = self._trace_of
         futures = self._futures
         for corr, ok, payload in results:
+            tr = trace_of.pop(corr, None)
+            if tr is not None and tracer is not None and t_push_mono:
+                tracer.record(tr[0], tr[1], "shm.response_ring", "ring",
+                              t_push_wall, dwell, pid=os.getpid())
             fut = futures.pop(corr, None)
             if fut is None or fut.done():
                 continue
@@ -434,6 +503,7 @@ class VectorShmClient:
 
     def fail_all(self, exc: Exception) -> None:
         futs, self._futures = self._futures, {}
+        self._trace_of.clear()
         for fut in futs.values():
             if not fut.done():
                 fut.set_exception(exc)
@@ -452,7 +522,8 @@ class _WorkerBoot:
                  "advertised_ep", "owner_internal_ep", "owner_address",
                  "config", "registry", "storage_providers",
                  "vector_interfaces", "membership_factory",
-                 "req_ring", "resp_ring", "close_fds", "close_socks")
+                 "req_ring", "resp_ring", "close_fds", "close_socks",
+                 "management")
 
     def __init__(self, **kw) -> None:
         for k, v in kw.items():
@@ -465,7 +536,8 @@ class _WorkerLink:
     the outbound side too)."""
 
     __slots__ = ("index", "proc", "req_ring", "resp_ring", "silo_address",
-                 "internal_ep", "ready", "dead", "out", "_flush_armed")
+                 "internal_ep", "ready", "dead", "out", "_flush_armed",
+                 "origin")
 
     def __init__(self, index: int, proc, req_ring: ShmRing,
                  resp_ring: ShmRing, ready: asyncio.Future):
@@ -479,6 +551,10 @@ class _WorkerLink:
         self.dead = False
         self.out: list = []          # pending (corr, ok, payload)
         self._flush_armed = False
+        # ledger attribution label for work this worker originates
+        # (device row-seconds via _Pending.origin, wire bytes via
+        # charge_wire) — the cross-process burner key
+        self.origin = f"worker-{index}"
 
 
 class WorkerSupervisor:
@@ -539,6 +615,10 @@ class WorkerSupervisor:
                 storage_providers=storage_providers,
                 vector_interfaces=dict(silo.vector_interfaces),
                 membership_factory=membership_factory,
+                # workers of a managed silo install their own SiloControl
+                # so cluster fan-outs (ctl_metrics / ctl_loop_profile /
+                # ctl_critical_path) reach every process by silo address
+                management=getattr(silo, "silo_control", None) is not None,
                 req_ring=req, resp_ring=resp,
                 # earlier workers' wakeup pipes: close in this child so
                 # a dead sibling's pipe EOF semantics stay crisp
@@ -612,10 +692,15 @@ class WorkerSupervisor:
     def _drain_link(self, link: _WorkerLink) -> None:
         ring = link.req_ring
         ring.drain_wakeups()
+        st = self.silo.ingest_stats
+        led = self.silo.ledger
+        n_recs = rx_bytes = 0
         while True:
             rec = ring.pop()
             if rec is None:
-                return
+                break
+            n_recs += 1
+            rx_bytes += len(rec[0])
             try:
                 payload = pickle.loads(rec[0])
                 kind = payload[0]
@@ -639,32 +724,73 @@ class WorkerSupervisor:
             except Exception:  # noqa: BLE001 — one record, not the link
                 log.exception("shm request record failed (worker %d)",
                               link.index)
+        if n_recs:
+            if st is not None:
+                # drain-batch size + record counter: one observe per
+                # wakeup sweep, on the owner's own loop (loop-confined)
+                st.histogram_with(_RS_DRAIN, COUNT_BOUNDS).observe(n_recs)
+                st.increment(_RS_RECORDS, n_recs)
+            if led is not None:
+                # inbound wire bytes land on the originating worker's
+                # route row — the cross-process get_cluster_ledger key
+                led.charge_wire(link.origin, rx_bytes, 0)
 
     def _handle_vec(self, link: _WorkerLink, payload) -> None:
         """One packed vector batch -> the engine. The columnar
         sub-batches join via ``call_packed`` (one method/table
         resolution + one tick schedule per group — the call_group
         discipline), route notes land in the real table, and each
-        wanted future's completion batches onto the response ring."""
-        _, iface, method, routes, subs = payload
+        wanted future's completion batches onto the response ring.
+
+        The record tail carries the worker's push stamps and per-sub
+        trace-context columns: the pop here closes the staging-ring
+        dwell (histogram + one "ring" span per distinct traced request,
+        parented into the request's trace), and the contexts thread
+        into ``call_packed`` so the tick records correctly-parented
+        device-tick child spans. ``link.origin`` labels every item for
+        the ledger's per-worker device-time attribution."""
+        _, iface, method, routes, subs, t_mono, t_wall, hops = payload
         silo = self.silo
         rt = silo.vector
         vcls = silo.vector_interfaces.get(iface)
         if rt is None or vcls is None:
             err = SiloUnavailableError(
                 f"no device engine for {iface} in the owner process")
-            for _names, _khs, corrs, _cols in subs:
+            for _names, _khs, corrs, _cols, _trs in subs:
                 for corr in corrs:
                     if corr >= 0:
                         self._complete_value(link, corr, False, err)
             return
+        st = silo.ingest_stats
+        dwell = max(0.0, time.monotonic() - t_mono)
+        if st is not None:
+            st.observe(_RS_STAGING, dwell)
+            st.histogram_with(_RS_HOPS, COUNT_BOUNDS).observe(hops)
+        tracer = silo.tracer
+        if tracer is not None:
+            seen: set = set()
+            for _names, _khs, _corrs, _cols, trs in subs:
+                for tr in trs:
+                    if tr is None or tr in seen:
+                        continue
+                    seen.add(tr)
+                    tracer.record(tr[0], tr[1], "shm.staging_ring",
+                                  "ring", t_wall, dwell,
+                                  worker=link.index)
         if routes:
             rt.table(vcls).note_route_many(routes)
-        for names, khs, corrs, cols in subs:
+        origin = link.origin if silo.ledger is not None else None
+        for names, khs, corrs, cols, trs in subs:
+            if st is not None:
+                st.histogram_with(_RS_GROUP, COUNT_BOUNDS).observe(
+                    len(khs))
             try:
                 futs = rt.call_packed(vcls, method, khs,
                                       dict(zip(names, cols)),
-                                      [c >= 0 for c in corrs])
+                                      [c >= 0 for c in corrs],
+                                      traces=(trs if tracer is not None
+                                              else None),
+                                      origin=origin)
             except Exception as e:  # noqa: BLE001 — unknown method etc.
                 for corr in corrs:
                     if corr >= 0:
@@ -700,12 +826,17 @@ class WorkerSupervisor:
             link.out.clear()
             return
         batch, link.out = link.out, []
+        # push stamps ride the record (monotonic for the response-dwell
+        # observe, wall for the return-leg span start — both closed by
+        # the worker's pop); a retry re-stamps at its own push, so dwell
+        # never absorbs the backoff
+        stamps = (time.monotonic(), time.time())
         try:
-            data = pickle.dumps(("res", batch), protocol=5)
+            data = pickle.dumps(("res", batch) + stamps, protocol=5)
         except Exception:  # noqa: BLE001 — unpicklable result: per-item
             data = pickle.dumps(
-                ("res", [self._portable(item) for item in batch]),
-                protocol=5)
+                ("res", [self._portable(item) for item in batch])
+                + stamps, protocol=5)
         if not link.resp_ring.push(data, n_msgs=len(batch)):
             # response ring full (worker loop stalled): hold the batch
             # and retry — results must not drop while the worker lives
@@ -713,6 +844,12 @@ class WorkerSupervisor:
             if not link._flush_armed:
                 link._flush_armed = True
                 self.loop.call_later(0.002, self._flush_link, link)
+            return
+        led = self.silo.ledger
+        if led is not None:
+            # outbound wire bytes join the worker's route row (the rx
+            # half charges at the request-ring drain)
+            led.charge_wire(link.origin, 0, len(data))
 
     @staticmethod
     def _portable(item):
@@ -778,8 +915,10 @@ class WorkerSupervisor:
                 "client_routes": relays.get(lk.internal_ep or "", 0),
                 "req_pushed": lk.req_ring.pushed_msgs,
                 "req_drained": lk.req_ring.drained_msgs,
+                "req_backlog": lk.req_ring.backlog(),
                 "resp_pushed": lk.resp_ring.pushed_msgs,
                 "resp_drained": lk.resp_ring.drained_msgs,
+                "resp_backlog": lk.resp_ring.backlog(),
             } for lk in self.links],
         }
 
@@ -891,7 +1030,18 @@ async def _worker_async(boot: _WorkerBoot) -> None:
     except (NotImplementedError, RuntimeError):
         pass
 
-    cfg = replace(boot.config, name=boot.name, worker_procs=1)
+    # worker_procs=1: a worker never forks its own fleet. The owner's
+    # Prometheus endpoint is a TCP port WITHOUT SO_REUSEPORT — N workers
+    # inheriting its number would collide at bind (or worse, silently
+    # shadow each other) — so workers rebind ephemeral (port 0) when the
+    # owner serves metrics at all, else stay serverless; per-process
+    # metrics stay reachable over ctl (ctl_metrics / ctl_critical_path
+    # fan out by silo address). Everything else — tracing, profiling
+    # (flight-recorder triggers), ledger, SLO — inherits, so anomaly
+    # triggers fire IN the worker that breached.
+    cfg = replace(boot.config, name=boot.name, worker_procs=1,
+                  metrics_port=(0 if boot.config.metrics_port is not None
+                                else None))
     fabric = SocketFabric(boot.host)
     storage = StorageManager()
     storage.providers.update(boot.storage_providers)
@@ -899,12 +1049,27 @@ async def _worker_async(boot: _WorkerBoot) -> None:
     join_cluster(silo, boot.membership_factory())
     await silo.start()
 
+    if getattr(boot, "management", False):
+        # the owner runs add_management: mirror the SiloControl system
+        # target here so ManagementGrain fan-outs (cluster metrics, loop
+        # profiles, the critical-path waterfall) reach THIS process by
+        # its silo address — workers are full cluster members
+        from ..management.control import SILO_CONTROL, SiloControl
+        control = SiloControl(silo)
+        silo.register_system_target(control, SILO_CONTROL)
+        silo.silo_control = control
+
     # the device proxy: every vector call from this process crosses the
     # staging ring into the owner's engine (installed before the
     # reuseport listener opens, so no client ever races it)
     proxy = None
     if boot.vector_interfaces:
         proxy = VectorShmClient(boot.req_ring, boot.owner_address)
+        # observability taps: the proxy stamps trace contexts into ring
+        # records and closes response-ring legs on THIS silo's collector
+        # / metrics-gated registry (both None when the plane is off)
+        proxy.tracer = silo.tracer
+        proxy.stats = silo.ingest_stats
         silo.vector = proxy
         silo.vector_interfaces.update(boot.vector_interfaces)
     # responses to clients held by OTHER processes route via the owner
@@ -933,7 +1098,7 @@ async def _worker_async(boot: _WorkerBoot) -> None:
                 continue
             if payload[0] == "res":
                 if proxy is not None:
-                    proxy.resolve(payload[1])
+                    proxy.resolve(payload[1], payload[2], payload[3])
             elif payload[0] == "stop":
                 stop_ev.set()
     loop.add_reader(boot.resp_ring.wake_rfd, _drain_responses)

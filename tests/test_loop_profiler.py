@@ -301,6 +301,12 @@ async def test_flight_recorder_on_forced_shed_via_management():
         assert prof["snapshot_count"] >= 1
         per = list(prof["per_silo"].values())[0]
         assert per["snapshots"][0]["reason"] == snap["reason"]
+        # pid labels (ISSUE 20): under worker processes several silos'
+        # recorders feed one cluster view — every payload and snapshot
+        # names the process it was captured in
+        import os
+        assert per["pid"] == os.getpid()
+        assert per["snapshots"][0]["pid"] == os.getpid()
         assert abs(sum(prof["shares"].values()) - 1.0) < 0.02
     finally:
         await client.close_async()

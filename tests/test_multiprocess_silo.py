@@ -426,3 +426,158 @@ async def test_cross_os_process_cluster_and_kill(tmp_path):
             finally:
                 if silo is not None:
                     await silo.stop()
+
+
+# --------------------------------------------------------------------------
+# cross-process observability (ISSUE 20): trace context over the rings,
+# per-worker ledger attribution, and the cluster-wide span merge
+# --------------------------------------------------------------------------
+
+def _build_obs_mp_silo(table_path, vec_cls, worker_procs, name="obsmp"):
+    """worker_procs silo with the FULL observability stack + management:
+    trace context must survive the shm ring hop (workers get their own
+    SiloControl so the cluster fan-outs reach every process)."""
+    from orleans_tpu.dispatch import add_vector_grains
+    from orleans_tpu.management import add_management
+    from orleans_tpu.parallel import make_mesh
+
+    fabric = SocketFabric()
+    b = (SiloBuilder().with_name(name).with_fabric(fabric)
+         .add_grains(EchoGrain)
+         .with_config(**LIVENESS, worker_procs=worker_procs,
+                      metrics_enabled=True, trace_enabled=True,
+                      trace_sample_rate=0.0, ledger_enabled=True))
+    add_vector_grains(b, vec_cls, mesh=make_mesh(8), capacity_per_shard=32)
+    add_management(b)
+    silo = b.build()
+    join_cluster(silo, FileMembershipTable(table_path))
+    return silo
+
+
+async def test_worker_procs_trace_waterfall(tmp_path):
+    """The ISSUE 20 acceptance: a client-rooted request through a worker
+    process yields ONE trace whose cluster-merged spans cover >= 95% of
+    the request wall as contiguous segments — client network leg, shm
+    staging-ring dwell (worker push → owner pop), owner queue-wait +
+    device tick, response-ring dwell, response network leg. Before this
+    PR the trace went dark between the worker's ingress and the owner's
+    engine: the ring hop carried no trace context."""
+    from benchmarks.multiproc_attribution import waterfall_coverage
+    from orleans_tpu.management import ManagementGrain
+
+    vec_cls = _vector_grain()
+    silo = _build_obs_mp_silo(str(tmp_path / "mbr.json"), vec_cls, 2)
+    await silo.start()
+    client = None
+    try:
+        client = await GatewayClient(
+            [silo.gateway_endpoint], response_timeout=15.0).connect()
+        # warmup: activate the key + compile the kernel so the traced
+        # request measures the steady-state path, not one-time JIT
+        await client.get_grain(vec_cls, 0).add(x=1.0)
+
+        client.enable_tracing(sample_rate=1.0, name="mp-client")
+        assert float(await client.get_grain(vec_cls, 0).add(x=2.0)) == 3.0
+        await asyncio.sleep(0.1)  # let done-callbacks close their spans
+        cspans = client.tracer.snapshot()
+        tids = [s["trace_id"] for s in cspans if s["kind"] == "client"]
+        assert len(tids) == 1, cspans  # exactly one client-rooted trace
+        tid = tids[0]
+
+        # cluster-wide merge: the owner AND both workers answer the span
+        # fan-out (workers run their own SiloControl since this PR)
+        mgmt = client.get_grain(ManagementGrain, 0)
+        spans = cspans + await mgmt.get_trace_spans(tid)
+        wf = waterfall_coverage(spans, tid)
+
+        names = {s["name"] for s in wf["segments"]}
+        assert "shm.staging_ring" in names, wf
+        assert "shm.response_ring" in names, wf
+        assert "engine.queue_wait" in names, wf
+        assert any(n.startswith("tick ") for n in names), wf
+        assert {"ring", "network", "server", "device_tick"} <= \
+            set(wf["kinds"]), wf
+        # contiguous coverage of the measured request wall
+        assert wf["coverage"] >= 0.95, wf
+        # waterfall order: staging dwell precedes the tick, the response
+        # ring leg outlives it (push happens at tick completion)
+        seg = {s["name"]: s for s in wf["segments"]}
+        tick = next(s for s in wf["segments"]
+                    if s["name"].startswith("tick "))
+        assert seg["shm.staging_ring"]["offset_us"] <= tick["offset_us"]
+        resp = seg["shm.response_ring"]
+        assert resp["offset_us"] + resp["dur_us"] >= \
+            tick["offset_us"] + tick["dur_us"]
+        # the spans name >= 3 distinct silos (client, owner, worker) —
+        # the Perfetto export keys its process tracks by span silo, so
+        # the waterfall renders one track per OS process for free
+        assert len({s["silo"] for s in spans
+                    if s["trace_id"] == tid}) >= 3, spans
+    finally:
+        if client is not None:
+            await client.close_async()
+        await silo.stop()
+
+
+async def test_worker_procs_ledger_attribution(tmp_path):
+    """Per-worker cost attribution (ISSUE 20 satellite): device rows
+    charged on the owner's engine land on the ORIGINATING worker's
+    ``procs`` row (exactly its staged message count), the owner's wire
+    charges are keyed by worker origin, and the cluster merge is
+    fold-order independent."""
+    from orleans_tpu.management import ManagementGrain
+    from orleans_tpu.observability.ledger import CostLedger
+
+    vec_cls = _vector_grain()
+    silo = _build_obs_mp_silo(str(tmp_path / "mbr.json"), vec_cls, 2,
+                              name="ledmp")
+    await silo.start()
+    clients = []
+    try:
+        for _ in range(4):
+            clients.append(await GatewayClient(
+                [silo.gateway_endpoint], response_timeout=15.0).connect())
+        vals = await asyncio.gather(*(
+            clients[k % 4].get_grain(vec_cls, k).add(x=1.0)
+            for k in range(24)))
+        assert [float(v) for v in vals] == [1.0] * 24
+
+        # ground truth from the ring counters: how many vector messages
+        # each worker actually staged (single-writer cumulative)
+        d = silo.workers.describe()
+        pushed = {f"worker-{w['index']}": w["req_pushed"]
+                  for w in d["workers"]}
+        assert sum(pushed.values()) == 24, d
+
+        # the ring counters are live MetricsSampler gauges (ISSUE 20):
+        # summed across workers, evaluated at snapshot time
+        gauges = silo.stats.snapshot()["gauges"]
+        assert gauges["workers.alive"] == 2, gauges
+        assert gauges["workers.req_drained"] == 24, gauges
+        assert gauges["workers.req_backlog"] == 0, gauges
+        assert gauges["workers.resp_pushed"] == 24, gauges
+
+        mgmt = clients[0].get_grain(ManagementGrain, 0)
+        led = await mgmt.get_cluster_ledger(5)
+        procs = led["procs"]
+        # every device row charged to exactly the worker that staged it
+        assert set(procs) == {o for o, n in pushed.items() if n}, led
+        for origin, (rows, secs) in procs.items():
+            assert rows == pushed[origin], (origin, procs, pushed)
+            assert secs > 0, (origin, procs)
+        # the owner's shm wire accounting is keyed by the same origin
+        for origin in procs:
+            rx, tx = led["wire"][origin]
+            assert rx > 0 and tx > 0, (origin, led["wire"])
+        # deterministic merge: silo fold order cannot change the answer
+        snaps = [s for s in led["per_silo"].values() if s]
+        a = CostLedger.merge(snaps)
+        z = CostLedger.merge(list(reversed(snaps)))
+        assert a["procs"] == z["procs"] and a["wire"] == z["wire"]
+    finally:
+        for c in clients:
+            try:
+                await c.close_async()
+            except Exception:
+                pass
+        await silo.stop()

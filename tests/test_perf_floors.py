@@ -585,6 +585,65 @@ async def test_floor_multiproc():
         f"(floor {MULTIPROC_SPEEDUP_FLOOR}x on a multi-core runner)"
 
 
+# Multi-process observability (ISSUE 20): the FULL stack (profiling +
+# metrics + tracing + ledger + management) vs a bare silo on identical
+# worker_procs=2 traffic. Two layers, like the multiproc floor:
+#   * structural (always): the merged cluster critical path covers the
+#     summed loop wall (shares_sum ~1.0 by construction — contiguous
+#     per-callback segments + idle, folded across all 3 processes),
+#     every process reports, device rows attribute to originating
+#     workers in the merged ledger, and the traced probe's
+#     cross-process waterfall (client → ring dwell → queue wait → tick
+#     → ring dwell → client) covers >= 0.9 of its request wall.
+#   * overhead ratio (gated on the parallelism probe): observability
+#     CPU in 3 busy processes competes for cores, so the >=0.85x ratio
+#     is only meaningful where parallel work actually scales — this
+#     container (~0.5-1.6x probe) skips with the capacity in the reason.
+MULTIPROC_OBS_OVERHEAD_FLOOR = 0.85
+
+
+async def test_floor_multiproc_observability():
+    import os
+
+    from benchmarks import multiproc_attribution
+
+    cores = (len(os.sched_getaffinity(0))
+             if hasattr(os, "sched_getaffinity") else (os.cpu_count() or 1))
+    if cores < 2:
+        pytest.skip("multi-process observability floor needs >=2 cores")
+
+    async def once():
+        r = await multiproc_attribution.run_observability_ab(seconds=1.5)
+        return r["value"], r["extra"]
+
+    ratio, x = await once()
+    if ratio < MULTIPROC_OBS_OVERHEAD_FLOOR * 1.1:
+        r2, x2 = await once()  # noise guard: best of two
+        if r2 > ratio:
+            ratio, x = r2, x2
+    # structural, always: one report covers every process's loop wall
+    cp = x["critical_path"]
+    assert cp is not None and abs(cp["shares_sum"] - 1.0) <= 0.02, cp
+    assert len(cp["processes"]) == 3, cp  # owner + both workers report
+    assert x["ledger"]["procs"], x["ledger"]  # per-worker attribution
+    wf = x["trace_waterfall"]
+    assert wf is not None and wf["coverage"] >= 0.9, wf
+    assert {"ring", "server"} <= set(wf["kinds"]), wf
+    capacity = _parallel_capacity()
+    if capacity < MULTIPROC_SPEEDUP_FLOOR:
+        pytest.skip(
+            f"runner delivers only {capacity:.2f}x to perfectly parallel "
+            f"work (shared/throttled cores) — observability CPU competes "
+            f"with 3 busy processes for the same cores, so the "
+            f">={MULTIPROC_OBS_OVERHEAD_FLOOR}x overhead ratio is only "
+            f"asserted on genuinely multi-core runners; structural "
+            f"critical-path/waterfall/ledger reads verified "
+            f"(ratio {ratio:.2f}x)")
+    assert ratio >= MULTIPROC_OBS_OVERHEAD_FLOOR, \
+        f"full observability stack at {ratio:.2f}x of bare multiproc " \
+        f"(floor {MULTIPROC_OBS_OVERHEAD_FLOOR}x on a multi-core runner)"
+
+
 # SLO monitor over the metrics pipeline: a same-process ratio (no
 # needs_eager). Both sides pay identical per-message metrics stamps —
 # the monitor adds zero hot-path instrumentation by design (evaluation

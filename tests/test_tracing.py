@@ -100,7 +100,7 @@ async def test_two_silo_trace_covers_client_network_queue_exec(tmp_path):
         bd = await mgmt.get_trace_breakdown(tid)
         assert bd["span_count"] > 0 and bd["total_s"] > 0
         assert bd["seconds"]["exec"] > 0
-        assert set(bd["fractions"]) == {"queue", "exec", "network",
+        assert set(bd["fractions"]) == {"queue", "exec", "network", "ring",
                                         "directory", "device", "migration"}
         assert all(0.0 <= f <= 1.0 for f in bd["fractions"].values())
 
